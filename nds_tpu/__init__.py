@@ -1,0 +1,14 @@
+"""nds_tpu — TPU-native decision-support benchmark framework.
+
+Re-implements the capabilities of NVIDIA's spark-rapids-benchmarks (NDS /
+NDS-H harness over Spark + spark-rapids GPU plugin; see SURVEY.md) with a
+TPU-first architecture: the harness half (data/query generation, schemas,
+phase drivers, reporting, validation, orchestration) is pure Python; the
+engine half is a columnar SQL execution layer lowering
+scan -> join -> aggregate -> sort -> exchange to XLA via JAX
+(`jit`/`shard_map`), with shuffle exchange riding ICI/DCN collectives in
+place of Spark's block shuffle (reference delegated all execution to Spark:
+/root/reference/nds/power_run_gpu.template:35).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,173 @@
+"""Raw '|'-delimited text IO (dbgen/dsdgen .dat format) + Parquet.
+
+The raw-data contract matches what the TPC tools emit and the reference
+consumes (`nds/nds_transcode.py:56-66` reads '|'-CSV with an explicit
+schema; `nds-h/nds_h_schema.py:50-61` adds an 'ignore' trailing column for
+dbgen's trailing '|'). Here ``trailing_delimiter=True`` handles that in the
+reader. Parquet read/write goes through pyarrow; string columns round-trip
+as Arrow dictionary arrays so the sorted-code invariant is rebuilt on read.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+from nds_tpu.engine.types import (
+    DateType, DecimalType, FloatType, IntType, Schema, StringType,
+)
+from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
+
+_EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def _arrow_read_type(dtype) -> pa.DataType:
+    if isinstance(dtype, IntType):
+        return pa.int64() if dtype.bits == 64 else pa.int32()
+    if isinstance(dtype, FloatType):
+        return pa.float64() if dtype.bits == 64 else pa.float32()
+    if isinstance(dtype, DecimalType):
+        return pa.decimal128(max(dtype.precision, 18), dtype.scale)
+    if isinstance(dtype, DateType):
+        return pa.date32()
+    if isinstance(dtype, StringType):
+        return pa.string()
+    raise TypeError(f"unsupported dtype {dtype}")
+
+
+def read_tbl(paths: list[str] | str, name: str, schema: Schema,
+             trailing_delimiter: bool = True) -> HostTable:
+    """Read one table from one or more '|'-delimited files."""
+    if isinstance(paths, str):
+        paths = [paths]
+    names = schema.names + (["_trailing"] if trailing_delimiter else [])
+    types = {f.name: _arrow_read_type(f.dtype) for f in schema}
+    if trailing_delimiter:
+        types["_trailing"] = pa.string()
+    tables = []
+    for p in paths:
+        if os.path.getsize(p) == 0:
+            continue  # zero-row chunks are legitimate (fixed tables)
+        t = pacsv.read_csv(
+            p,
+            read_options=pacsv.ReadOptions(column_names=names),
+            parse_options=pacsv.ParseOptions(delimiter="|"),
+            convert_options=pacsv.ConvertOptions(column_types=types),
+        )
+        if trailing_delimiter:
+            t = t.drop(["_trailing"])
+        tables.append(t)
+    if not tables:
+        empty = pa.table(
+            {f.name: pa.array([], type=_arrow_read_type(f.dtype)) for f in schema})
+        return from_arrow(name, schema, empty)
+    return from_arrow(name, schema, pa.concat_tables(tables))
+
+
+def from_arrow(name: str, schema: Schema, t: pa.Table) -> HostTable:
+    cols: dict[str, HostColumn] = {}
+    for f in schema:
+        arr = t.column(f.name).combine_chunks()
+        if isinstance(f.dtype, StringType):
+            # arrow-native dictionary encode, then remap codes so the
+            # dictionary is sorted (code order == lexicographic order);
+            # only the (small) dictionary is ever sorted, not the column
+            if not pa.types.is_dictionary(arr.type):
+                arr = arr.dictionary_encode()
+            raw_dict = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+            raw_codes = arr.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+            order = np.argsort(raw_dict.astype(str), kind="stable")
+            remap = np.empty(len(raw_dict), dtype=np.int32)
+            remap[order] = np.arange(len(raw_dict), dtype=np.int32)
+            codes = remap[raw_codes] if len(raw_dict) else raw_codes
+            cols[f.name] = HostColumn(f.dtype, codes, raw_dict[order])
+        elif isinstance(f.dtype, DecimalType):
+            s = f.dtype.scale
+            if f.dtype.precision <= 15:
+                # float64 is exact for <= 15 significant digits: vectorized
+                as_f = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+                ints = np.round(as_f * 10**s).astype(np.int64)
+            else:
+                ints = np.array(
+                    [0 if v is None else int(v.scaleb(s)) for v in arr.to_pylist()],
+                    dtype=np.int64)
+            cols[f.name] = HostColumn(f.dtype, ints)
+        elif isinstance(f.dtype, DateType):
+            d = arr.cast(pa.int32())
+            cols[f.name] = HostColumn(f.dtype, d.to_numpy(zero_copy_only=False))
+        else:
+            cols[f.name] = HostColumn(
+                f.dtype, arr.to_numpy(zero_copy_only=False))
+    return HostTable(name, schema, cols)
+
+
+def to_arrow(table: HostTable) -> pa.Table:
+    arrays, names = [], []
+    for f in table.schema:
+        col = table.columns[f.name]
+        names.append(f.name)
+        if col.is_string:
+            dict_arr = pa.DictionaryArray.from_arrays(
+                pa.array(col.values, type=pa.int32()),
+                pa.array(list(col.dictionary), type=pa.string()))
+            arrays.append(dict_arr)
+        elif isinstance(f.dtype, DecimalType):
+            s = f.dtype.scale
+            target = pa.decimal128(max(f.dtype.precision, 18), s)
+            if f.dtype.precision <= 15:
+                # exact: |value| < 10^15 so float64 round-trips the cents
+                as_f = col.values.astype(np.float64) / 10**s
+                arrays.append(pa.array(as_f).cast(target, safe=False))
+            else:
+                from decimal import Decimal
+                vals = [Decimal(int(v)).scaleb(-s) for v in col.values]
+                arrays.append(pa.array(vals, type=target))
+        elif isinstance(f.dtype, DateType):
+            arrays.append(pa.array(col.values, type=pa.int32()).cast(pa.date32()))
+        else:
+            arrays.append(pa.array(col.values))
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+def write_parquet(table: HostTable, path: str, compression: str = "snappy",
+                  row_group_rows: int = 1 << 20) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pq.write_table(to_arrow(table), path, compression=compression,
+                   row_group_size=row_group_rows)
+
+
+def read_parquet(paths: list[str] | str, name: str, schema: Schema) -> HostTable:
+    if isinstance(paths, str):
+        paths = [paths]
+    tables = [pq.read_table(p) for p in paths]
+    return from_arrow(name, schema, pa.concat_tables(tables, promote_options="permissive"))
+
+
+def write_tbl(arrays: dict[str, np.ndarray], schema: Schema, path: str,
+              trailing_delimiter: bool = True) -> None:
+    """Write generator output in dbgen's .tbl text format (for parity with
+    the reference raw-data layout, `nds-h/nds_h_gen_data.py:109-115`)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = len(next(iter(arrays.values())))
+    cols = []
+    for f in schema:
+        arr = arrays[f.name]
+        if isinstance(f.dtype, DecimalType):
+            s = f.dtype.scale
+            ints = arr.astype(np.int64)
+            sign = np.where(ints < 0, "-", "")
+            mag = np.abs(ints)
+            cols.append([f"{sign[i]}{mag[i] // 10**s}.{mag[i] % 10**s:0{s}d}"
+                         for i in range(n)])
+        elif isinstance(f.dtype, DateType):
+            cols.append([str(_EPOCH + int(v)) for v in arr])
+        else:
+            cols.append([str(v) for v in arr])
+    end = "|\n" if trailing_delimiter else "\n"
+    with open(path, "w") as f:
+        for row in zip(*cols):
+            f.write("|".join(row) + end)

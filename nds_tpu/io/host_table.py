@@ -1,0 +1,100 @@
+"""Host-side columnar tables: the staging format between IO and the engine.
+
+Everything the device engine touches is numeric. String columns are
+dictionary-encoded at load: values live in a host-side sorted dictionary,
+devices only see int32 codes. Because the dictionary is sorted, code order
+== lexicographic order, so <,>,=,ORDER BY on strings compile to integer
+compares on the MXU-friendly path (SURVEY.md §7 "hard parts": strings are
+the classic reason SQL engines fall off the accelerator; this encoding
+keeps them on it).
+
+The reference has no equivalent layer — Spark DataFrames play this role
+(`nds/nds_transcode.py:56-66` reads CSV into Spark). Here the layer is
+explicit because the engine is ours.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from nds_tpu.engine.types import (
+    DateType, DecimalType, DType, FloatType, IntType, Schema, StringType,
+)
+
+
+@dataclass
+class HostColumn:
+    """One column: numeric numpy array + optional string dictionary.
+
+    For string columns ``values`` holds int32 codes indexing ``dictionary``
+    (sorted unique values, so codes preserve lexicographic order).
+    ``null_mask`` is True where the value is valid (None = all valid).
+    """
+
+    dtype: DType
+    values: np.ndarray
+    dictionary: np.ndarray | None = None
+    null_mask: np.ndarray | None = None
+
+    @property
+    def is_string(self) -> bool:
+        return self.dictionary is not None
+
+    def decode(self) -> np.ndarray:
+        """Materialize python-visible values (strings decoded)."""
+        if self.is_string:
+            out = self.dictionary[np.clip(self.values, 0, len(self.dictionary) - 1)]
+            if self.null_mask is not None:
+                out = out.copy()
+                out[~self.null_mask] = None
+            return out
+        return self.values
+
+
+@dataclass
+class HostTable:
+    name: str
+    schema: Schema
+    columns: dict[str, HostColumn] = field(default_factory=dict)
+
+    @property
+    def nrows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())).values)
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[name]
+
+
+def encode_strings(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted-dictionary encode an object array -> (int32 codes, dictionary)."""
+    dictionary, codes = np.unique(values.astype(str), return_inverse=True)
+    return codes.astype(np.int32), dictionary.astype(object)
+
+
+def from_arrays(name: str, schema: Schema, arrays: dict[str, np.ndarray]) -> HostTable:
+    """Build a HostTable from generator output ({col: numpy array}).
+
+    Numeric/date/decimal columns pass through (decimals already scaled
+    int64); object arrays are dictionary-encoded.
+    """
+    cols: dict[str, HostColumn] = {}
+    for f in schema:
+        arr = arrays[f.name]
+        if isinstance(f.dtype, StringType):
+            codes, dictionary = encode_strings(arr)
+            cols[f.name] = HostColumn(f.dtype, codes, dictionary)
+        elif isinstance(f.dtype, DecimalType):
+            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int64))
+        elif isinstance(f.dtype, DateType):
+            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int32))
+        elif isinstance(f.dtype, IntType):
+            cols[f.name] = HostColumn(f.dtype, arr.astype(f"int{f.dtype.bits}"))
+        elif isinstance(f.dtype, FloatType):
+            cols[f.name] = HostColumn(f.dtype, arr.astype(f"float{f.dtype.bits}"))
+        else:
+            cols[f.name] = HostColumn(f.dtype, arr)
+    return HostTable(name, schema, cols)

@@ -1,0 +1,94 @@
+"""Fail-fast precondition guards for CLI drivers.
+
+Plays the role of the reference's `nds/check.py:38-152` / `utils/check.py`
+(version gate, path validation, range validation, parallelism validation,
+summary-folder guard, query-subset check) for the TPU harness. One shared
+copy — the reference's nds/ vs utils/ duplication is deliberately not
+reproduced (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+class CheckError(ValueError):
+    """Raised when a precondition guard fails."""
+
+
+def check_version(minimum=(3, 9)) -> None:
+    """Gate on interpreter version (reference gates on >=3.6; jax needs 3.9+)."""
+    if sys.version_info < minimum:
+        req = ".".join(str(p) for p in minimum)
+        raise CheckError(f"Python {req}+ required, found {sys.version.split()[0]}")
+
+
+def get_abs_path(path: str) -> str:
+    """Expand and absolutize a user-supplied path, requiring existence."""
+    p = os.path.abspath(os.path.expanduser(path))
+    if not os.path.exists(p):
+        raise CheckError(f"path does not exist: {path}")
+    return p
+
+
+def valid_range(value: str, parallel: int) -> tuple[int, int]:
+    """Parse an inclusive 'start,end' chunk range for incremental data gen.
+
+    Mirrors the semantics of the reference's ``--range`` option
+    (`nds/nds_gen_data.py` valid_range): both ends in [1, parallel],
+    start <= end.
+    """
+    try:
+        start_s, end_s = value.split(",")
+        start, end = int(start_s), int(end_s)
+    except ValueError as e:
+        raise CheckError(f"invalid range {value!r}: expected 'start,end'") from e
+    if not (1 <= start <= end <= parallel):
+        raise CheckError(
+            f"invalid range {value!r}: need 1 <= start <= end <= parallel={parallel}")
+    return start, end
+
+
+def parallel_value_type(value: str) -> int:
+    """Parallelism must be an int >= 2 (reference: parallel_value_type)."""
+    try:
+        v = int(value)
+    except ValueError as e:
+        raise CheckError(f"parallel must be an integer, got {value!r}") from e
+    if v < 2:
+        raise CheckError(f"parallel must be >= 2, got {v}")
+    return v
+
+
+def check_json_summary_folder(path: str | None) -> None:
+    """Require the summary folder, if given, to be absent or an empty dir.
+
+    Same guard as the reference's check_json_summary_folder: refuses to mix
+    new per-query JSON summaries with stale ones.
+    """
+    if not path:
+        return
+    if os.path.exists(path):
+        if not os.path.isdir(path):
+            raise CheckError(f"json summary folder is not a directory: {path}")
+        if os.listdir(path):
+            raise CheckError(f"json summary folder is not empty: {path}")
+
+
+def check_query_subset_exists(query_dict, subset) -> None:
+    """Every requested query name must exist in the parsed stream."""
+    missing = [q for q in subset if q not in query_dict]
+    if missing:
+        raise CheckError(f"queries not found in stream: {missing}")
+
+
+def get_dir_size(path: str) -> int:
+    """Total bytes under a directory tree (used for raw-data size reporting)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            fp = os.path.join(root, f)
+            if os.path.isfile(fp):
+                total += os.path.getsize(fp)
+    return total

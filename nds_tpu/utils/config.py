@@ -1,0 +1,100 @@
+"""Layered engine configuration.
+
+The reference configures Spark through bash-sourced template files building
+a ``SPARK_CONF`` array (`nds/spark-submit-template:28-40`,
+`nds/base.template:26-37`) overlaid by ``--property_file`` k=v files merged
+into the session (`nds/nds_power.py:324-330`). There is no shell or JVM in
+this stack, so templates become plain ``key=value`` files with ``${ENV:-default}``
+substitution; precedence is identical: template < property file < explicit
+CLI overrides.
+
+Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
+
+  engine.backend            tpu|cpu (which jax backend executes queries)
+  engine.mesh.shards        data-parallel shard count (devices in mesh)
+  engine.floats             true -> float64/float32 arithmetic (reference
+                            --floats mode); false -> scaled-int decimals
+  engine.batch.capacity     static row capacity override per table scan
+  engine.concurrent_tasks   async dispatch depth (analog of
+                            spark.rapids.sql.concurrentGpuTasks,
+                            nds/power_run_gpu.template:38)
+  engine.precision          bf16|f32 for float mode on-device compute
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_ENV_RE = re.compile(r"\$\{(?P<name>[A-Za-z_][A-Za-z0-9_]*)(?::-(?P<default>[^}]*))?\}")
+
+
+def _substitute_env(value: str, env: dict | None = None) -> str:
+    env = env if env is not None else os.environ
+
+    def repl(m):
+        name, default = m.group("name"), m.group("default")
+        if name in env:
+            return env[name]
+        if default is not None:
+            return default
+        raise KeyError(f"undefined environment variable ${{{name}}} in config")
+
+    return _ENV_RE.sub(repl, value)
+
+
+def load_properties(path: str, env: dict | None = None) -> dict:
+    """Parse a k=v property/template file with comments and env substitution."""
+    conf: dict[str, str] = {}
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}:{lineno}: expected key=value, got {line!r}")
+            key, _, value = line.partition("=")
+            conf[key.strip()] = _substitute_env(value.strip(), env)
+    return conf
+
+
+DEFAULTS = {
+    "engine.backend": "cpu",
+    "engine.mesh.shards": "1",
+    "engine.floats": "false",
+    "engine.concurrent_tasks": "2",
+    "engine.precision": "f32",
+}
+
+
+class EngineConfig:
+    """Merged view over defaults < template < property file < overrides."""
+
+    def __init__(self, template_path: str | None = None,
+                 property_path: str | None = None,
+                 overrides: dict | None = None) -> None:
+        conf = dict(DEFAULTS)
+        self.sources = {"template": template_path, "property_file": property_path}
+        if template_path:
+            conf.update(load_properties(template_path))
+        if property_path:
+            conf.update(load_properties(property_path))
+        if overrides:
+            conf.update({k: str(v) for k, v in overrides.items()})
+        self.conf = conf
+
+    def get(self, key: str, default=None):
+        return self.conf.get(key, default)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.conf.get(key)
+        if v is None:
+            return default
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.conf.get(key)
+        return default if v is None else int(v)
+
+    def as_dict(self) -> dict:
+        return dict(self.conf)

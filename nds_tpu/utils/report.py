@@ -1,0 +1,140 @@
+"""Per-query JSON summary reports.
+
+Format-compatible with the reference's `nds/PysparkBenchReport.py:47-122`
+summary dict (env/queryStatus/exceptions/startTime/queryTimes/query +
+filename '{prefix}-{query}-{startTime}.json'), so downstream report
+consumers keep working. Differences are TPU-native by design:
+
+- env captures jax backend/devices instead of sparkConf/sparkVersion;
+- "task failure" detection (reference: Scala SparkListener bridged over
+  py4j, `nds/python_listener/PythonListener.py:21-61`) is an in-process
+  failure collector — there is no JVM boundary in this stack;
+- timing brackets call ``block_until_ready`` upstream so async dispatch
+  cannot hide work (SURVEY.md §5 tracing note).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Callable
+
+_REDACTED_MARKERS = ("TOKEN", "SECRET", "PASSWORD", "KEY", "CREDENTIAL")
+
+
+def redact_env(env: dict) -> dict:
+    """Drop env vars whose *name* suggests a secret.
+
+    Stricter than the reference (exact-name match on TOKEN/SECRET/PASSWORD,
+    `PysparkBenchReport.py:72-73`): substring match plus KEY/CREDENTIAL.
+    """
+    return {
+        k: v for k, v in env.items()
+        if not any(m in k.upper() for m in _REDACTED_MARKERS)
+    }
+
+
+class TaskFailureCollector:
+    """In-process stand-in for the reference's jvm/python listener chain.
+
+    Engine internals append non-fatal anomalies (retries, padded-capacity
+    overflows that were recovered by re-execution, host fallbacks). A query
+    that completes with collected failures is reported
+    'CompletedWithTaskFailures', matching `PysparkBenchReport.py:90-93`.
+    """
+
+    _active: list["TaskFailureCollector"] = []
+
+    def __init__(self) -> None:
+        self.failures: list[str] = []
+
+    def register(self) -> None:
+        TaskFailureCollector._active.append(self)
+
+    def unregister(self) -> None:
+        if self in TaskFailureCollector._active:
+            TaskFailureCollector._active.remove(self)
+
+    @classmethod
+    def notify(cls, reason: str) -> None:
+        """Called by engine internals on recoverable task-level failures."""
+        for listener in cls._active:
+            listener.failures.append(reason)
+
+
+class BenchReport:
+    """Build and persist one per-query JSON summary."""
+
+    def __init__(self, query_name: str, engine_info: dict | None = None) -> None:
+        self.summary = {
+            "env": {
+                "envVars": {},
+                "engineConf": {},
+                "engineVersion": None,
+            },
+            "queryStatus": [],
+            "exceptions": [],
+            "startTime": None,
+            "queryTimes": [],
+            "query": query_name,
+        }
+        self._engine_info = engine_info or {}
+
+    def _capture_env(self) -> None:
+        self.summary["env"]["envVars"] = redact_env(dict(os.environ))
+        conf = dict(self._engine_info)
+        try:
+            import jax
+            conf.setdefault("backend", jax.default_backend())
+            conf.setdefault("device_count", jax.device_count())
+            conf.setdefault(
+                "devices", [str(d) for d in jax.devices()][:8])
+            self.summary["env"]["engineVersion"] = f"jax-{jax.__version__}"
+        except Exception:  # jax optional for harness-only paths
+            self.summary["env"]["engineVersion"] = "cpu-harness"
+        self.summary["env"]["engineConf"] = {str(k): str(v) for k, v in conf.items()}
+
+    def report_on(self, fn: Callable, *args):
+        """Run fn(*args), recording status/exception/elapsed-ms.
+
+        Statuses: Completed | CompletedWithTaskFailures | Failed — the same
+        vocabulary the reference emits (`PysparkBenchReport.py:90-103`).
+        """
+        self._capture_env()
+        collector = TaskFailureCollector()
+        collector.register()
+        start_time = int(time.time() * 1000)
+        try:
+            fn(*args)
+            end_time = int(time.time() * 1000)
+            if collector.failures:
+                self.summary["queryStatus"].append("CompletedWithTaskFailures")
+                self.summary["exceptions"].extend(collector.failures)
+            else:
+                self.summary["queryStatus"].append("Completed")
+        except Exception as e:
+            print("ERROR BEGIN")
+            traceback.print_exc()
+            print("ERROR END")
+            end_time = int(time.time() * 1000)
+            self.summary["queryStatus"].append("Failed")
+            self.summary["exceptions"].append(str(e))
+        finally:
+            collector.unregister()
+        self.summary["startTime"] = start_time
+        self.summary["queryTimes"].append(end_time - start_time)
+        return self.summary
+
+    def write_summary(self, prefix: str = "") -> str:
+        """Write '{prefix}-{query}-{startTime}.json' (reference filename
+        contract, `PysparkBenchReport.py:117-119`) and return the path."""
+        filename = f"{prefix}-{self.summary['query']}-{self.summary['startTime']}.json"
+        self.summary["filename"] = filename
+        with open(filename, "w") as f:
+            json.dump(self.summary, f, indent=2)
+        return filename
+
+    def is_success(self) -> bool:
+        return self.summary["queryStatus"] == ["Completed"]

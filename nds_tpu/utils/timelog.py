@@ -1,0 +1,41 @@
+"""CSV time-log writer.
+
+Header and row format match the reference power-run time log
+(`nds/nds_power.py:294-303`): ["application_id", "query",
+"time/milliseconds"], with synthetic rows for per-phase brackets
+(CreateTempView / WriteTimeLog / Total / benchmark times), so tooling that
+parses the reference CSV parses ours.
+"""
+
+from __future__ import annotations
+
+import csv
+
+HEADER = ["application_id", "query", "time/milliseconds"]
+
+
+class TimeLog:
+    def __init__(self, app_id: str) -> None:
+        self.app_id = app_id
+        self.rows: list[list] = []
+
+    def add(self, query_name: str, millis: int) -> None:
+        self.rows.append([self.app_id, query_name, int(millis)])
+
+    def write(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(HEADER)
+            w.writerows(self.rows)
+
+    @staticmethod
+    def read(path: str) -> list[tuple[str, str, int]]:
+        out = []
+        with open(path, newline="") as f:
+            r = csv.reader(f)
+            header = next(r)
+            if header != HEADER:
+                raise ValueError(f"unexpected time log header {header!r} in {path}")
+            for app_id, query, ms in r:
+                out.append((app_id, query, int(ms)))
+        return out

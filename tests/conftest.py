@@ -1,0 +1,18 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+This is the "multi-node without a cluster" tier (SURVEY.md §4): the
+reference's analog is Spark local[*] mode (`shared/base.template:27`); ours
+is XLA's host-platform device multiplexing, so every sharding/collective
+path is exercised without TPU hardware.
+
+Must run before any jax import — pytest imports conftest first.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")

@@ -1,0 +1,109 @@
+import numpy as np
+import pytest
+
+from nds_tpu.datagen import tpch
+from nds_tpu.io import csv_io
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds_h.schema import get_schemas
+
+SF = 0.01  # tiny but non-degenerate: ~60k lineitem rows
+
+
+@pytest.fixture(scope="module")
+def schemas():
+    return get_schemas()
+
+
+class TestTpchGen:
+    def test_fixed_tables(self):
+        nation = tpch.gen_table("nation", SF)
+        assert len(nation["n_nationkey"]) == 25
+        assert "GERMANY" in set(nation["n_name"])
+        region = tpch.gen_table("region", SF)
+        assert list(region["r_name"]) == tpch.REGIONS
+
+    def test_chunking_covers_exactly(self):
+        whole = tpch.gen_table("customer", SF, 1, 1)
+        parts = [tpch.gen_table("customer", SF, 4, s) for s in range(1, 5)]
+        joined = np.concatenate([p["c_custkey"] for p in parts])
+        assert np.array_equal(np.sort(joined), np.sort(whole["c_custkey"]))
+        # chunks are deterministic
+        again = tpch.gen_table("customer", SF, 4, 2)
+        assert np.array_equal(again["c_acctbal"], parts[1]["c_acctbal"])
+
+    def test_lineitem_orders_consistency(self):
+        orders = tpch.gen_table("orders", SF)
+        li = tpch.gen_table("lineitem", SF)
+        # every lineitem orderkey exists in orders
+        assert np.isin(li["l_orderkey"], orders["o_orderkey"]).all()
+        # line numbers start at 1 per order, max 7
+        assert li["l_linenumber"].min() == 1
+        assert li["l_linenumber"].max() <= 7
+        # lineitem chunks partition the same rows
+        li_parts = [tpch.gen_table("lineitem", SF, 3, s) for s in range(1, 4)]
+        total = sum(len(p["l_orderkey"]) for p in li_parts)
+        assert total == len(li["l_orderkey"])
+        # extendedprice correlation with part retailprice
+        exp = li["l_quantity"] // 100 * tpch.retailprice_cents(li["l_partkey"])
+        assert np.array_equal(exp, li["l_extendedprice"])
+
+    def test_custkey_never_multiple_of_three(self):
+        orders = tpch.gen_table("orders", SF)
+        assert (orders["o_custkey"] % 3 != 0).all()
+
+    def test_dates_in_range(self):
+        li = tpch.gen_table("lineitem", SF)
+        assert li["l_shipdate"].min() >= tpch.STARTDATE
+        assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+        # both linestatus values occur (split date logic)
+        assert set(li["l_linestatus"]) == {"O", "F"}
+
+    def test_partsupp_spread(self):
+        ps = tpch.gen_table("partsupp", SF)
+        # 4 distinct suppliers per part
+        assert len(ps["ps_partkey"]) == 4 * tpch.table_rows("part", SF)
+        first_part = ps["ps_suppkey"][ps["ps_partkey"] == 1]
+        assert len(set(first_part)) == 4
+
+
+class TestIO:
+    def test_tbl_roundtrip(self, tmp_path, schemas):
+        arrays = tpch.gen_table("supplier", SF)
+        schema = schemas["supplier"]
+        p = str(tmp_path / "supplier.tbl")
+        csv_io.write_tbl(arrays, schema, p)
+        t = csv_io.read_tbl(p, "supplier", schema)
+        assert t.nrows == len(arrays["s_suppkey"])
+        assert np.array_equal(t.column("s_suppkey").values, arrays["s_suppkey"])
+        # decimal scale preserved exactly through text
+        assert np.array_equal(t.column("s_acctbal").values, arrays["s_acctbal"])
+        # strings decode back
+        assert list(t.column("s_name").decode()[:3]) == list(arrays["s_name"][:3])
+
+    def test_parquet_roundtrip(self, tmp_path, schemas):
+        arrays = tpch.gen_table("orders", SF, 4, 1)
+        schema = schemas["orders"]
+        ht = from_arrays("orders", schema, arrays)
+        p = str(tmp_path / "orders.parquet")
+        csv_io.write_parquet(ht, p)
+        back = csv_io.read_parquet(p, "orders", schema)
+        assert back.nrows == ht.nrows
+        assert np.array_equal(back.column("o_orderkey").values,
+                              ht.column("o_orderkey").values)
+        assert np.array_equal(back.column("o_totalprice").values,
+                              ht.column("o_totalprice").values)
+        assert np.array_equal(back.column("o_orderdate").values,
+                              ht.column("o_orderdate").values)
+        assert list(back.column("o_orderpriority").decode()[:5]) == \
+            list(ht.column("o_orderpriority").decode()[:5])
+
+    def test_string_codes_sorted(self, schemas):
+        arrays = tpch.gen_table("customer", SF, 8, 3)
+        ht = from_arrays("customer", schemas["customer"], arrays)
+        col = ht.column("c_mktsegment")
+        d = col.dictionary
+        assert all(d[i] <= d[i + 1] for i in range(len(d) - 1))
+        # code comparison == lexicographic comparison
+        decoded = col.decode()
+        order_by_code = np.argsort(col.values, kind="stable")
+        assert list(decoded[order_by_code]) == sorted(decoded)

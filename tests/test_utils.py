@@ -1,0 +1,123 @@
+import json
+import os
+
+import pytest
+
+from nds_tpu.utils import check
+from nds_tpu.utils.config import EngineConfig, load_properties
+from nds_tpu.utils.report import BenchReport, TaskFailureCollector, redact_env
+from nds_tpu.utils.timelog import TimeLog
+
+
+class TestCheck:
+    def test_valid_range(self):
+        assert check.valid_range("1,10", 10) == (1, 10)
+        assert check.valid_range("3,3", 5) == (3, 3)
+        with pytest.raises(check.CheckError):
+            check.valid_range("0,5", 10)
+        with pytest.raises(check.CheckError):
+            check.valid_range("5,3", 10)
+        with pytest.raises(check.CheckError):
+            check.valid_range("1,11", 10)
+        with pytest.raises(check.CheckError):
+            check.valid_range("junk", 10)
+
+    def test_parallel_value_type(self):
+        assert check.parallel_value_type("2") == 2
+        with pytest.raises(check.CheckError):
+            check.parallel_value_type("1")
+        with pytest.raises(check.CheckError):
+            check.parallel_value_type("x")
+
+    def test_json_summary_folder(self, tmp_path):
+        check.check_json_summary_folder(None)
+        check.check_json_summary_folder(str(tmp_path / "new"))  # absent ok
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        check.check_json_summary_folder(str(empty))
+        full = tmp_path / "full"
+        full.mkdir()
+        (full / "x.json").write_text("{}")
+        with pytest.raises(check.CheckError):
+            check.check_json_summary_folder(str(full))
+
+    def test_query_subset(self):
+        qd = {"query1": "...", "query2": "..."}
+        check.check_query_subset_exists(qd, ["query1"])
+        with pytest.raises(check.CheckError):
+            check.check_query_subset_exists(qd, ["query9"])
+
+
+class TestConfig:
+    def test_load_properties_env_subst(self, tmp_path, monkeypatch):
+        p = tmp_path / "t.properties"
+        p.write_text(
+            "# comment\n"
+            "engine.backend=${NDS_BACKEND:-tpu}\n"
+            "engine.mesh.shards=8\n")
+        conf = load_properties(str(p))
+        assert conf["engine.backend"] == "tpu"
+        monkeypatch.setenv("NDS_BACKEND", "cpu")
+        conf = load_properties(str(p))
+        assert conf["engine.backend"] == "cpu"
+
+    def test_precedence(self, tmp_path):
+        tpl = tmp_path / "a.template"
+        tpl.write_text("engine.floats=true\nengine.mesh.shards=4\n")
+        prop = tmp_path / "b.properties"
+        prop.write_text("engine.floats=false\n")
+        cfg = EngineConfig(str(tpl), str(prop), {"engine.mesh.shards": 2})
+        assert cfg.get_bool("engine.floats") is False
+        assert cfg.get_int("engine.mesh.shards") == 2
+        # defaults survive when unset
+        assert cfg.get_int("engine.concurrent_tasks") == 2
+
+
+class TestReport:
+    def test_redaction(self):
+        env = {"MY_TOKEN": "x", "API_SECRET": "y", "PASSWORD": "z",
+               "AWS_ACCESS_KEY_ID": "k", "HOME": "/root"}
+        red = redact_env(env)
+        assert red == {"HOME": "/root"}
+
+    def test_report_success_and_filename(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        r = BenchReport("query1")
+        summary = r.report_on(lambda x: x + 1, 41)
+        assert summary["queryStatus"] == ["Completed"]
+        assert summary["query"] == "query1"
+        assert len(summary["queryTimes"]) == 1
+        path = r.write_summary(prefix="pow")
+        assert path == f"pow-query1-{summary['startTime']}.json"
+        with open(path) as f:
+            loaded = json.load(f)
+        assert loaded["queryStatus"] == ["Completed"]
+        assert r.is_success()
+
+    def test_report_failure(self):
+        r = BenchReport("query2")
+        def boom():
+            raise RuntimeError("kaput")
+        s = r.report_on(boom)
+        assert s["queryStatus"] == ["Failed"]
+        assert "kaput" in s["exceptions"][0]
+        assert not r.is_success()
+
+    def test_task_failures(self):
+        r = BenchReport("query3")
+        def flaky():
+            TaskFailureCollector.notify("retry on padded overflow")
+        s = r.report_on(flaky)
+        assert s["queryStatus"] == ["CompletedWithTaskFailures"]
+        assert not r.is_success()
+
+
+class TestTimeLog:
+    def test_roundtrip(self, tmp_path):
+        tl = TimeLog("app-123")
+        tl.add("query1", 1500)
+        tl.add("query2", 2500)
+        p = str(tmp_path / "time.csv")
+        tl.write(p)
+        rows = TimeLog.read(p)
+        assert rows == [("app-123", "query1", 1500), ("app-123", "query2", 2500)]
