@@ -1,0 +1,707 @@
+"""CPU oracle executor: interprets logical plans with numpy/pandas.
+
+Role: the differential-validation ground truth. The reference's oracle is
+the same workload run on CPU Spark, compared row-by-row with epsilon
+(`nds/nds_validate.py:48-114`); here the oracle is an independent
+interpretation of the same logical plan — separate code path from the
+device engine (no jax, no static shapes, no dictionary tricks for
+evaluation: strings are materialized), so engine bugs don't cancel out.
+
+Decimals stay scaled int64 through +,-,* and comparisons (exact); division
+and AVG go through float64, matching the IR type policy.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pandas as pd
+
+from nds_tpu.engine.types import (
+    DateType, DecimalType, DType, FloatType, IntType, StringType,
+)
+from nds_tpu.io.host_table import HostTable
+from nds_tpu.sql import ir
+from nds_tpu.sql import plan as P
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+class Context:
+    """One relation's materialized columns keyed by (binding, name)."""
+
+    def __init__(self, nrows: int):
+        self.nrows = nrows
+        self.cols: dict[tuple, np.ndarray] = {}
+        self.valid: dict[tuple, np.ndarray | None] = {}
+
+    def put(self, key, arr, valid=None):
+        self.cols[key] = arr
+        self.valid[key] = valid
+
+    def take(self, idx: np.ndarray, matched: np.ndarray | None = None,
+             only_bindings: set | None = None) -> "Context":
+        out = Context(len(idx))
+        for k, v in self.cols.items():
+            if only_bindings is not None and k[0] not in only_bindings:
+                continue
+            arr = v[idx]
+            val = self.valid[k]
+            val = val[idx] if val is not None else None
+            if matched is not None:
+                val = matched if val is None else (val & matched)
+            out.put(k, arr, val)
+        return out
+
+    def merge(self, other: "Context") -> "Context":
+        assert self.nrows == other.nrows
+        out = Context(self.nrows)
+        out.cols.update(self.cols)
+        out.cols.update(other.cols)
+        out.valid.update(self.valid)
+        out.valid.update(other.valid)
+        return out
+
+    def mask(self, m: np.ndarray) -> "Context":
+        idx = np.nonzero(m)[0]
+        return self.take(idx)
+
+
+def _scale_of(t: DType) -> int:
+    return t.scale if isinstance(t, DecimalType) else 0
+
+
+def _to_float(arr: np.ndarray, t: DType) -> np.ndarray:
+    if isinstance(t, DecimalType):
+        return arr.astype(np.float64) / 10**t.scale
+    return arr.astype(np.float64)
+
+
+def _like_to_segments(pattern: str):
+    """'%a%b' -> (anchored_start, anchored_end, [segments])."""
+    segs = pattern.split("%")
+    return (not pattern.startswith("%"), not pattern.endswith("%"),
+            [s for s in segs if s])
+
+
+def like_mask(values: np.ndarray, pattern: str) -> np.ndarray:
+    """Vectorized SQL LIKE over a unicode array ('_' not needed by the
+    benchmark patterns; falls back to regex if present)."""
+    vals = values.astype(str)
+    if "_" in pattern:
+        rx = re.compile(
+            "^" + re.escape(pattern).replace("%", ".*").replace("_", ".")
+            + "$", re.DOTALL)
+        return np.array([bool(rx.match(v)) for v in vals])
+    start_anchor, end_anchor, segs = _like_to_segments(pattern)
+    u = np.asarray(vals, dtype=np.str_)
+    ok = np.ones(len(u), dtype=bool)
+    pos = np.zeros(len(u), dtype=np.int64)
+    for i, seg in enumerate(segs):
+        if i == 0 and start_anchor:
+            found = np.char.startswith(u, seg)
+            ok &= found
+            pos = np.where(found, len(seg), pos)
+        else:
+            idx = np.char.find(u, seg)
+            # search from current position
+            idx2 = np.array([v.find(seg, p) for v, p in zip(vals, pos)])
+            found = idx2 >= 0
+            ok &= found
+            pos = np.where(found, idx2 + len(seg), pos)
+    if segs and end_anchor:
+        last = segs[-1]
+        if len(segs) == 1 and start_anchor:
+            ok &= np.char.str_len(u) == len(last)  # exact match
+        else:
+            ok &= np.char.endswith(u, last)
+            # ensure the end match doesn't precede previous segments
+    return ok
+
+
+class CpuExecutor:
+    def __init__(self, tables: dict[str, HostTable]):
+        self.tables = tables
+        self._node_cache: dict[int, Context] = {}
+        self.scalars: dict[int, tuple] = {}  # id -> (value, dtype)
+
+    # ----------------------------------------------------------------- API
+
+    def execute(self, planned: P.PlannedQuery):
+        self._node_cache.clear()
+        self.scalars.clear()
+        for i, sub in enumerate(planned.scalar_subplans):
+            ctx = self.run(sub)
+            name, dt = sub.output[0]
+            arr = ctx.cols[(sub.binding, name)]
+            if len(arr) != 1:
+                raise ExecError(
+                    f"scalar subquery returned {len(arr)} rows")
+            valid = ctx.valid[(sub.binding, name)]
+            v = None if (valid is not None and not valid[0]) else arr[0]
+            self.scalars[i] = (v, dt)
+        ctx = self.run(planned.root)
+        return self._result(ctx, planned.root, planned.column_names)
+
+    def _result(self, ctx: Context, root: P.Node, names: list[str]):
+        b = root.binding
+        cols, dtypes = [], []
+        for name, dt in root.output:
+            arr = ctx.cols[(b, name)]
+            cols.append(arr)
+            dtypes.append(dt)
+        return ResultTable(names, cols, dtypes,
+                           [ctx.valid[(b, n)] for n, _ in root.output])
+
+    # --------------------------------------------------------------- nodes
+
+    def run(self, node: P.Node) -> Context:
+        nid = id(node)
+        if nid in self._node_cache:
+            return self._node_cache[nid]
+        method = getattr(self, "_run_" + type(node).__name__.lower())
+        ctx = method(node)
+        self._node_cache[nid] = ctx
+        return ctx
+
+    def _run_scan(self, node: P.Scan) -> Context:
+        t = self.tables[node.table]
+        ctx = Context(t.nrows)
+        for name, _dt in node.output:
+            col = t.columns[name]
+            arr = col.decode() if col.is_string else col.values
+            ctx.put((node.binding, name), np.asarray(arr), col.null_mask)
+        for pred in node.filters:
+            m, mv = self.eval(pred, ctx)
+            m = m.astype(bool)
+            if mv is not None:
+                m &= mv
+            ctx = ctx.mask(m)
+        return ctx
+
+    def _run_derivedscan(self, node: P.DerivedScan) -> Context:
+        child_ctx = self.run(node.child)
+        cb = node.child.binding
+        out = Context(child_ctx.nrows)
+        for name, _dt in node.child.output:
+            out.put((node.binding, name), child_ctx.cols[(cb, name)],
+                    child_ctx.valid[(cb, name)])
+        return out
+
+    def _run_filter(self, node: P.Filter) -> Context:
+        ctx = self.run(node.child)
+        m, mv = self.eval(node.predicate, ctx)
+        m = m.astype(bool)
+        if mv is not None:
+            m = m & mv
+        return ctx.mask(m)
+
+    def _run_project(self, node: P.Project) -> Context:
+        ctx = self.run(node.child)
+        out = Context(ctx.nrows)
+        for name, e in node.exprs:
+            arr, valid = self.eval(e, ctx)
+            if np.isscalar(arr) or arr.ndim == 0:
+                arr = np.full(ctx.nrows, arr)
+            out.put((node.binding, name), arr, valid)
+        return out
+
+    def _key_frame(self, ctx: Context, keys: list[ir.IR]) -> pd.DataFrame:
+        data = {}
+        for i, k in enumerate(keys):
+            arr, valid = self.eval(k, ctx)
+            if isinstance(arr.dtype, object.__class__) or arr.dtype == object:
+                arr = arr.astype(str)
+            data[f"k{i}"] = arr
+        return pd.DataFrame(data)
+
+    def _run_join(self, node: P.Join) -> Context:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        if not node.left_keys:  # cross join
+            li = np.repeat(np.arange(lctx.nrows), rctx.nrows)
+            ri = np.tile(np.arange(rctx.nrows), lctx.nrows)
+            out = lctx.take(li).merge(rctx.take(ri))
+            return out
+        lk = self._key_frame(lctx, node.left_keys)
+        rk = self._key_frame(rctx, node.right_keys)
+        lk["_li"] = np.arange(lctx.nrows)
+        rk["_ri"] = np.arange(rctx.nrows)
+        how = "left" if node.kind == "left" else "inner"
+        m = lk.merge(rk, on=[f"k{i}" for i in range(len(node.left_keys))],
+                     how=how)
+        li = m["_li"].to_numpy()
+        if node.kind == "left":
+            matched = m["_ri"].notna().to_numpy()
+            ri = np.where(matched, m["_ri"].fillna(0).to_numpy(), 0).astype(
+                np.int64)
+            out = lctx.take(li).merge(rctx.take(ri, matched=matched))
+            if node.residual is not None:
+                rm, rmv = self.eval(node.residual, out)
+                rm = rm.astype(bool)
+                if rmv is not None:
+                    rm &= rmv
+                keep_match = matched & rm
+                # left join: keep every left row; null out right side where
+                # the residual fails, then dedupe to one row per unmatched li
+                unmatched_li = np.setdiff1d(li, li[keep_match])
+                lidx = np.concatenate([li[keep_match], unmatched_li])
+                ridx = np.concatenate(
+                    [ri[keep_match], np.zeros(len(unmatched_li), np.int64)])
+                mflag = np.concatenate(
+                    [np.ones(keep_match.sum(), bool),
+                     np.zeros(len(unmatched_li), bool)])
+                out = lctx.take(lidx).merge(rctx.take(ridx, matched=mflag))
+            return out
+        ri = m["_ri"].to_numpy().astype(np.int64)
+        out = lctx.take(li).merge(rctx.take(ri))
+        if node.residual is not None:
+            rm, rmv = self.eval(node.residual, out)
+            rm = rm.astype(bool)
+            if rmv is not None:
+                rm &= rmv
+            out = out.mask(rm)
+        return out
+
+    def _run_semijoin(self, node: P.SemiJoin) -> Context:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        if node.left_keys:
+            lk = self._key_frame(lctx, node.left_keys)
+            rk = self._key_frame(rctx, node.right_keys)
+            lk["_li"] = np.arange(lctx.nrows)
+            rk["_ri"] = np.arange(rctx.nrows)
+            m = lk.merge(rk, on=[f"k{i}" for i in range(len(node.left_keys))],
+                         how="inner")
+            li = m["_li"].to_numpy()
+            ri = m["_ri"].to_numpy()
+        else:
+            li = np.repeat(np.arange(lctx.nrows), rctx.nrows)
+            ri = np.tile(np.arange(rctx.nrows), lctx.nrows)
+        if node.residual is not None:
+            combined = lctx.take(li).merge(rctx.take(ri))
+            rm, rmv = self.eval(node.residual, combined)
+            rm = rm.astype(bool)
+            if rmv is not None:
+                rm &= rmv
+            li = li[rm]
+        exists = np.zeros(lctx.nrows, dtype=bool)
+        exists[li] = True
+        return lctx.mask(~exists if node.anti else exists)
+
+    def _run_aggregate(self, node: P.Aggregate) -> Context:
+        ctx = self.run(node.child)
+        b = node.binding
+        n_keys = len(node.group_keys)
+        if n_keys == 0:
+            out = Context(1)
+            for name, spec in node.aggs:
+                v = self._agg_all(spec, ctx)
+                if v is None:  # SQL: aggregate over empty input is NULL
+                    out.put((b, name), np.zeros(1, dtype=np.int64),
+                            np.array([False]))
+                else:
+                    out.put((b, name), np.array([v]))
+            return out
+        keyframes = {}
+        for i, (kname, kexpr) in enumerate(node.group_keys):
+            arr, _ = self.eval(kexpr, ctx)
+            keyframes[f"k{i}"] = arr if arr.dtype != object else arr.astype(str)
+        df = pd.DataFrame(keyframes)
+        codes, uniques = pd.factorize(
+            pd.MultiIndex.from_frame(df) if n_keys > 1 else df["k0"],
+            sort=False)
+        ngroups = len(uniques)
+        out = Context(ngroups)
+        # representative (first-occurrence) row per group for key values
+        rev = np.arange(len(codes))[::-1]
+        first = np.full(ngroups, -1, dtype=np.int64)
+        first[codes[rev]] = rev
+        for i, (kname, kexpr) in enumerate(node.group_keys):
+            arr, _ = self.eval(kexpr, ctx)
+            out.put((b, kname), arr[first])
+        for name, spec in node.aggs:
+            out.put((b, name), self._agg_grouped(spec, ctx, codes, ngroups))
+        return out
+
+    def _agg_input(self, spec: P.AggSpec, ctx: Context):
+        if spec.arg is None:
+            return None, None
+        return self.eval(spec.arg, ctx)
+
+    def _agg_all(self, spec: P.AggSpec, ctx: Context):
+        arr, valid = self._agg_input(spec, ctx)
+        if spec.func == "count":
+            if arr is None:
+                return ctx.nrows
+            n = ctx.nrows if valid is None else int(valid.sum())
+            if spec.distinct:
+                a = arr if valid is None else arr[valid]
+                return len(pd.unique(a))
+            return n
+        if valid is not None:
+            arr = arr[valid]
+        if len(arr) == 0:
+            return None  # SQL NULL
+        if spec.func == "sum":
+            return arr.sum()
+        if spec.func == "min":
+            return arr.min()
+        if spec.func == "max":
+            return arr.max()
+        if spec.func == "avg":
+            return _to_float(arr, spec.arg.dtype).mean()
+        raise ExecError(spec.func)
+
+    def _agg_grouped(self, spec: P.AggSpec, ctx: Context,
+                     codes: np.ndarray, ngroups: int) -> np.ndarray:
+        arr, valid = self._agg_input(spec, ctx)
+        if spec.func == "count":
+            if spec.distinct:
+                df = pd.DataFrame({"g": codes, "v": arr.astype(str)
+                                   if arr.dtype == object else arr})
+                if valid is not None:
+                    df = df[valid]
+                s = df.groupby("g")["v"].nunique()
+                out = np.zeros(ngroups, dtype=np.int64)
+                out[s.index.to_numpy()] = s.to_numpy()
+                return out
+            if arr is None:
+                return np.bincount(codes, minlength=ngroups).astype(np.int64)
+            m = valid if valid is not None else np.ones(len(arr), bool)
+            return np.bincount(codes[m], minlength=ngroups).astype(np.int64)
+        m = valid if valid is not None else None
+        vals = arr if m is None else arr[m]
+        gcodes = codes if m is None else codes[m]
+        if spec.func == "sum":
+            if isinstance(spec.dtype, FloatType):
+                return np.bincount(gcodes, weights=vals.astype(np.float64),
+                                   minlength=ngroups)
+            # integer/decimal sums accumulate in int64 — exact (the decimal
+            # policy this oracle exists to enforce; bincount would round
+            # through float64 past 2^53)
+            out = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(out, gcodes, vals.astype(np.int64))
+            return out
+        if spec.func == "avg":
+            f = _to_float(vals, spec.arg.dtype)
+            s = np.bincount(gcodes, weights=f, minlength=ngroups)
+            c = np.bincount(gcodes, minlength=ngroups)
+            with np.errstate(invalid="ignore"):
+                return s / np.maximum(c, 1)
+        if spec.func in ("min", "max"):
+            df = pd.DataFrame({"g": gcodes, "v": vals})
+            s = df.groupby("g")["v"].min() if spec.func == "min" \
+                else df.groupby("g")["v"].max()
+            out = np.zeros(ngroups, dtype=vals.dtype)
+            out[s.index.to_numpy()] = s.to_numpy()
+            return out
+        raise ExecError(spec.func)
+
+    def _run_sort(self, node: P.Sort) -> Context:
+        ctx = self.run(node.child)
+        idx = np.arange(ctx.nrows)
+        # stable sort from last key to first
+        for e, asc, _nf in reversed(node.keys):
+            arr, _ = self.eval(e, ctx)
+            arr = arr[idx]
+            if arr.dtype == object:
+                arr = arr.astype(str)
+            key = arr if asc else _rank_desc(arr)
+            idx = idx[np.argsort(key, kind="stable")]
+        return ctx.take(idx)
+
+    def _run_limit(self, node: P.Limit) -> Context:
+        ctx = self.run(node.child)
+        return ctx.take(np.arange(min(node.count, ctx.nrows)))
+
+    def _run_distinct(self, node: P.Distinct) -> Context:
+        ctx = self.run(node.child)
+        b = node.binding
+        df = pd.DataFrame({
+            n: (ctx.cols[(b, n)].astype(str)
+                if ctx.cols[(b, n)].dtype == object else ctx.cols[(b, n)])
+            for n, _ in node.output})
+        keep = ~df.duplicated().to_numpy()
+        return ctx.mask(keep)
+
+    def _setop_frame(self, ctx: Context, node: P.Node) -> pd.DataFrame:
+        b = node.binding
+        data = {}
+        for i, (name, _) in enumerate(node.output):
+            arr = ctx.cols[(b, name)]
+            v = ctx.valid[(b, name)]
+            col = pd.Series(arr.astype(str) if arr.dtype == object else arr)
+            if v is not None:
+                col = col.mask(~v)
+            data[f"c{i}"] = col
+        return pd.DataFrame(data)
+
+    def _run_setop(self, node: P.SetOp) -> Context:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        lb = node.left.binding
+        if node.kind.startswith("union"):
+            out = Context(lctx.nrows + rctx.nrows)
+            rb = node.right.binding
+            for (lname, _), (rname, _) in zip(node.left.output,
+                                              node.right.output):
+                a = np.concatenate([lctx.cols[(lb, lname)],
+                                    rctx.cols[(rb, rname)]])
+                lv = lctx.valid[(lb, lname)]
+                rv = rctx.valid[(rb, rname)]
+                if lv is not None or rv is not None:
+                    lv = lv if lv is not None else np.ones(lctx.nrows, bool)
+                    rv = rv if rv is not None else np.ones(rctx.nrows, bool)
+                    out.put((lb, lname), a, np.concatenate([lv, rv]))
+                else:
+                    out.put((lb, lname), a)
+            return out
+        # intersect / except: row-set membership against the right side
+        ldf = self._setop_frame(lctx, node.left)
+        rdf = self._setop_frame(rctx, node.right)
+        rkeys = set(map(tuple, rdf.itertuples(index=False, name=None)))
+        in_right = np.array(
+            [tuple(row) in rkeys
+             for row in ldf.itertuples(index=False, name=None)])
+        keep = in_right if node.kind == "intersect" else ~in_right
+        return lctx.mask(keep)
+
+    # ---------------------------------------------------------- expressions
+
+    def eval(self, e: ir.IR, ctx: Context):
+        """-> (ndarray, valid_mask|None)"""
+        if isinstance(e, ir.ColRef):
+            return ctx.cols[(e.binding, e.name)], ctx.valid.get(
+                (e.binding, e.name))
+        if isinstance(e, ir.Lit):
+            return np.full(ctx.nrows, e.value), None
+        if isinstance(e, ir.ScalarRef):
+            v, _ = self.scalars[e.plan_id]
+            if v is None:  # NULL scalar: every comparison fails
+                return (np.zeros(ctx.nrows, dtype=np.int64),
+                        np.zeros(ctx.nrows, dtype=bool))
+            return np.full(ctx.nrows, v), None
+        if isinstance(e, ir.Arith):
+            return self._eval_arith(e, ctx)
+        if isinstance(e, ir.Cmp):
+            return self._eval_cmp(e, ctx)
+        if isinstance(e, ir.BoolOp):
+            arrs = [self.eval(a, ctx) for a in e.args]
+            out = arrs[0][0].astype(bool)
+            valid = arrs[0][1]
+            for a, v in arrs[1:]:
+                if e.op == "and":
+                    out = out & a.astype(bool)
+                else:
+                    out = out | a.astype(bool)
+                valid = _and_valid(valid, v)
+            return out, valid
+        if isinstance(e, ir.Not):
+            a, v = self.eval(e.operand, ctx)
+            return ~a.astype(bool), v
+        if isinstance(e, ir.Neg):
+            a, v = self.eval(e.operand, ctx)
+            return -a, v
+        if isinstance(e, ir.CaseIR):
+            conds, vals = [], []
+            for c, v in e.whens:
+                ca, cv = self.eval(c, ctx)
+                va, _vv = self.eval(v, ctx)
+                conds.append(ca.astype(bool) if cv is None
+                             else (ca.astype(bool) & cv))
+                vals.append(self._coerce(va, v.dtype, e.dtype))
+            if e.else_ is not None:
+                ea, _ev = self.eval(e.else_, ctx)
+                default = self._coerce(ea, e.else_.dtype, e.dtype)
+                valid = None
+            else:
+                # CASE with no ELSE: rows matching no branch are NULL
+                if isinstance(e.dtype, FloatType):
+                    default = np.zeros(ctx.nrows, dtype=np.float64)
+                elif isinstance(e.dtype, StringType):
+                    default = np.full(ctx.nrows, "", dtype=object)
+                else:
+                    default = np.zeros(ctx.nrows, dtype=np.int64)
+                valid = np.logical_or.reduce(conds)
+            return np.select(conds, vals, default=default), valid
+        if isinstance(e, ir.LikeIR):
+            a, v = self.eval(e.operand, ctx)
+            m = like_mask(a, e.pattern)
+            return (~m if e.negated else m), v
+        if isinstance(e, ir.InListIR):
+            a, v = self.eval(e.operand, ctx)
+            vals = e.values
+            if isinstance(e.operand.dtype, DecimalType):
+                s = e.operand.dtype.scale
+                vals = [int(round(float(x) * 10**s)) for x in vals]
+            if a.dtype == object:
+                a = a.astype(str)
+                vals = [str(x) for x in vals]
+            m = np.isin(a, np.array(vals))
+            return (~m if e.negated else m), v
+        if isinstance(e, ir.IsNullIR):
+            a, v = self.eval(e.operand, ctx)
+            isnull = (np.zeros(len(a), bool) if v is None else ~v)
+            return (~isnull if e.negated else isnull), None
+        if isinstance(e, ir.ExtractIR):
+            a, v = self.eval(e.operand, ctx)
+            d = (np.datetime64("1970-01-01", "D")
+                 + a.astype(np.int64)).astype("datetime64[D]")
+            if e.part == "year":
+                out = d.astype("datetime64[Y]").astype(np.int64) + 1970
+            elif e.part == "month":
+                out = (d.astype("datetime64[M]").astype(np.int64) % 12) + 1
+            elif e.part == "day":
+                out = (d - d.astype("datetime64[M]")).astype(np.int64) + 1
+            else:
+                raise ExecError(f"extract {e.part}")
+            return out.astype(np.int32), v
+        if isinstance(e, ir.SubstrIR):
+            a, v = self.eval(e.operand, ctx)
+            sa = a.astype(str)
+            if e.start == 1 and e.length is not None:
+                return sa.astype(f"<U{e.length}").astype(object), v
+            lo = e.start - 1
+            hi = None if e.length is None else lo + e.length
+            return np.array([s[lo:hi] for s in sa], dtype=object), v
+        if isinstance(e, ir.CastIR):
+            a, v = self.eval(e.operand, ctx)
+            src = e.operand.dtype
+            if isinstance(e.dtype, FloatType):
+                return _to_float(a, src), v
+            if isinstance(e.dtype, IntType):
+                if isinstance(src, DecimalType):
+                    return (a // 10**src.scale).astype(np.int64), v
+                return a.astype(np.int64), v
+            if isinstance(e.dtype, StringType):
+                return a.astype(str).astype(object), v
+            if isinstance(e.dtype, DecimalType):
+                s = e.dtype.scale
+                if isinstance(src, DecimalType):
+                    return _rescale(a, src.scale, s), v
+                if isinstance(src, IntType):
+                    return a.astype(np.int64) * 10**s, v
+                return np.round(a * 10**s).astype(np.int64), v
+            raise ExecError(f"cast to {e.dtype}")
+        raise ExecError(f"cannot eval {e!r}")
+
+    def _coerce(self, arr, src: DType, dst: DType):
+        if repr(src) == repr(dst):
+            return arr
+        if isinstance(dst, FloatType):
+            return _to_float(arr, src)
+        if isinstance(dst, DecimalType):
+            ss = _scale_of(src)
+            return _rescale(np.asarray(arr, dtype=np.int64), ss, dst.scale)
+        return arr
+
+    def _eval_arith(self, e: ir.Arith, ctx: Context):
+        l, lv = self.eval(e.left, ctx)
+        r, rv = self.eval(e.right, ctx)
+        valid = _and_valid(lv, rv)
+        lt, rt = e.left.dtype, e.right.dtype
+        if isinstance(e.dtype, DateType):
+            return l + r, valid
+        if e.op == "/":
+            return _to_float(l, lt) / _to_float(r, rt), valid
+        if isinstance(e.dtype, FloatType):
+            return _apply(e.op, _to_float(l, lt), _to_float(r, rt)), valid
+        if isinstance(e.dtype, DecimalType):
+            if e.op == "*":
+                return l.astype(np.int64) * r.astype(np.int64), valid
+            s = e.dtype.scale
+            return _apply(e.op, _rescale(l, _scale_of(lt), s),
+                          _rescale(r, _scale_of(rt), s)), valid
+        return _apply(e.op, l, r), valid
+
+    def _eval_cmp(self, e: ir.Cmp, ctx: Context):
+        l, lv = self.eval(e.left, ctx)
+        r, rv = self.eval(e.right, ctx)
+        valid = _and_valid(lv, rv)
+        lt, rt = e.left.dtype, e.right.dtype
+        if isinstance(lt, StringType) or isinstance(rt, StringType):
+            l = l.astype(str)
+            r = r.astype(str)
+        elif isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            s = max(_scale_of(lt), _scale_of(rt))
+            if isinstance(lt, FloatType) or isinstance(rt, FloatType):
+                l, r = _to_float(l, lt), _to_float(r, rt)
+            else:
+                l = _rescale(np.asarray(l, np.int64), _scale_of(lt), s)
+                r = _rescale(np.asarray(r, np.int64), _scale_of(rt), s)
+        op = {"=": np.equal, "<>": np.not_equal, "<": np.less,
+              "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal}
+        return op[e.op](l, r), valid
+
+
+def _apply(op, l, r):
+    if op == "+":
+        return l + r
+    if op == "-":
+        return l - r
+    if op == "*":
+        return l * r
+    if op == "%":
+        return l % r
+    raise ExecError(op)
+
+
+def _rescale(arr: np.ndarray, from_s: int, to_s: int) -> np.ndarray:
+    if from_s == to_s:
+        return arr
+    if to_s > from_s:
+        return arr * 10**(to_s - from_s)
+    return arr // 10**(from_s - to_s)
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _rank_desc(arr: np.ndarray) -> np.ndarray:
+    """Key transform for stable descending sort."""
+    if arr.dtype.kind in "iuf":
+        return -arr
+    # strings: rank by sorted-unique position, negated
+    uniq, inv = np.unique(arr, return_inverse=True)
+    return -inv
+
+
+class ResultTable:
+    """Final query output: named columns with dtypes; decimals stay scaled
+    until formatted."""
+
+    def __init__(self, names, cols, dtypes, valids=None):
+        self.names = names
+        self.cols = cols
+        self.dtypes = dtypes
+        self.valids = valids or [None] * len(cols)
+
+    @property
+    def nrows(self):
+        return len(self.cols[0]) if self.cols else 0
+
+    def to_pandas(self) -> pd.DataFrame:
+        data = {}
+        for name, arr, dt, valid in zip(self.names, self.cols, self.dtypes,
+                                        self.valids):
+            if isinstance(dt, DecimalType):
+                a = arr.astype(np.float64) / 10**dt.scale
+            elif isinstance(dt, DateType):
+                a = (np.datetime64("1970-01-01", "D")
+                     + arr.astype(np.int64)).astype("datetime64[D]")
+            else:
+                a = arr
+            if valid is not None:
+                a = pd.array(a)
+                a[~valid] = None
+            data[name] = a
+        df = pd.DataFrame(data)
+        # duplicate names possible; keep positional
+        df.columns = self.names
+        return df

@@ -1,0 +1,59 @@
+"""Query session: the engine-side analog of a SparkSession.
+
+Holds the table registry (reference: temp views created per table,
+`nds/nds_power.py:79-106`), session views (q15), parses + plans + executes
+SQL, and exposes the executor backend choice (CPU oracle vs device
+engine) the way templates choose cpu/gpu in the reference.
+"""
+
+from __future__ import annotations
+
+from nds_tpu.engine.cpu_exec import CpuExecutor, ResultTable
+from nds_tpu.io.host_table import HostTable
+from nds_tpu.sql import plan as P
+from nds_tpu.sql.parser import parse
+from nds_tpu.sql.planner import CatalogInfo, Planner
+
+# relative size weights for greedy join ordering (TPC-H row ratios)
+TPCH_SIZES = {
+    "lineitem": 6_000_000, "orders": 1_500_000, "partsupp": 800_000,
+    "part": 200_000, "customer": 150_000, "supplier": 10_000,
+    "nation": 25, "region": 5,
+}
+
+
+class Session:
+    def __init__(self, catalog: CatalogInfo, executor_factory=None):
+        self.catalog = catalog
+        self.tables: dict[str, HostTable] = {}
+        self.views: dict[str, P.Node] = {}
+        self._executor_factory = executor_factory or (
+            lambda tables: CpuExecutor(tables))
+
+    @classmethod
+    def for_nds_h(cls, executor_factory=None) -> "Session":
+        from nds_tpu.nds_h.schema import PRIMARY_KEYS, get_schemas
+        cat = CatalogInfo(get_schemas(), PRIMARY_KEYS, dict(TPCH_SIZES))
+        return cls(cat, executor_factory)
+
+    def register_table(self, table: HostTable) -> None:
+        self.tables[table.name] = table
+
+    def plan(self, sql_text: str):
+        planner = Planner(self.catalog, self.views)
+        return planner.plan_statement(parse(sql_text))
+
+    def sql(self, sql_text: str) -> ResultTable | None:
+        planned = self.plan(sql_text)
+        if isinstance(planned, tuple):
+            action, name, node = planned
+            if action == "create_view":
+                if name in self.views:
+                    raise ValueError(f"view {name!r} already exists")
+                self.views[name] = node
+                return None
+            if action == "drop_view":
+                self.views.pop(name, None)
+                return None
+        executor = self._executor_factory(self.tables)
+        return executor.execute(planned)

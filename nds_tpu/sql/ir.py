@@ -1,0 +1,213 @@
+"""Typed expression IR — what the executors actually evaluate.
+
+The planner lowers parsed AST expressions into this IR with dtypes
+resolved. Design choices are TPU-driven (SURVEY.md §7 "hard parts" #3):
+
+- DECIMAL stays scaled int64 through +,-,* (exact, integer ALU path);
+  division and AVG convert to float64 — TPC validation is epsilon-based
+  (`nds/nds_validate.py:194-215`), so float division is within contract.
+- Dates are epoch-day int32; EXTRACT lowers to integer civil-date math.
+- String predicates never touch string data at run time: the planner binds
+  them against the column dictionary (LIKE/substring/IN evaluate on the
+  host dictionary once, producing code sets), so devices compare int32
+  codes only. That binding happens in the engine layer; here LIKE et al.
+  remain symbolic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from nds_tpu.engine.types import (
+    BOOL, DATE, DType, FLOAT64, INT32, INT64, DecimalType, IntType,
+    FloatType, StringType, DateType, BoolType,
+)
+
+
+class IR:
+    dtype: DType
+
+
+@dataclass
+class ColRef(IR):
+    binding: str
+    name: str
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"{self.binding}.{self.name}"
+
+
+@dataclass
+class Lit(IR):
+    value: object      # python int (scaled for decimals) | str | None | bool
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"lit({self.value}:{self.dtype})"
+
+
+@dataclass
+class Arith(IR):
+    op: str            # + - * / %
+    left: IR
+    right: IR
+    dtype: DType = None
+
+
+@dataclass
+class Cmp(IR):
+    op: str            # = <> < <= > >=
+    left: IR
+    right: IR
+    dtype: DType = BOOL
+
+
+@dataclass
+class BoolOp(IR):
+    op: str            # and | or
+    args: list[IR] = field(default_factory=list)
+    dtype: DType = BOOL
+
+
+@dataclass
+class Not(IR):
+    operand: IR
+    dtype: DType = BOOL
+
+
+@dataclass
+class Neg(IR):
+    operand: IR
+    dtype: DType = None
+
+
+@dataclass
+class CaseIR(IR):
+    whens: list[tuple[IR, IR]] = field(default_factory=list)
+    else_: Optional[IR] = None
+    dtype: DType = None
+
+
+@dataclass
+class LikeIR(IR):
+    operand: IR
+    pattern: str
+    negated: bool = False
+    dtype: DType = BOOL
+
+
+@dataclass
+class InListIR(IR):
+    operand: IR
+    values: list[object] = field(default_factory=list)  # python values
+    negated: bool = False
+    dtype: DType = BOOL
+
+
+@dataclass
+class IsNullIR(IR):
+    operand: IR
+    negated: bool = False
+    dtype: DType = BOOL
+
+
+@dataclass
+class ExtractIR(IR):
+    part: str
+    operand: IR
+    dtype: DType = INT32
+
+
+@dataclass
+class SubstrIR(IR):
+    operand: IR
+    start: int
+    length: Optional[int]
+    dtype: DType = None
+
+
+@dataclass
+class CastIR(IR):
+    operand: IR
+    dtype: DType = None
+
+
+@dataclass
+class AggRef(IR):
+    """Reference to aggregate #index of the enclosing Aggregate node."""
+    index: int
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"agg#{self.index}"
+
+
+@dataclass
+class ScalarRef(IR):
+    """Result of an uncorrelated scalar subquery, planned separately and
+    bound at execution time (plan_id indexes LogicalPlan.scalar_subplans)."""
+    plan_id: int
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"scalar#{self.plan_id}"
+
+
+def is_decimal(t: DType) -> bool:
+    return isinstance(t, DecimalType)
+
+
+def common_scale(a: DType, b: DType) -> int:
+    sa = a.scale if is_decimal(a) else 0
+    sb = b.scale if is_decimal(b) else 0
+    return max(sa, sb)
+
+
+def arith_type(op: str, lt: DType, rt: DType) -> DType:
+    """Result dtype of an arithmetic op, per the decimal policy above."""
+    if isinstance(lt, DateType) or isinstance(rt, DateType):
+        return DATE  # date +/- days
+    if op == "/":
+        return FLOAT64
+    if isinstance(lt, FloatType) or isinstance(rt, FloatType):
+        return FLOAT64
+    if is_decimal(lt) or is_decimal(rt):
+        if op == "*":
+            return DecimalType(38, (lt.scale if is_decimal(lt) else 0)
+                               + (rt.scale if is_decimal(rt) else 0))
+        return DecimalType(38, common_scale(lt, rt))
+    if isinstance(lt, IntType) and isinstance(rt, IntType):
+        return INT64 if max(lt.bits, rt.bits) > 32 else INT32
+    raise TypeError(f"cannot apply {op} to {lt} and {rt}")
+
+
+def agg_type(func: str, arg_t: DType | None) -> DType:
+    if func == "count":
+        return INT64
+    if func == "avg":
+        return FLOAT64
+    if func in ("sum", "min", "max"):
+        if arg_t is None:
+            raise TypeError(f"{func} requires an argument type")
+        if isinstance(arg_t, IntType):
+            return INT64 if func == "sum" else arg_t
+        return arg_t
+    raise TypeError(f"unknown aggregate {func}")
+
+
+def walk(e: IR):
+    """Yield e and all IR descendants."""
+    yield e
+    for f in vars(e).values():
+        if isinstance(f, IR):
+            yield from walk(f)
+        elif isinstance(f, list):
+            for x in f:
+                if isinstance(x, IR):
+                    yield from walk(x)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, IR):
+                            yield from walk(y)

