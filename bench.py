@@ -1,0 +1,85 @@
+"""Round benchmark: NDS-H power run, TPU engine vs CPU oracle.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology follows the reference power run (bracketed wall-clock around
+execute+collect per query, `nds/PysparkBenchReport.py:87-105`): the 22
+qualification queries run on the JAX device engine (real TPU chip when
+available) after one untimed warmup pass (steady-state compile cache, the
+reference's warmed-JVM analog), and the same stream runs on the CPU
+oracle as the baseline — the reference publishes no numbers
+(BASELINE.md), so CPU wall-clock is the denominator.
+
+value = device power-run total seconds; vs_baseline = cpu_total /
+device_total (>1 means the TPU engine beats the CPU baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SF = float(os.environ.get("BENCH_SF", "0.1"))
+DATA_DIR = os.environ.get("BENCH_DATA", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_data",
+    f"sf{SF:g}"))
+
+
+def _gen_data():
+    from nds_tpu.datagen import tpch
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds_h.schema import get_schemas
+    schemas = get_schemas()
+    return {t: from_arrays(t, schemas[t], tpch.gen_table(t, SF))
+            for t in schemas}
+
+
+def _power_run(session, timed: bool = True, warmup: int = 1):
+    from nds_tpu.nds_h import streams
+    times = {}
+    for qn in range(1, 23):
+        sql = streams.render_query(qn)
+        stmts = ([s for s in sql.split(";") if s.strip()]
+                 if qn == 15 else [sql])
+        for _ in range(warmup):
+            for s in stmts:
+                session.sql(s)
+        t0 = time.perf_counter()
+        for s in stmts:
+            session.sql(s)
+        times[qn] = time.perf_counter() - t0
+    return times
+
+
+def main() -> None:
+    from nds_tpu.engine.device_exec import make_device_factory
+    from nds_tpu.engine.session import Session
+
+    tables = _gen_data()
+
+    dev = Session.for_nds_h(make_device_factory())
+    for t in tables.values():
+        dev.register_table(t)
+    # q15 creates/drops a view per pass; warmup handled inside _power_run
+    dev_times = _power_run(dev, warmup=1)
+    dev_total = sum(dev_times.values())
+
+    cpu = Session.for_nds_h()
+    for t in tables.values():
+        cpu.register_table(t)
+    cpu_times = _power_run(cpu, warmup=0)
+    cpu_total = sum(cpu_times.values())
+
+    result = {
+        "metric": f"nds_h_sf{SF:g}_power_total",
+        "value": round(dev_total, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_total / dev_total, 4) if dev_total else 0.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

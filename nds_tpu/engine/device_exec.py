@@ -1,0 +1,1268 @@
+"""TPU device executor: one logical plan -> ONE static-shape XLA program.
+
+This is the engine half the reference delegates to Spark + spark-rapids
+(`nds/power_run_gpu.template:35` enables the plugin; all GPU execution is
+external to the reference repo). Here the execution layer is ours and is
+designed TPU-first (SURVEY.md §7):
+
+- **Masked fixed-capacity dataflow.** XLA wants static shapes, SQL produces
+  data-dependent cardinalities. Resolution: every relation is a set of
+  fixed-capacity device arrays plus a boolean presence mask. Filters AND
+  the mask instead of compacting; every operator's output capacity is a
+  *compile-time* function of its inputs' capacities, so the entire query
+  traces into a single jit-compiled XLA program — no host round-trips, no
+  recompiles within a scale factor.
+- **Joins are gather joins.** Every equi-join in the TPC workloads has a
+  side that is unique on the join keys (star schema). The unique side is
+  sorted once (`lax.sort`), probes are `searchsorted` + gather — O(n log n)
+  vectorized, no dynamic hash tables. Multi-column keys are bit-packed into
+  one int64 using value bounds computed on the host at trace time.
+- **Grouping is sort-based.** Rows sort by (presence, keys...) via a
+  stable multi-operand `lax.sort`; group boundaries come from adjacent-row
+  comparison; aggregates are `segment_sum/min/max` with
+  `indices_are_sorted=True`. Output capacity = input capacity; the unused
+  tail is masked.
+- **Strings never reach the device.** Columns are dictionary-encoded
+  (sorted dictionary => code order == lexicographic order,
+  `nds_tpu/io/host_table.py`); LIKE / IN / comparisons against literals are
+  evaluated once on the host dictionary producing boolean lookup tables the
+  device gathers through. Cross-column string ops go through a union
+  dictionary remap.
+- **Decimals are scaled int64** end to end (+,-,*,compare exact; division
+  and AVG via float64), mirroring the reference's use_decimal=True path
+  (`nds/nds_schema.py:43-47`) with the `--floats` epsilon mode as the
+  alternative.
+
+The differential oracle for all of this is `cpu_exec.CpuExecutor`
+(reference analog: CPU Spark as ground truth, `nds/nds_validate.py:48-114`).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# the deployment sitecustomize may pin jax to a remote TPU plugin
+# regardless of JAX_PLATFORMS; NDS_TPU_PLATFORM wins when set (used by
+# CLI drivers and CI to run the engine on the local cpu backend)
+if os.environ.get("NDS_TPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["NDS_TPU_PLATFORM"])
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from nds_tpu.engine.cpu_exec import ResultTable, like_mask  # noqa: E402
+from nds_tpu.engine.types import (  # noqa: E402
+    BoolType, DateType, DecimalType, DType, FloatType, IntType, StringType,
+)
+from nds_tpu.io.host_table import HostTable  # noqa: E402
+from nds_tpu.sql import ir  # noqa: E402
+from nds_tpu.sql import plan as P  # noqa: E402
+
+I64_MAX = np.iinfo(np.int64).max
+I64_MIN = np.iinfo(np.int64).min
+
+
+class DeviceExecError(RuntimeError):
+    pass
+
+
+class DVal:
+    """One evaluated column on device: array + optional validity, plus
+    host-side metadata (string dictionary; integer value bounds used for
+    join-key bit packing)."""
+
+    __slots__ = ("arr", "valid", "sdict", "lo", "hi")
+
+    def __init__(self, arr, valid=None, sdict=None, lo=None, hi=None):
+        self.arr = arr
+        self.valid = valid
+        self.sdict = sdict
+        self.lo = lo
+        self.hi = hi
+
+    def with_arrays(self, arr, valid):
+        return DVal(arr, valid, self.sdict, self.lo, self.hi)
+
+
+class DCtx:
+    """One relation during trace: capacity (static), presence mask (traced),
+    and columns keyed by (binding, name)."""
+
+    def __init__(self, n: int, row):
+        self.n = n
+        self.row = row
+        self.cols: dict[tuple, DVal] = {}
+
+    def gather(self, idx, clear_valid=None) -> "DCtx":
+        """New ctx with every column gathered at idx (same capacity as idx).
+        clear_valid, if given, is ANDed into every column's validity
+        (used to null out the build side of outer joins)."""
+        out = DCtx(idx.shape[0], None)
+        for k, dv in self.cols.items():
+            arr = jnp.take(dv.arr, idx, axis=0)
+            valid = None if dv.valid is None else jnp.take(dv.valid, idx)
+            if clear_valid is not None:
+                valid = clear_valid if valid is None else (valid & clear_valid)
+            out.cols[k] = dv.with_arrays(arr, valid)
+        return out
+
+    def merge(self, other: "DCtx") -> "DCtx":
+        assert self.n == other.n
+        out = DCtx(self.n, self.row)
+        out.cols.update(self.cols)
+        out.cols.update(other.cols)
+        return out
+
+
+def _ok(dv: DVal, row):
+    """Row-presence AND value-validity for a column."""
+    return row if dv.valid is None else (row & dv.valid)
+
+
+def _scale_of(t: DType) -> int:
+    return t.scale if isinstance(t, DecimalType) else 0
+
+
+def _to_float(arr, t: DType):
+    if isinstance(t, DecimalType):
+        return arr.astype(jnp.float64) / (10.0 ** t.scale)
+    return arr.astype(jnp.float64)
+
+
+def _rescale(arr, from_s: int, to_s: int):
+    if from_s == to_s:
+        return arr
+    if to_s > from_s:
+        return arr.astype(jnp.int64) * (10 ** (to_s - from_s))
+    return arr.astype(jnp.int64) // (10 ** (from_s - to_s))
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _epoch_days_to_civil(days):
+    """Hinnant's algorithm: epoch days -> (year, month, day), integer ops
+    only so it vectorizes onto the VPU."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _plan_bindings(node: P.Node) -> set:
+    """All binding names produced anywhere inside a plan subtree."""
+    out = set()
+    for n in P.walk_plan(node):
+        b = getattr(n, "binding", "")
+        if b:
+            out.add(b)
+    return out
+
+
+def _expr_bindings(e: ir.IR) -> set:
+    return {x.binding for x in ir.walk(e) if isinstance(x, ir.ColRef)}
+
+
+class DeviceExecutor:
+    """Executes logical plans on jax devices. One instance should live for
+    a whole session: it owns the device buffer pool (columns uploaded once,
+    the transcode/load analog) and the per-query compile cache."""
+
+    def __init__(self, tables: dict[str, HostTable]):
+        self.tables = tables
+        self._buffers: dict[str, jnp.ndarray] = {}
+        self._bounds: dict[tuple, tuple] = {}
+        self._compiled: dict[object, tuple] = {}
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, planned: P.PlannedQuery, key: object = None):
+        key = key if key is not None else id(planned)
+        if key not in self._compiled:
+            # the cache entry holds a strong ref to the plan: id()-keyed
+            # entries must keep their plan alive or a recycled address
+            # could serve another query's compiled program
+            self._compiled[key] = self._compile(planned) + (planned,)
+        jitted, side, _ref = self._compiled[key]
+        bufs = self._collect_buffers(planned)
+        row, outs = jitted(bufs)
+        return self._materialize(planned, row, outs, side)
+
+    def _compile(self, planned: P.PlannedQuery):
+        side = {}
+
+        def fn(bufs):
+            tr = _Trace(self, bufs)
+            row, outs, dicts = tr.run_query(planned)
+            side["dicts"] = dicts
+            return row, outs
+
+        return jax.jit(fn), side
+
+    # -------------------------------------------------------------- buffers
+
+    def _collect_buffers(self, planned: P.PlannedQuery) -> dict:
+        bufs = {}
+        roots = [planned.root] + list(planned.scalar_subplans)
+        for root in roots:
+            for node in P.walk_plan(root):
+                if isinstance(node, P.Scan):
+                    for name, _dt in node.output:
+                        self._upload(bufs, node.table, name)
+        return bufs
+
+    def _upload(self, bufs: dict, table: str, name: str) -> None:
+        key = f"{table}.{name}"
+        if key not in self._buffers:
+            col = self.tables[table].columns[name]
+            self._buffers[key] = jnp.asarray(col.values)
+            if col.null_mask is not None:
+                self._buffers[key + "#v"] = jnp.asarray(col.null_mask)
+        bufs[key] = self._buffers[key]
+        if key + "#v" in self._buffers:
+            bufs[key + "#v"] = self._buffers[key + "#v"]
+
+    def col_bounds(self, table: str, name: str):
+        """Host-side (min,max) of an integer-typed column, for key packing."""
+        ck = (table, name)
+        if ck not in self._bounds:
+            col = self.tables[table].columns[name]
+            if col.is_string:
+                self._bounds[ck] = (0, max(len(col.dictionary) - 1, 0))
+            elif np.issubdtype(col.values.dtype, np.integer):
+                vals = col.values
+                if col.null_mask is not None:
+                    vals = vals[col.null_mask]
+                if len(vals) == 0:
+                    self._bounds[ck] = (0, 0)
+                else:
+                    self._bounds[ck] = (int(vals.min()), int(vals.max()))
+            else:
+                self._bounds[ck] = (None, None)
+        return self._bounds[ck]
+
+    # ---------------------------------------------------------- materialize
+
+    def _materialize(self, planned: P.PlannedQuery, row, outs, side):
+        row = np.asarray(row)
+        idx = np.nonzero(row)[0]
+        arrs, valids, dtypes = [], [], []
+        for (arr, valid), (name, dt), sd in zip(
+                outs, planned.root.output, side["dicts"]):
+            a = np.asarray(arr)[idx]
+            v = np.asarray(valid)[idx]
+            if sd is not None:
+                a = sd[np.clip(a, 0, len(sd) - 1)]
+                a = np.asarray(a, dtype=object)
+            arrs.append(a)
+            valids.append(None if v.all() else v)
+            dtypes.append(dt)
+        names = planned.column_names or [n for n, _ in planned.root.output]
+        return ResultTable(names, arrs, dtypes, valids)
+
+
+class _Trace:
+    """Interprets a plan while being traced by jax.jit. All python control
+    flow here runs at trace time; host-side numpy work (dictionary
+    predicate tables, key bounds) becomes XLA constants."""
+
+    def __init__(self, ex: DeviceExecutor, bufs: dict):
+        self.ex = ex
+        self.bufs = bufs
+        self.scalars: dict[int, tuple] = {}
+        self._cache: dict[int, DCtx] = {}
+
+    def run_query(self, planned: P.PlannedQuery):
+        for i, sub in enumerate(planned.scalar_subplans):
+            ctx = self.run(sub)
+            name, dt = sub.output[0]
+            dv = ctx.cols[(sub.binding, name)]
+            pos = jnp.argmax(ctx.row)
+            v = dv.arr[pos]
+            ok = ctx.row[pos]
+            if dv.valid is not None:
+                ok = ok & dv.valid[pos]
+            self.scalars[i] = (v, ok, dv.sdict, dt)
+        ctx = self.run(planned.root)
+        root = planned.root
+        outs, dicts = [], []
+        for name, _dt in root.output:
+            dv = ctx.cols[(root.binding, name)]
+            valid = dv.valid if dv.valid is not None else jnp.ones(
+                ctx.n, dtype=bool)
+            outs.append((dv.arr, valid))
+            dicts.append(dv.sdict)
+        return ctx.row, outs, dicts
+
+    # ----------------------------------------------------------- plan nodes
+
+    def run(self, node: P.Node) -> DCtx:
+        nid = id(node)
+        if nid in self._cache:
+            return self._cache[nid]
+        ctx = getattr(self, "_run_" + type(node).__name__.lower())(node)
+        self._cache[nid] = ctx
+        return ctx
+
+    def _run_scan(self, node: P.Scan) -> DCtx:
+        t = self.ex.tables[node.table]
+        n = max(t.nrows, 1)
+        row = jnp.arange(n) < t.nrows
+        ctx = DCtx(n, row)
+        for name, _dt in node.output:
+            col = t.columns[name]
+            arr = self.bufs[f"{node.table}.{name}"]
+            valid = self.bufs.get(f"{node.table}.{name}#v")
+            if arr.shape[0] == 0:
+                arr = jnp.zeros((1,), dtype=arr.dtype)
+                valid = None
+            lo, hi = self.ex.col_bounds(node.table, name)
+            sdict = col.dictionary if col.is_string else None
+            ctx.cols[(node.binding, name)] = DVal(arr, valid, sdict, lo, hi)
+        for pred in node.filters:
+            ctx = self._apply_filter(ctx, pred)
+        return ctx
+
+    def _apply_filter(self, ctx: DCtx, pred: ir.IR) -> DCtx:
+        dv = self.eval(pred, ctx)
+        m = dv.arr.astype(bool)
+        if dv.valid is not None:
+            m = m & dv.valid
+        out = DCtx(ctx.n, ctx.row & m)
+        out.cols = ctx.cols
+        return out
+
+    def _run_derivedscan(self, node: P.DerivedScan) -> DCtx:
+        child = self.run(node.child)
+        cb = node.child.binding
+        out = DCtx(child.n, child.row)
+        for name, _dt in node.child.output:
+            out.cols[(node.binding, name)] = child.cols[(cb, name)]
+        return out
+
+    def _run_filter(self, node: P.Filter) -> DCtx:
+        return self._apply_filter(self.run(node.child), node.predicate)
+
+    def _run_project(self, node: P.Project) -> DCtx:
+        ctx = self.run(node.child)
+        out = DCtx(ctx.n, ctx.row)
+        for name, e in node.exprs:
+            dv = self.eval(e, ctx)
+            if dv.arr.ndim == 0:
+                dv = dv.with_arrays(
+                    jnp.broadcast_to(dv.arr, (ctx.n,)),
+                    None if dv.valid is None
+                    else jnp.broadcast_to(dv.valid, (ctx.n,)))
+            out.cols[(node.binding, name)] = dv
+        return out
+
+    # -------------------------------------------------------------- joins
+
+    def _join_key_arrays(self, lvals, rvals, lctx, rctx):
+        """Align key pairs (string dictionary union, decimal rescale), then
+        bit-pack multi-column keys into one int64 per side.
+        Returns (lkey, lok, rkey, rok)."""
+        lok = lctx.row
+        rok = rctx.row
+        if len(lvals) == 1 and lvals[0].sdict is None \
+                and rvals[0].sdict is None:
+            lv, rv = lvals[0], rvals[0]
+            return (lv.arr.astype(jnp.int64), _ok(lv, lok),
+                    rv.arr.astype(jnp.int64), _ok(rv, rok))
+        lks, rks, widths = [], [], []
+        for lv, rv in zip(lvals, rvals):
+            la, ra, lo, hi = self._align_pair(lv, rv)
+            lok = _ok(lv, lok)
+            rok = _ok(rv, rok)
+            lks.append((la, lo, hi))
+            rks.append((ra, lo, hi))
+            span = hi - lo
+            widths.append(max(span.bit_length(), 1))
+        if sum(widths) > 62:
+            raise DeviceExecError(
+                f"join key too wide to pack: {widths} bits")
+        lkey = self._pack(lks, widths)
+        rkey = self._pack(rks, widths)
+        return lkey, lok, rkey, rok
+
+    @staticmethod
+    def _pack(keys, widths):
+        acc = None
+        for (arr, lo, hi), w in zip(keys, widths):
+            norm = jnp.clip(arr.astype(jnp.int64) - lo, 0, hi - lo)
+            acc = norm if acc is None else ((acc << w) | norm)
+        return acc
+
+    def _align_pair(self, lv: DVal, rv: DVal):
+        """Make one key pair comparable as integers; returns
+        (l_arr, r_arr, lo, hi) with host-known bounds."""
+        if lv.sdict is not None or rv.sdict is not None:
+            if lv.sdict is None or rv.sdict is None:
+                raise DeviceExecError("string vs non-string join key")
+            if lv.sdict is rv.sdict or (
+                    len(lv.sdict) == len(rv.sdict)
+                    and np.array_equal(lv.sdict, rv.sdict)):
+                hi = max(len(lv.sdict) - 1, 0)
+                return lv.arr, rv.arr, 0, hi
+            union = np.union1d(lv.sdict.astype(str), rv.sdict.astype(str))
+            lmap = jnp.asarray(np.searchsorted(union, lv.sdict.astype(str)))
+            rmap = jnp.asarray(np.searchsorted(union, rv.sdict.astype(str)))
+            return (jnp.take(lmap, lv.arr), jnp.take(rmap, rv.arr),
+                    0, max(len(union) - 1, 0))
+        la, ra = lv.arr, rv.arr
+        if (lv.lo is None or lv.hi is None or rv.lo is None
+                or rv.hi is None):
+            raise DeviceExecError(
+                "join key without host bounds (needed for packing)")
+        return la, ra, min(lv.lo, rv.lo), max(lv.hi, rv.hi)
+
+    @staticmethod
+    def _build_lookup(key, ok):
+        """Sort build keys (invalid rows to the sentinel end)."""
+        k = jnp.where(ok, key, I64_MAX)
+        order = jnp.argsort(k)
+        return jnp.take(k, order), order
+
+    @staticmethod
+    def _probe(ks, order, pkey, pok):
+        n = ks.shape[0]
+        pos = jnp.clip(jnp.searchsorted(ks, pkey), 0, n - 1)
+        hit = (jnp.take(ks, pos) == pkey) & pok
+        return jnp.take(order, pos), hit
+
+    def _run_join(self, node: P.Join) -> DCtx:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        if not node.left_keys:
+            return self._cross_join(node, lctx, rctx)
+        lvals = [self.eval(k, lctx) for k in node.left_keys]
+        rvals = [self.eval(k, rctx) for k in node.right_keys]
+        lkey, lok, rkey, rok = self._join_key_arrays(lvals, rvals, lctx, rctx)
+        if node.right_unique:
+            # gather join: probe from the left, build on the unique right
+            ks, order = self._build_lookup(rkey, rok)
+            ridx, hit = self._probe(ks, order, lkey, lok)
+            if node.kind == "left":
+                out = DCtx(lctx.n, lctx.row)
+                out.cols.update(lctx.cols)
+                gathered = rctx.gather(ridx, clear_valid=hit)
+                out.cols.update(gathered.cols)
+                if node.residual is not None:
+                    resid = self.eval(node.residual, out)
+                    rk = resid.arr.astype(bool)
+                    if resid.valid is not None:
+                        rk = rk & resid.valid
+                    keep = hit & rk
+                    out2 = DCtx(lctx.n, lctx.row)
+                    out2.cols.update(lctx.cols)
+                    out2.cols.update(rctx.gather(ridx, clear_valid=keep).cols)
+                    return out2
+                return out
+            out = DCtx(lctx.n, lctx.row & hit)
+            out.cols.update(lctx.cols)
+            out.cols.update(rctx.gather(ridx).cols)
+            if node.residual is not None:
+                out = self._apply_filter(out, node.residual)
+            return out
+        # right side not unique: probe from the right against a unique left
+        # (FK-side expansion; the planner orients star joins the other way,
+        # this path serves customer LEFT JOIN orders-style plans, q13)
+        ks, order = self._build_lookup(lkey, lok)
+        lidx, hit = self._probe(ks, order, rkey, rok)
+        if node.kind == "inner":
+            out = DCtx(rctx.n, rctx.row & hit)
+            out.cols.update(rctx.cols)
+            out.cols.update(lctx.gather(lidx).cols)
+            if node.residual is not None:
+                out = self._apply_filter(out, node.residual)
+            return out
+        # left outer with expansion: block A = matched right rows with
+        # gathered left columns; block B = left rows with no surviving match
+        presentA = rctx.row & hit
+        if node.residual is not None:
+            combined = DCtx(rctx.n, presentA)
+            combined.cols.update(rctx.cols)
+            combined.cols.update(lctx.gather(lidx).cols)
+            resid = self.eval(node.residual, combined)
+            rk = resid.arr.astype(bool)
+            if resid.valid is not None:
+                rk = rk & resid.valid
+            presentA = presentA & rk
+        scat = jnp.zeros(lctx.n, dtype=jnp.int32).at[lidx].max(
+            presentA.astype(jnp.int32))
+        matched = scat > 0
+        n_out = rctx.n + lctx.n
+        out = DCtx(n_out, jnp.concatenate(
+            [presentA, lctx.row & ~matched]))
+        gatheredA = lctx.gather(lidx)
+        for k, dv in lctx.cols.items():
+            ga = gatheredA.cols[k]
+            arr = jnp.concatenate([ga.arr, dv.arr])
+            valid = None
+            if ga.valid is not None or dv.valid is not None:
+                gav = ga.valid if ga.valid is not None else jnp.ones(
+                    rctx.n, bool)
+                dvv = dv.valid if dv.valid is not None else jnp.ones(
+                    lctx.n, bool)
+                valid = jnp.concatenate([gav, dvv])
+            out.cols[k] = dv.with_arrays(arr, valid)
+        falses = jnp.zeros(lctx.n, dtype=bool)
+        for k, dv in rctx.cols.items():
+            arr = jnp.concatenate(
+                [dv.arr, jnp.zeros(lctx.n, dtype=dv.arr.dtype)])
+            av = dv.valid if dv.valid is not None else jnp.ones(rctx.n, bool)
+            out.cols[k] = dv.with_arrays(arr, jnp.concatenate([av, falses]))
+        return out
+
+    def _cross_join(self, node: P.Join, lctx: DCtx, rctx: DCtx) -> DCtx:
+        if lctx.n * rctx.n > 1 << 24:
+            raise DeviceExecError(
+                f"cross join too large: {lctx.n} x {rctx.n}")
+        li = jnp.repeat(jnp.arange(lctx.n), rctx.n)
+        ri = jnp.tile(jnp.arange(rctx.n), lctx.n)
+        out = lctx.gather(li).merge(rctx.gather(ri))
+        out.row = jnp.take(lctx.row, li) & jnp.take(rctx.row, ri)
+        if node.residual is not None:
+            out = self._apply_filter(out, node.residual)
+        return out
+
+    def _run_semijoin(self, node: P.SemiJoin) -> DCtx:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        lvals = [self.eval(k, lctx) for k in node.left_keys]
+        rvals = [self.eval(k, rctx) for k in node.right_keys]
+        if not node.left_keys:
+            raise DeviceExecError("semi join without keys")
+        lkey, lok, rkey, rok = self._join_key_arrays(lvals, rvals, lctx, rctx)
+        if node.residual is None:
+            ks, order = self._build_lookup(rkey, rok)
+            _idx, hit = self._probe(ks, order, lkey, lok)
+            exists = hit
+        else:
+            exists = self._exists_with_residual(
+                node, lctx, rctx, lkey, lok, rkey, rok)
+        keep = (lctx.row & ~exists) if node.anti else (lctx.row & exists)
+        out = DCtx(lctx.n, keep)
+        out.cols = lctx.cols
+        return out
+
+    def _exists_with_residual(self, node, lctx, rctx, lkey, lok, rkey, rok):
+        """EXISTS with a cross-side residual of the q21 shape
+        `r.col <> l.col`: exists a right row with the key and a DIFFERENT
+        col value  <=>  count(key) > count(key, col == l.col). Both counts
+        come from sorted-key range queries — no row expansion."""
+        e = node.residual
+        if not (isinstance(e, ir.Cmp) and e.op == "<>"):
+            raise DeviceExecError(
+                f"unsupported semi-join residual: {e!r}")
+        rbinds = _plan_bindings(node.right)
+        if _expr_bindings(e.left) <= rbinds:
+            r_ir, l_ir = e.left, e.right
+        elif _expr_bindings(e.right) <= rbinds:
+            r_ir, l_ir = e.right, e.left
+        else:
+            raise DeviceExecError("residual does not split by side")
+        lcol = self.eval(l_ir, lctx)
+        rcol = self.eval(r_ir, rctx)
+        # count of right rows per key
+        ks = jnp.sort(jnp.where(rok, rkey, I64_MAX))
+        c_all = (jnp.searchsorted(ks, lkey, side="right")
+                 - jnp.searchsorted(ks, lkey, side="left"))
+        # count of right rows per (key, col)
+        la, ra, lo, hi = self._align_pair(lcol, rcol)
+        w = max((hi - lo).bit_length(), 1)
+        lkey2 = (lkey << w) | jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo)
+        rkey2 = (rkey << w) | jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo)
+        lok2 = _ok(lcol, lok)
+        rok2 = _ok(rcol, rok)
+        ks2 = jnp.sort(jnp.where(rok2, rkey2, I64_MAX))
+        c_same = (jnp.searchsorted(ks2, lkey2, side="right")
+                  - jnp.searchsorted(ks2, lkey2, side="left"))
+        return lok & lok2 & ((c_all - c_same) > 0)
+
+    # --------------------------------------------------------- aggregation
+
+    def _run_aggregate(self, node: P.Aggregate) -> DCtx:
+        ctx = self.run(node.child)
+        b = node.binding
+        if not node.group_keys:
+            out = DCtx(1, jnp.ones(1, dtype=bool))
+            for name, spec in node.aggs:
+                arr, valid, sdict = self._agg_global(spec, ctx)
+                lo, hi = self._agg_bounds(spec, ctx)
+                out.cols[(b, name)] = DVal(arr, valid, sdict, lo, hi)
+            return out
+        keyvals = [self.eval(e, ctx) for _, e in node.group_keys]
+        perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
+        G = ctx.n
+        out_row = jnp.arange(G) < ngroups
+        out = DCtx(G, out_row)
+        # representative (first) sorted position per group
+        iota = jnp.arange(ctx.n)
+        starts = jax.ops.segment_min(
+            jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
+            indices_are_sorted=True)
+        starts = jnp.clip(starts, 0, ctx.n - 1)
+        for (kname, _kexpr), kv in zip(node.group_keys, keyvals):
+            arr_s = jnp.take(kv.arr, perm)
+            arr_g = jnp.take(arr_s, starts)
+            valid_g = None
+            if kv.valid is not None:
+                valid_g = jnp.take(jnp.take(kv.valid, perm), starts)
+            out.cols[(b, kname)] = kv.with_arrays(arr_g, valid_g)
+        for name, spec in node.aggs:
+            arr, valid, sdict = self._agg_grouped(
+                spec, ctx, perm, gid, present_s, G)
+            lo, hi = self._agg_bounds(spec, ctx)
+            out.cols[(b, name)] = DVal(arr, valid, sdict, lo, hi)
+        return out
+
+    def _agg_bounds(self, spec: P.AggSpec, ctx: DCtx):
+        """Host-known value bounds of an aggregate output (lets downstream
+        joins against aggregate results bit-pack their keys, q2)."""
+        if spec.func == "count":
+            return 0, ctx.n
+        dv = None
+        if spec.arg is not None:
+            dv = self.eval(spec.arg, ctx)  # cached via column DVals
+        if dv is None or dv.lo is None or dv.hi is None:
+            return None, None
+        if spec.func in ("min", "max"):
+            return dv.lo, dv.hi
+        if spec.func == "sum" and not isinstance(spec.dtype, FloatType):
+            return min(0, dv.lo) * ctx.n, max(0, dv.hi) * ctx.n
+        return None, None
+
+    def _group_ids(self, ctx: DCtx, keyvals):
+        """Stable sort rows by (presence, key validity+values...); returns
+        (perm, gid per sorted row, first-flag, presence per sorted row,
+        ngroups). Present rows sort to the front."""
+        n = ctx.n
+        ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32)]
+        key_ops = []
+        for kv in keyvals:
+            if kv.valid is not None:
+                vop = jnp.where(kv.valid, 0, 1).astype(jnp.int32)
+                ops.append(vop)
+                key_ops.append(len(ops) - 1)
+            filled = jnp.where(_ok(kv, ctx.row), kv.arr,
+                               jnp.zeros((), dtype=kv.arr.dtype))
+            ops.append(filled)
+            key_ops.append(len(ops) - 1)
+        ops.append(jnp.arange(n))
+        sorted_ops = lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
+        perm = sorted_ops[-1]
+        present_s = jnp.take(ctx.row, perm)
+        iota = jnp.arange(n)
+        diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+        for i in key_ops:
+            o = sorted_ops[i]
+            diff = diff | jnp.concatenate(
+                [jnp.ones(1, bool), o[1:] != o[:-1]])
+        first_s = present_s & (diff | (iota == 0))
+        gid = jnp.cumsum(first_s.astype(jnp.int32)) - 1
+        gid = jnp.clip(gid, 0, n - 1)
+        ngroups = jnp.sum(first_s)
+        return perm, gid, first_s, present_s, ngroups
+
+    def _agg_arg(self, spec: P.AggSpec, ctx: DCtx):
+        if spec.arg is None:
+            return None
+        return self.eval(spec.arg, ctx)
+
+    def _agg_global(self, spec: P.AggSpec, ctx: DCtx):
+        dv = self._agg_arg(spec, ctx)
+        if spec.func == "count":
+            if dv is None:
+                cnt = jnp.sum(ctx.row)
+                return (cnt.reshape(1).astype(jnp.int64),
+                        jnp.ones(1, bool), None)
+            w = _ok(dv, ctx.row)
+            if spec.distinct:
+                key = jnp.where(w, dv.arr.astype(jnp.int64), I64_MAX)
+                ks = jnp.sort(key)
+                newv = jnp.concatenate(
+                    [jnp.ones(1, bool), ks[1:] != ks[:-1]])
+                cnt = jnp.sum(newv & (ks != I64_MAX))
+            else:
+                cnt = jnp.sum(w)
+            return (cnt.reshape(1).astype(jnp.int64),
+                    jnp.ones(1, bool), None)
+        w = _ok(dv, ctx.row)
+        cnt = jnp.sum(w)
+        valid = (cnt > 0).reshape(1)
+        if spec.func == "sum":
+            if isinstance(spec.dtype, FloatType):
+                s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.float64), 0.0))
+            else:
+                s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.int64), 0))
+            return s.reshape(1), valid, None
+        if spec.func == "avg":
+            f = _to_float(dv.arr, spec.arg.dtype)
+            s = jnp.sum(jnp.where(w, f, 0.0))
+            return (s / jnp.maximum(cnt, 1)).reshape(1), valid, None
+        if spec.func in ("min", "max"):
+            if jnp.issubdtype(dv.arr.dtype, jnp.floating):
+                fill = jnp.inf if spec.func == "min" else -jnp.inf
+                masked = jnp.where(w, dv.arr, fill)
+            else:
+                fill = I64_MAX if spec.func == "min" else I64_MIN
+                masked = jnp.where(w, dv.arr.astype(jnp.int64), fill)
+            red = jnp.min(masked) if spec.func == "min" else jnp.max(masked)
+            return red.reshape(1), valid, dv.sdict
+        raise DeviceExecError(spec.func)
+
+    def _agg_grouped(self, spec: P.AggSpec, ctx: DCtx, perm, gid,
+                     present_s, G):
+        dv = self._agg_arg(spec, ctx)
+        if spec.func == "count" and spec.distinct:
+            return self._count_distinct_grouped(
+                spec, ctx, perm, gid, present_s, G)
+        if dv is None:  # count(*)
+            cnt = jax.ops.segment_sum(
+                present_s.astype(jnp.int64), gid, num_segments=G,
+                indices_are_sorted=True)
+            return cnt, None, None
+        arr_s = jnp.take(dv.arr, perm)
+        w = present_s
+        if dv.valid is not None:
+            w = w & jnp.take(dv.valid, perm)
+        cnt = jax.ops.segment_sum(w.astype(jnp.int64), gid, num_segments=G,
+                                  indices_are_sorted=True)
+        if spec.func == "count":
+            return cnt, None, None
+        valid = cnt > 0
+        if spec.func == "sum":
+            if isinstance(spec.dtype, FloatType):
+                data = jnp.where(w, arr_s.astype(jnp.float64), 0.0)
+            else:
+                data = jnp.where(w, arr_s.astype(jnp.int64), 0)
+            return jax.ops.segment_sum(data, gid, num_segments=G,
+                                       indices_are_sorted=True), valid, None
+        if spec.func == "avg":
+            f = _to_float(arr_s, spec.arg.dtype)
+            s = jax.ops.segment_sum(jnp.where(w, f, 0.0), gid,
+                                    num_segments=G, indices_are_sorted=True)
+            return s / jnp.maximum(cnt, 1).astype(jnp.float64), valid, None
+        if spec.func in ("min", "max"):
+            isf = jnp.issubdtype(arr_s.dtype, jnp.floating)
+            if isf:
+                fill = jnp.inf if spec.func == "min" else -jnp.inf
+                data = jnp.where(w, arr_s, fill)
+            else:
+                fill = I64_MAX if spec.func == "min" else I64_MIN
+                data = jnp.where(w, arr_s.astype(jnp.int64), fill)
+            seg = (jax.ops.segment_min if spec.func == "min"
+                   else jax.ops.segment_max)
+            red = seg(data, gid, num_segments=G, indices_are_sorted=True)
+            if not isf and not isinstance(spec.dtype,
+                                          (FloatType, DecimalType)):
+                red = red.astype(arr_s.dtype)
+            return red, valid, dv.sdict
+        raise DeviceExecError(spec.func)
+
+    def _count_distinct_grouped(self, spec, ctx, perm, gid, present_s, G):
+        """Re-sort by (presence, gid, value); count first occurrences of
+        (gid, value) among valid rows."""
+        dv = self.eval(spec.arg, ctx)
+        n = ctx.n
+        val = dv.arr.astype(jnp.int64)
+        w0 = _ok(dv, ctx.row)
+        # group id per ORIGINAL row: scatter sorted gid back through perm
+        gid_orig = jnp.zeros(n, dtype=gid.dtype).at[perm].set(gid)
+        # valid rows sort before invalid within each group so the
+        # first-occurrence flag below can't be shadowed by a NULL row
+        ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32),
+               gid_orig,
+               jnp.where(w0, 0, 1).astype(jnp.int32),
+               jnp.where(w0, val, 0), jnp.arange(n)]
+        sorted_ops = lax.sort(ops, num_keys=4, is_stable=True)
+        perm2 = sorted_ops[-1]
+        g2 = sorted_ops[1]
+        v2 = sorted_ops[3]
+        w2 = jnp.take(w0, perm2)
+        newpair = jnp.concatenate(
+            [jnp.ones(1, bool), (g2[1:] != g2[:-1]) | (v2[1:] != v2[:-1])])
+        flag = w2 & newpair
+        cnt = jax.ops.segment_sum(flag.astype(jnp.int64), g2, num_segments=G)
+        return cnt, None, None
+
+    # ------------------------------------------------------- sort and misc
+
+    def _run_sort(self, node: P.Sort) -> DCtx:
+        ctx = self.run(node.child)
+        n = ctx.n
+        ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32)]
+        for e, asc, nulls_first in node.keys:
+            dv = self.eval(e, ctx)
+            if dv.valid is not None:
+                rank = jnp.where(dv.valid, 1, 0) if nulls_first \
+                    else jnp.where(dv.valid, 0, 1)
+                ops.append(rank.astype(jnp.int32))
+            arr = dv.arr
+            if jnp.issubdtype(arr.dtype, jnp.bool_):
+                arr = arr.astype(jnp.int32)
+            key = arr if asc else -arr.astype(
+                jnp.float64 if jnp.issubdtype(arr.dtype, jnp.floating)
+                else jnp.int64)
+            if dv.valid is not None:
+                key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
+            ops.append(key)
+        ops.append(jnp.arange(n))
+        sorted_ops = lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
+        perm = sorted_ops[-1]
+        out = ctx.gather(perm)
+        out.row = jnp.take(ctx.row, perm)
+        return out
+
+    def _compact(self, ctx: DCtx) -> DCtx:
+        """Stable-sort present rows to the front (needed before Limit when
+        the child didn't already order them)."""
+        ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32),
+               jnp.arange(ctx.n)]
+        sorted_ops = lax.sort(ops, num_keys=1, is_stable=True)
+        perm = sorted_ops[-1]
+        out = ctx.gather(perm)
+        out.row = jnp.take(ctx.row, perm)
+        return out
+
+    def _run_limit(self, node: P.Limit) -> DCtx:
+        ctx = self.run(node.child)
+        if not isinstance(node.child, P.Sort):
+            ctx = self._compact(ctx)
+        cap = min(node.count, ctx.n)
+        out = DCtx(cap, ctx.row[:cap])
+        for k, dv in ctx.cols.items():
+            out.cols[k] = dv.with_arrays(
+                dv.arr[:cap],
+                None if dv.valid is None else dv.valid[:cap])
+        return out
+
+    def _run_distinct(self, node: P.Distinct) -> DCtx:
+        ctx = self.run(node.child)
+        b = node.binding
+        keyvals = [ctx.cols[(b, name)] for name, _ in node.output]
+        perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
+        G = ctx.n
+        iota = jnp.arange(ctx.n)
+        starts = jax.ops.segment_min(
+            jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
+            indices_are_sorted=True)
+        starts = jnp.clip(starts, 0, ctx.n - 1)
+        out = DCtx(G, jnp.arange(G) < ngroups)
+        for (name, _dt), kv in zip(node.output, keyvals):
+            arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
+            valid_g = None
+            if kv.valid is not None:
+                valid_g = jnp.take(jnp.take(kv.valid, perm), starts)
+            out.cols[(b, name)] = kv.with_arrays(arr_g, valid_g)
+        return out
+
+    def _run_setop(self, node: P.SetOp) -> DCtx:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        lb, rb = node.left.binding, node.right.binding
+        if node.kind.startswith("union"):
+            out = DCtx(lctx.n + rctx.n,
+                       jnp.concatenate([lctx.row, rctx.row]))
+            for (lname, _), (rname, _) in zip(node.left.output,
+                                              node.right.output):
+                lv = lctx.cols[(lb, lname)]
+                rv = rctx.cols[(rb, rname)]
+                la, ra = lv.arr, rv.arr
+                sdict = lv.sdict
+                if lv.sdict is not None or rv.sdict is not None:
+                    la, ra, sdict = self._union_dict(lv, rv)
+                if la.dtype != ra.dtype:
+                    tgt = jnp.promote_types(la.dtype, ra.dtype)
+                    la, ra = la.astype(tgt), ra.astype(tgt)
+                arr = jnp.concatenate([la, ra])
+                valid = None
+                if lv.valid is not None or rv.valid is not None:
+                    lvv = lv.valid if lv.valid is not None else jnp.ones(
+                        lctx.n, bool)
+                    rvv = rv.valid if rv.valid is not None else jnp.ones(
+                        rctx.n, bool)
+                    valid = jnp.concatenate([lvv, rvv])
+                out.cols[(lb, lname)] = DVal(
+                    arr, valid, sdict,
+                    None if (lv.lo is None or rv.lo is None)
+                    else min(lv.lo, rv.lo),
+                    None if (lv.hi is None or rv.hi is None)
+                    else max(lv.hi, rv.hi))
+            if node.kind == "union":
+                # distinct over the concatenated context, inline
+                keyvals = [out.cols[(lb, name)]
+                           for name, _ in node.left.output]
+                perm, gid, first_s, present_s, ngroups = self._group_ids(
+                    out, keyvals)
+                G = out.n
+                iota = jnp.arange(G)
+                starts = jax.ops.segment_min(
+                    jnp.where(first_s, iota, G - 1), gid, num_segments=G,
+                    indices_are_sorted=True)
+                starts = jnp.clip(starts, 0, G - 1)
+                dctx = DCtx(G, jnp.arange(G) < ngroups)
+                for (name, _dt), kv in zip(node.left.output, keyvals):
+                    arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
+                    valid_g = None
+                    if kv.valid is not None:
+                        valid_g = jnp.take(jnp.take(kv.valid, perm), starts)
+                    dctx.cols[(lb, name)] = kv.with_arrays(arr_g, valid_g)
+                return dctx
+            return out
+        raise DeviceExecError(f"setop {node.kind} not yet on device")
+
+    @staticmethod
+    def _union_dict(lv: DVal, rv: DVal):
+        if lv.sdict is None or rv.sdict is None:
+            raise DeviceExecError("union of string and non-string column")
+        if lv.sdict is rv.sdict or (
+                len(lv.sdict) == len(rv.sdict)
+                and np.array_equal(lv.sdict, rv.sdict)):
+            return lv.arr, rv.arr, lv.sdict
+        union = np.union1d(lv.sdict.astype(str), rv.sdict.astype(str))
+        lmap = jnp.asarray(np.searchsorted(union, lv.sdict.astype(str)))
+        rmap = jnp.asarray(np.searchsorted(union, rv.sdict.astype(str)))
+        return (jnp.take(lmap, lv.arr), jnp.take(rmap, rv.arr),
+                union.astype(object))
+
+    # ---------------------------------------------------------- expressions
+
+    def eval(self, e: ir.IR, ctx: DCtx) -> DVal:
+        if isinstance(e, ir.ColRef):
+            return ctx.cols[(e.binding, e.name)]
+        if isinstance(e, ir.Lit):
+            return self._eval_lit(e, ctx)
+        if isinstance(e, ir.ScalarRef):
+            v, ok, sdict, _dt = self.scalars[e.plan_id]
+            return DVal(jnp.broadcast_to(v, (ctx.n,)),
+                        jnp.broadcast_to(ok, (ctx.n,)), sdict)
+        if isinstance(e, ir.Arith):
+            return self._eval_arith(e, ctx)
+        if isinstance(e, ir.Cmp):
+            return self._eval_cmp(e, ctx)
+        if isinstance(e, ir.BoolOp):
+            vals = [self.eval(a, ctx) for a in e.args]
+            out = vals[0].arr.astype(bool)
+            valid = vals[0].valid
+            for dv in vals[1:]:
+                if e.op == "and":
+                    out = out & dv.arr.astype(bool)
+                else:
+                    out = out | dv.arr.astype(bool)
+                valid = _and_valid(valid, dv.valid)
+            return DVal(out, valid)
+        if isinstance(e, ir.Not):
+            dv = self.eval(e.operand, ctx)
+            return DVal(~dv.arr.astype(bool), dv.valid)
+        if isinstance(e, ir.Neg):
+            dv = self.eval(e.operand, ctx)
+            lo = None if dv.hi is None else -dv.hi
+            hi = None if dv.lo is None else -dv.lo
+            return DVal(-dv.arr, dv.valid, None, lo, hi)
+        if isinstance(e, ir.CaseIR):
+            return self._eval_case(e, ctx)
+        if isinstance(e, ir.LikeIR):
+            dv = self.eval(e.operand, ctx)
+            if dv.sdict is None:
+                raise DeviceExecError("LIKE over non-string")
+            table = like_mask(dv.sdict, e.pattern)
+            if e.negated:
+                table = ~table
+            return DVal(jnp.take(jnp.asarray(table), dv.arr), dv.valid)
+        if isinstance(e, ir.InListIR):
+            return self._eval_inlist(e, ctx)
+        if isinstance(e, ir.IsNullIR):
+            dv = self.eval(e.operand, ctx)
+            if dv.valid is None:
+                isnull = jnp.zeros(ctx.n, dtype=bool)
+            else:
+                isnull = ~dv.valid
+            return DVal(~isnull if e.negated else isnull, None)
+        if isinstance(e, ir.ExtractIR):
+            dv = self.eval(e.operand, ctx)
+            y, m, d = _epoch_days_to_civil(dv.arr)
+            if e.part == "year":
+                return DVal(y, dv.valid, None, 1970, 2199)
+            if e.part == "month":
+                return DVal(m, dv.valid, None, 1, 12)
+            if e.part == "day":
+                return DVal(d, dv.valid, None, 1, 31)
+            raise DeviceExecError(f"extract {e.part}")
+        if isinstance(e, ir.SubstrIR):
+            return self._eval_substr(e, ctx)
+        if isinstance(e, ir.CastIR):
+            return self._eval_cast(e, ctx)
+        raise DeviceExecError(f"cannot eval {e!r}")
+
+    def _eval_lit(self, e: ir.Lit, ctx: DCtx) -> DVal:
+        if isinstance(e.dtype, StringType):
+            # string literals only appear inside comparisons, which bind
+            # them against a dictionary; standalone use keeps the raw value
+            return DVal(jnp.zeros(ctx.n, jnp.int32), None,
+                        np.array([e.value], dtype=object), 0, 0)
+        v = e.value
+        if v is None:
+            return DVal(jnp.zeros(ctx.n, jnp.int64),
+                        jnp.zeros(ctx.n, dtype=bool))
+        if isinstance(e.dtype, FloatType):
+            arr = jnp.full(ctx.n, float(v), dtype=jnp.float64)
+            return DVal(arr, None)
+        iv = int(v)
+        dtype = jnp.int64
+        if isinstance(e.dtype, (IntType,)) and e.dtype.bits <= 32 \
+                and -2**31 <= iv < 2**31:
+            dtype = jnp.int32
+        if isinstance(e.dtype, DateType):
+            dtype = jnp.int32
+        return DVal(jnp.full(ctx.n, iv, dtype=dtype), None, None, iv, iv)
+
+    def _eval_arith(self, e: ir.Arith, ctx: DCtx) -> DVal:
+        l = self.eval(e.left, ctx)
+        r = self.eval(e.right, ctx)
+        valid = _and_valid(l.valid, r.valid)
+        lt, rt = e.left.dtype, e.right.dtype
+        if isinstance(e.dtype, DateType):
+            return DVal(l.arr + r.arr, valid)
+        if e.op == "/":
+            la = _to_float(l.arr, lt)
+            ra = _to_float(r.arr, rt)
+            return DVal(la / ra, valid)
+        if isinstance(e.dtype, FloatType):
+            return DVal(_apply(e.op, _to_float(l.arr, lt),
+                               _to_float(r.arr, rt)), valid)
+        if isinstance(e.dtype, DecimalType):
+            if e.op == "*":
+                return DVal(l.arr.astype(jnp.int64) * r.arr.astype(jnp.int64),
+                            valid)
+            s = e.dtype.scale
+            la = _rescale(l.arr, _scale_of(lt), s)
+            ra = _rescale(r.arr, _scale_of(rt), s)
+            return DVal(_apply(e.op, la, ra), valid)
+        out = _apply(e.op, l.arr, r.arr)
+        lo = hi = None
+        if (l.lo is not None and r.lo is not None
+                and l.hi is not None and r.hi is not None):
+            if e.op == "+":
+                lo, hi = l.lo + r.lo, l.hi + r.hi
+            elif e.op == "-":
+                lo, hi = l.lo - r.hi, l.hi - r.lo
+            elif e.op == "*":
+                cands = [l.lo * r.lo, l.lo * r.hi, l.hi * r.lo, l.hi * r.hi]
+                lo, hi = min(cands), max(cands)
+        return DVal(out, valid, None, lo, hi)
+
+    def _eval_cmp(self, e: ir.Cmp, ctx: DCtx) -> DVal:
+        lt, rt = e.left.dtype, e.right.dtype
+        if isinstance(lt, StringType) or isinstance(rt, StringType):
+            return self._string_cmp(e, ctx)
+        l = self.eval(e.left, ctx)
+        r = self.eval(e.right, ctx)
+        valid = _and_valid(l.valid, r.valid)
+        la, ra = l.arr, r.arr
+        if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            if isinstance(lt, FloatType) or isinstance(rt, FloatType):
+                la, ra = _to_float(la, lt), _to_float(ra, rt)
+            else:
+                s = max(_scale_of(lt), _scale_of(rt))
+                la = _rescale(la.astype(jnp.int64), _scale_of(lt), s)
+                ra = _rescale(ra.astype(jnp.int64), _scale_of(rt), s)
+        elif isinstance(lt, FloatType) or isinstance(rt, FloatType):
+            la, ra = _to_float(la, lt), _to_float(ra, rt)
+        return DVal(_cmp(e.op, la, ra), valid)
+
+    def _string_cmp(self, e: ir.Cmp, ctx: DCtx) -> DVal:
+        lit, col_ir, flipped = None, None, False
+        if isinstance(e.right, ir.Lit):
+            lit, col_ir = e.right.value, e.left
+        elif isinstance(e.left, ir.Lit):
+            lit, col_ir, flipped = e.left.value, e.right, True
+        if lit is not None:
+            dv = self.eval(col_ir, ctx)
+            if dv.sdict is None:
+                raise DeviceExecError("string compare on non-dict column")
+            vals = dv.sdict.astype(str)
+            op = e.op
+            if flipped:
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            table = _np_cmp(op, vals, str(lit))
+            return DVal(jnp.take(jnp.asarray(table), dv.arr), dv.valid)
+        l = self.eval(e.left, ctx)
+        r = self.eval(e.right, ctx)
+        valid = _and_valid(l.valid, r.valid)
+        la, ra, _sd = self._union_dict(l, r)
+        return DVal(_cmp(e.op, la, ra), valid)
+
+    def _eval_case(self, e: ir.CaseIR, ctx: DCtx) -> DVal:
+        if isinstance(e.dtype, StringType):
+            raise DeviceExecError("string-valued CASE not yet on device")
+        conds, vals, branch_valids = [], [], []
+        for c, v in e.whens:
+            cdv = self.eval(c, ctx)
+            cm = cdv.arr.astype(bool)
+            if cdv.valid is not None:
+                cm = cm & cdv.valid
+            vdv = self.eval(v, ctx)
+            conds.append(cm)
+            vals.append(self._coerce(vdv, v.dtype, e.dtype))
+            branch_valids.append(vdv.valid)
+        if e.else_ is not None:
+            edv = self.eval(e.else_, ctx)
+            default = self._coerce(edv, e.else_.dtype, e.dtype)
+            valid = edv.valid  # else-branch validity; refined per row below
+        else:
+            if isinstance(e.dtype, FloatType):
+                default = jnp.zeros(ctx.n, jnp.float64)
+            else:
+                default = jnp.zeros(ctx.n, jnp.int64)
+            valid = jnp.zeros(ctx.n, dtype=bool)  # no branch -> NULL
+        out = default
+        # the result's validity is the SELECTED branch's validity
+        need_valid = valid is not None or any(
+            bv is not None for bv in branch_valids)
+        if need_valid and valid is None:
+            valid = jnp.ones(ctx.n, dtype=bool)
+        for c, v, bv in zip(reversed(conds), reversed(vals),
+                            reversed(branch_valids)):
+            out = jnp.where(c, v, out)
+            if need_valid:
+                bvv = bv if bv is not None else jnp.ones(ctx.n, bool)
+                valid = jnp.where(c, bvv, valid)
+        return DVal(out, valid)
+
+    def _coerce(self, dv: DVal, src: DType, dst: DType):
+        if repr(src) == repr(dst):
+            return dv.arr
+        if isinstance(dst, FloatType):
+            return _to_float(dv.arr, src)
+        if isinstance(dst, DecimalType):
+            return _rescale(dv.arr.astype(jnp.int64), _scale_of(src),
+                            dst.scale)
+        return dv.arr
+
+    def _eval_inlist(self, e: ir.InListIR, ctx: DCtx) -> DVal:
+        dv = self.eval(e.operand, ctx)
+        if dv.sdict is not None:
+            table = np.isin(dv.sdict.astype(str),
+                            np.array([str(v) for v in e.values]))
+            if e.negated:
+                table = ~table
+            return DVal(jnp.take(jnp.asarray(table), dv.arr), dv.valid)
+        vals = e.values
+        if isinstance(e.operand.dtype, DecimalType):
+            s = e.operand.dtype.scale
+            vals = [int(round(float(x) * 10 ** s)) for x in vals]
+        m = jnp.zeros(ctx.n, dtype=bool)
+        for v in vals:
+            m = m | (dv.arr == v)
+        return DVal(~m if e.negated else m, dv.valid)
+
+    def _eval_substr(self, e: ir.SubstrIR, ctx: DCtx) -> DVal:
+        dv = self.eval(e.operand, ctx)
+        if dv.sdict is None:
+            raise DeviceExecError("substr over non-string")
+        lo = e.start - 1
+        hi = None if e.length is None else lo + e.length
+        subs = np.array([s[lo:hi] for s in dv.sdict.astype(str)],
+                        dtype=object)
+        newdict, remap = np.unique(subs.astype(str), return_inverse=True)
+        table = jnp.asarray(remap.astype(np.int32))
+        return DVal(jnp.take(table, dv.arr), dv.valid,
+                    newdict.astype(object), 0, max(len(newdict) - 1, 0))
+
+    def _eval_cast(self, e: ir.CastIR, ctx: DCtx) -> DVal:
+        dv = self.eval(e.operand, ctx)
+        src = e.operand.dtype
+        if isinstance(e.dtype, FloatType):
+            return DVal(_to_float(dv.arr, src), dv.valid)
+        if isinstance(e.dtype, IntType):
+            if isinstance(src, DecimalType):
+                return DVal((dv.arr // 10 ** src.scale).astype(jnp.int64),
+                            dv.valid)
+            return DVal(dv.arr.astype(jnp.int64), dv.valid, None,
+                        dv.lo, dv.hi)
+        if isinstance(e.dtype, DecimalType):
+            s = e.dtype.scale
+            if isinstance(src, DecimalType):
+                return DVal(_rescale(dv.arr, src.scale, s), dv.valid)
+            if isinstance(src, IntType):
+                return DVal(dv.arr.astype(jnp.int64) * 10 ** s, dv.valid)
+            return DVal(jnp.round(dv.arr * 10 ** s).astype(jnp.int64),
+                        dv.valid)
+        raise DeviceExecError(f"cast to {e.dtype}")
+
+
+def _apply(op, l, r):
+    if op == "+":
+        return l + r
+    if op == "-":
+        return l - r
+    if op == "*":
+        return l * r
+    if op == "%":
+        return l % r
+    raise DeviceExecError(op)
+
+
+def _cmp(op, l, r):
+    if op == "=":
+        return l == r
+    if op == "<>":
+        return l != r
+    if op == "<":
+        return l < r
+    if op == "<=":
+        return l <= r
+    if op == ">":
+        return l > r
+    if op == ">=":
+        return l >= r
+    raise DeviceExecError(op)
+
+
+def _np_cmp(op, vals, lit):
+    if op == "=":
+        return vals == lit
+    if op == "<>":
+        return vals != lit
+    if op == "<":
+        return vals < lit
+    if op == "<=":
+        return vals <= lit
+    if op == ">":
+        return vals > lit
+    if op == ">=":
+        return vals >= lit
+    raise DeviceExecError(op)
+
+
+def make_device_factory():
+    """Session executor factory that keeps ONE DeviceExecutor per table
+    registry, preserving its device buffers and compile cache across
+    queries (the load-once, query-many lifecycle of a power run,
+    `nds/nds_power.py:184-322`)."""
+    holder: dict = {}
+
+    def factory(tables):
+        ex = holder.get("ex")
+        if ex is None or ex.tables is not tables:
+            ex = DeviceExecutor(tables)
+            holder["ex"] = ex
+        return ex
+
+    return factory

@@ -1,0 +1,56 @@
+"""Query-result Parquet IO for differential validation.
+
+The reference power run can persist each query's output
+(`nds/nds_power.py:132-135` df.write.save) and the validator reads both
+CPU and GPU outputs back (`nds/nds_validate.py:82-83`). Same contract
+here: results from either backend round-trip through Parquet so
+`nds_tpu.nds_h.validate` can diff them.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from nds_tpu.engine.cpu_exec import ResultTable
+from nds_tpu.engine.types import DateType, DecimalType
+
+
+def result_to_arrow(result: ResultTable) -> pa.Table:
+    arrays = []
+    names = []
+    for i, (name, arr, dt, valid) in enumerate(zip(
+            result.names, result.cols, result.dtypes, result.valids)):
+        names.append(f"{name}#{i}" if result.names.count(name) > 1 else name)
+        mask = None if valid is None else ~np.asarray(valid)
+        if isinstance(dt, DecimalType):
+            vals = np.asarray(arr, dtype=np.float64) / 10 ** dt.scale
+            arrays.append(pa.array(vals, mask=mask))
+        elif isinstance(dt, DateType):
+            arrays.append(pa.array(
+                np.asarray(arr, dtype=np.int32), type=pa.int32(),
+                mask=mask).cast(pa.date32()))
+        elif arr.dtype == object:
+            arrays.append(pa.array(
+                [None if (mask is not None and mask[j]) else str(arr[j])
+                 for j in range(len(arr))], type=pa.string()))
+        else:
+            arrays.append(pa.array(arr, mask=mask))
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+def write_result(result: ResultTable, out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "part-0.parquet")
+    pq.write_table(result_to_arrow(result), path)
+    return path
+
+
+def read_result(out_dir: str):
+    """-> pandas DataFrame (dates as date32 -> object, fine for diffing)."""
+    paths = sorted(os.path.join(out_dir, f) for f in os.listdir(out_dir)
+                   if f.endswith(".parquet"))
+    return pa.concat_tables([pq.read_table(p) for p in paths]).to_pandas()

@@ -1,0 +1,168 @@
+"""NDS-H Power Run driver.
+
+Behavioral port of the reference's power driver (`nds-h/nds_h_power.py`):
+parse a query stream by its ``-- Template file: N`` markers, register the
+8 tables, run every query in stream order recording per-query wall-clock
+ms, emit the CSV time log (`nds/nds_power.py:294-303` format) and optional
+per-query JSON summaries, and exit non-zero if any query failed
+(`nds-h/nds_h_power.py:296`).
+
+TPU-native differences:
+- "setup tables" = load columnar data host-side and (for the device
+  backend) upload columns to HBM once — the analog of temp-view
+  registration timing (`nds-h/nds_h_power.py` CreateTempView rows).
+- per-query timing brackets the full execute INCLUDING device->host
+  result materialization, with jax async dispatch closed out by
+  materialization itself (results are numpy), so there is no hidden
+  async tail — the reference's df.collect() contract.
+- ``--warmup`` optionally runs each query once before timing to separate
+  XLA compile time from steady-state (reported either way; compile time
+  is part of the benchmark when warmup=0, matching cold Spark JITs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from nds_tpu.engine.session import Session
+from nds_tpu.nds_h import streams
+from nds_tpu.nds_h.schema import get_schemas
+from nds_tpu.utils.report import BenchReport
+from nds_tpu.utils.timelog import TimeLog
+
+
+def load_warehouse(session: Session, data_dir: str, fmt: str = "parquet",
+                   tables: list[str] | None = None) -> dict:
+    """Register every table from a warehouse directory; returns
+    {table: seconds} setup timings (the CreateTempView analog)."""
+    from nds_tpu.io import csv_io
+    schemas = get_schemas()
+    timings = {}
+    for name, schema in schemas.items():
+        if tables is not None and name not in tables:
+            continue
+        t0 = time.perf_counter()
+        tdir = os.path.join(data_dir, name)
+        if fmt == "parquet":
+            if os.path.isdir(tdir):
+                paths = sorted(
+                    os.path.join(tdir, f) for f in os.listdir(tdir)
+                    if f.endswith(".parquet"))
+            else:
+                paths = [os.path.join(data_dir, f"{name}.parquet")]
+            table = csv_io.read_parquet(paths, name, schema)
+        elif fmt == "raw":
+            if os.path.isdir(tdir):
+                paths = sorted(
+                    os.path.join(tdir, f) for f in os.listdir(tdir)
+                    if not f.startswith("."))
+            else:
+                paths = [os.path.join(data_dir, f"{name}.tbl")]
+            table = csv_io.read_tbl(paths, name, schema)
+        else:
+            raise ValueError(f"unknown input format {fmt!r}")
+        session.register_table(table)
+        timings[name] = time.perf_counter() - t0
+    return timings
+
+
+def make_session(backend: str) -> Session:
+    if backend == "tpu":
+        from nds_tpu.engine.device_exec import make_device_factory
+        return Session.for_nds_h(make_device_factory())
+    if backend == "cpu":
+        return Session.for_nds_h()
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def run_one_query(session: Session, sql: str, qname: str = "",
+                  output_prefix: str | None = None):
+    result = session.sql(sql)
+    if result is not None and output_prefix:
+        from nds_tpu.io.result_io import write_result
+        write_result(result, os.path.join(output_prefix, qname))
+    return result
+
+
+def run_query_stream(data_dir: str, stream_path: str, time_log_path: str,
+                     backend: str = "tpu", input_format: str = "parquet",
+                     json_summary_folder: str | None = None,
+                     output_prefix: str | None = None,
+                     warmup: int = 0, keep_sc: bool = False) -> int:
+    """Returns the number of failed queries (the driver exits with it)."""
+    session = make_session(backend)
+    app_id = f"nds-tpu-{backend}-{int(time.time())}"
+    tlog = TimeLog(app_id)
+    total_start = time.perf_counter()
+
+    setup = load_warehouse(session, data_dir, input_format)
+    for tname, secs in setup.items():
+        tlog.add(f"CreateTempView {tname}", int(secs * 1000))
+
+    queries = streams.parse_query_stream(stream_path)
+    if json_summary_folder:
+        os.makedirs(json_summary_folder, exist_ok=True)
+    failures = 0
+    power_start = time.perf_counter()
+    for qname, sql in queries.items():
+        if warmup and not qname.startswith("query15_part"):
+            for _ in range(warmup):
+                try:
+                    run_one_query(session, sql)
+                except Exception:
+                    break
+        report = BenchReport(qname, {"backend": backend})
+        summary = report.report_on(run_one_query, session, sql, qname,
+                                   output_prefix)
+        elapsed_ms = summary["queryTimes"][-1]
+        tlog.add(qname, elapsed_ms)
+        print(f"====== Run {qname} ======")
+        print(f"Time taken: {elapsed_ms} millis for {qname}")
+        if not report.is_success():
+            failures += 1
+        if json_summary_folder:
+            cwd = os.getcwd()
+            os.chdir(json_summary_folder)
+            try:
+                report.write_summary(prefix=f"power-{app_id}")
+            finally:
+                os.chdir(cwd)
+    power_ms = int((time.perf_counter() - power_start) * 1000)
+    tlog.add("Power Test Time", power_ms)
+    total_ms = int((time.perf_counter() - total_start) * 1000)
+    tlog.add("Total Time", total_ms)
+    tlog.write(time_log_path)
+    print(f"Power Test Time: {power_ms} millis")
+    return failures
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="NDS-H power run on the TPU columnar engine")
+    p.add_argument("data_dir", help="warehouse directory (transcode output)")
+    p.add_argument("query_stream", help="stream_N.sql file")
+    p.add_argument("time_log", help="output CSV time log path")
+    p.add_argument("--backend", choices=["tpu", "cpu"], default="tpu",
+                   help="device engine (tpu/jax) or CPU oracle")
+    p.add_argument("--input_format", choices=["parquet", "raw"],
+                   default="parquet")
+    p.add_argument("--json_summary_folder",
+                   help="folder for per-query JSON summaries")
+    p.add_argument("--output_prefix",
+                   help="save each query's result under this directory")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="untimed runs per query before the timed one")
+    args = p.parse_args(argv)
+    failures = run_query_stream(
+        args.data_dir, args.query_stream, args.time_log,
+        backend=args.backend, input_format=args.input_format,
+        json_summary_folder=args.json_summary_folder,
+        output_prefix=args.output_prefix, warmup=args.warmup)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

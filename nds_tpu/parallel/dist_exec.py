@@ -1,0 +1,530 @@
+"""Distributed (multi-chip) plan executor: shard_map over a device mesh.
+
+The reference scales by Spark data parallelism with shuffle exchanges
+delegated to the engine (SURVEY.md §2.6). The TPU-native equivalent here:
+
+- fact tables are ROW-SHARDED across the 1-D mesh axis; dimension tables
+  replicate (classic OLAP DP — the Spark broadcast-join analog);
+- probe-side joins run device-local when the build side is replicated;
+  when BOTH sides are sharded, both repartition by join key through the
+  `exchange` all_to_all so matching keys colocate — shuffle over ICI,
+  the deliverable the survey calls out (§5 "distributed communication
+  backend");
+- grouped aggregation exchanges rows by group-key hash, then aggregates
+  locally: every group lands wholly on one device, so distinct/avg need
+  no merge logic; global aggregates use psum/pmin/pmax;
+- the whole query still compiles to ONE XLA program (shard_map under
+  jit): collectives are inside the program, not host-driven.
+
+Exchange overflow (static bucket exceeded) is counted in-program and
+surfaced; execute() retries once with doubled slack — adaptive, never
+silent (utils.report.TaskFailureCollector records the retry).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P_
+
+from nds_tpu.engine import device_exec as dx
+from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
+from nds_tpu.io.host_table import HostTable
+from nds_tpu.parallel.exchange import exchange
+from nds_tpu.parallel.mesh import DATA_AXIS, make_mesh, pad_to_multiple
+from nds_tpu.sql import plan as P
+from nds_tpu.utils.report import TaskFailureCollector
+
+if hasattr(jax, "shard_map"):  # jax>=0.8
+    _shard_map = jax.shard_map
+else:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(fn, **kw):
+    """shard_map with replication checking off, across jax versions (the
+    kwarg was renamed check_rep -> check_vma)."""
+    import inspect
+    params = inspect.signature(_shard_map).parameters
+    if "check_vma" in params:
+        kw["check_vma"] = False
+    elif "check_rep" in params:
+        kw["check_rep"] = False
+    return _shard_map(fn, **kw)
+
+# tables at or above this row count shard across the mesh; smaller ones
+# replicate (the Spark broadcast threshold analog, but by rows)
+DEFAULT_SHARD_THRESHOLD = 8192
+
+
+class DistributedExecutor(dx.DeviceExecutor):
+    """Session-compatible executor that runs plans SPMD over a mesh."""
+
+    def __init__(self, tables: dict[str, HostTable], mesh=None,
+                 n_devices: int | None = None,
+                 shard_tables: set[str] | None = None,
+                 shard_threshold: int = DEFAULT_SHARD_THRESHOLD,
+                 slack: float = 2.0):
+        super().__init__(tables)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.n_dev = int(np.prod(self.mesh.devices.shape))
+        self._explicit_shard = shard_tables
+        self.shard_threshold = shard_threshold
+        self.slack = slack
+
+    def _is_sharded(self, table: str) -> bool:
+        if self._explicit_shard is not None:
+            return table in self._explicit_shard
+        return self.tables[table].nrows >= self.shard_threshold
+
+    # buffers: sharded tables pad to a multiple of n_dev
+    def _upload(self, bufs: dict, table: str, name: str) -> None:
+        key = f"{table}.{name}"
+        if key not in self._buffers:
+            col = self.tables[table].columns[name]
+            vals = col.values
+            if self._is_sharded(table):
+                cap = pad_to_multiple(max(len(vals), self.n_dev),
+                                      self.n_dev)
+                pad = cap - len(vals)
+                if pad:
+                    vals = np.concatenate(
+                        [vals, np.zeros(pad, dtype=vals.dtype)])
+                if col.null_mask is not None:
+                    m = np.concatenate(
+                        [col.null_mask, np.zeros(pad, dtype=bool)])
+                    self._buffers[key + "#v"] = jnp.asarray(m)
+            elif col.null_mask is not None:
+                self._buffers[key + "#v"] = jnp.asarray(col.null_mask)
+            self._buffers[key] = jnp.asarray(vals)
+        bufs[key] = self._buffers[key]
+        if key + "#v" in self._buffers:
+            bufs[key + "#v"] = self._buffers[key + "#v"]
+
+    def _compile(self, planned: P.PlannedQuery):
+        side = {}
+
+        def make(slack):
+            def fn(shard_bufs, repl_bufs):
+                tr = _DistTrace(self, {**shard_bufs, **repl_bufs}, slack)
+                row, outs, dicts = tr.run_query(planned)
+                side["dicts"] = dicts
+                overflow = tr.total_overflow()
+                return row, outs, overflow
+            return fn
+
+        def build(slack):
+            sharded_keys, repl_keys = self._split_keys(planned)
+            wrapped = shard_map(
+                make(slack), mesh=self.mesh,
+                in_specs=({k: P_(DATA_AXIS) for k in sharded_keys},
+                          {k: P_() for k in repl_keys}),
+                out_specs=P_())
+            return jax.jit(wrapped), sharded_keys, repl_keys
+
+        return build, side
+
+    def _split_keys(self, planned):
+        bufs = self._collect_buffers(planned)
+        sharded, repl = [], []
+        for k in bufs:
+            table = k.split(".", 1)[0]
+            (sharded if self._is_sharded(table) else repl).append(k)
+        return sharded, repl
+
+    def execute(self, planned: P.PlannedQuery, key: object = None):
+        key = key if key is not None else id(planned)
+        if key not in self._compiled:
+            # strong ref to the plan object, same rationale as the base
+            self._compiled[key] = (self._compile(planned), {}, planned)
+        (build, side), state, _ref = self._compiled[key]
+        slack = state.get("slack", self.slack)
+        for attempt in range(3):
+            if "jitted" not in state or state.get("slack") != slack:
+                state["jitted"], state["sk"], state["rk"] = build(slack)
+                state["slack"] = slack
+            bufs = self._collect_buffers(planned)
+            shard_bufs = {k: bufs[k] for k in state["sk"]}
+            repl_bufs = {k: bufs[k] for k in state["rk"]}
+            row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
+            if int(overflow) == 0:
+                return self._materialize(planned, row, outs, side)
+            TaskFailureCollector.notify(
+                f"exchange overflow ({int(overflow)} rows) at slack="
+                f"{slack}; retrying with slack={slack * 2}")
+            slack = slack * 2
+        raise DeviceExecError("exchange overflow persisted after retries")
+
+
+class _DistTrace(dx._Trace):
+    def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float):
+        super().__init__(ex, bufs)
+        self.n_dev = ex.n_dev
+        self.slack = slack
+        self._overflows: list = []
+
+    def total_overflow(self):
+        if not self._overflows:
+            return jnp.zeros((), jnp.int64)
+        tot = self._overflows[0]
+        for o in self._overflows[1:]:
+            tot = tot + o
+        # every device sees every exchange; max across devices is enough
+        return lax.pmax(tot.astype(jnp.int64), DATA_AXIS)
+
+    # ------------------------------------------------------------- helpers
+
+    def _replicate(self, ctx: DCtx) -> DCtx:
+        if not getattr(ctx, "sharded", False):
+            return ctx
+        n = ctx.n * self.n_dev
+        out = DCtx(n, lax.all_gather(ctx.row, DATA_AXIS, tiled=True))
+        for k, dv in ctx.cols.items():
+            arr = lax.all_gather(dv.arr, DATA_AXIS, tiled=True)
+            valid = (None if dv.valid is None
+                     else lax.all_gather(dv.valid, DATA_AXIS, tiled=True))
+            out.cols[k] = dv.with_arrays(arr, valid)
+        out.sharded = False
+        return out
+
+    def _exchange_ctx(self, ctx: DCtx, key, kok) -> tuple[DCtx, object]:
+        """Repartition a sharded ctx by an int64 key; returns (ctx', key')
+        both with capacity ctx.n * slack (rows colocated by key hash)."""
+        names = list(ctx.cols)
+        arrays = [ctx.cols[k].arr for k in names]
+        valids = [ctx.cols[k].valid for k in names]
+        vmask = [v is not None for v in valids]
+        payload = arrays + [v for v in valids if v is not None] + [key]
+        ok = ctx.row & kok
+        outs, out_ok, n_over = exchange(payload, key, ok, self.n_dev,
+                                        self.slack)
+        self._overflows.append(n_over)
+        out_arrays = outs[:len(names)]
+        vout = outs[len(names):-1]
+        out_key = outs[-1]
+        new = DCtx(out_ok.shape[0], out_ok)
+        vi = 0
+        for i, k in enumerate(names):
+            dv = ctx.cols[k]
+            valid = None
+            if vmask[i]:
+                valid = vout[vi]
+                vi += 1
+            new.cols[k] = dv.with_arrays(out_arrays[i], valid)
+        new.sharded = True
+        return new, out_key
+
+    def _key_of(self, ctx: DCtx, exprs) -> tuple:
+        """Pack a list of key exprs into one int64 per row (bounds
+        required beyond the first key), plus validity."""
+        vals = [self.eval(e, ctx) for e in exprs]
+        ok = ctx.row
+        for v in vals:
+            ok = _ok(v, ok)
+        if len(vals) == 1:
+            return vals[0].arr.astype(jnp.int64), ok
+        parts = []
+        widths = []
+        for v in vals:
+            lo, hi = v.lo, v.hi
+            if v.sdict is not None:
+                lo, hi = 0, max(len(v.sdict) - 1, 0)
+            if lo is None or hi is None:
+                raise DeviceExecError("cannot pack key without bounds")
+            parts.append((v.arr, lo, hi))
+            widths.append(max((hi - lo).bit_length(), 1))
+        if sum(widths) > 62:
+            raise DeviceExecError("distributed key too wide")
+        acc = None
+        for (arr, lo, hi), w in zip(parts, widths):
+            norm = jnp.clip(arr.astype(jnp.int64) - lo, 0, hi - lo)
+            acc = norm if acc is None else ((acc << w) | norm)
+        return acc, ok
+
+    # ---------------------------------------------------------- plan nodes
+
+    def _run_scan(self, node: P.Scan) -> DCtx:
+        if not self.ex._is_sharded(node.table):
+            ctx = super()._run_scan(node)
+            ctx.sharded = False
+            return ctx
+        t = self.ex.tables[node.table]
+        cap = pad_to_multiple(max(t.nrows, self.n_dev), self.n_dev)
+        local = cap // self.n_dev
+        gidx = (lax.axis_index(DATA_AXIS).astype(jnp.int64) * local
+                + jnp.arange(local))
+        ctx = DCtx(local, gidx < t.nrows)
+        ctx.sharded = True
+        for name, _dt in node.output:
+            col = t.columns[name]
+            arr = self.bufs[f"{node.table}.{name}"]
+            valid = self.bufs.get(f"{node.table}.{name}#v")
+            lo, hi = self.ex.col_bounds(node.table, name)
+            sdict = col.dictionary if col.is_string else None
+            ctx.cols[(node.binding, name)] = DVal(arr, valid, sdict, lo, hi)
+        for pred in node.filters:
+            ctx2 = self._apply_filter(ctx, pred)
+            ctx2.sharded = True
+            ctx = ctx2
+        return ctx
+
+    def _run_derivedscan(self, node: P.DerivedScan) -> DCtx:
+        ctx = super()._run_derivedscan(node)
+        ctx.sharded = getattr(self.run(node.child), "sharded", False)
+        return ctx
+
+    def _run_filter(self, node: P.Filter) -> DCtx:
+        child = self.run(node.child)
+        ctx = self._apply_filter(child, node.predicate)
+        ctx.sharded = getattr(child, "sharded", False)
+        return ctx
+
+    def _run_project(self, node: P.Project) -> DCtx:
+        child = self.run(node.child)
+        ctx = super()._run_project(node)
+        ctx.sharded = getattr(child, "sharded", False)
+        return ctx
+
+    def _run_join(self, node: P.Join) -> DCtx:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        ls = getattr(lctx, "sharded", False)
+        rs = getattr(rctx, "sharded", False)
+        if not node.left_keys:
+            out = self._cross_replicated(node, lctx, rctx, ls, rs)
+            return out
+        if node.right_unique:
+            probe_sharded = ls
+            if rs and ls:
+                # both sharded: colocate by join key over ICI. Keys must
+                # be packed with PAIR-aligned bounds/dictionaries (the
+                # single-device _align_pair rules) or identical logical
+                # keys would hash differently per side
+                lkey, lok, rkey, rok = self._join_key_arrays(
+                    [self.eval(k, lctx) for k in node.left_keys],
+                    [self.eval(k, rctx) for k in node.right_keys],
+                    lctx, rctx)
+                lctx, _lk = self._exchange_ctx(lctx, lkey, lok)
+                rctx, _rk = self._exchange_ctx(rctx, rkey, rok)
+            elif rs:
+                rctx = self._replicate(rctx)
+            out = self._join_cached(node, lctx, rctx)
+            out.sharded = probe_sharded
+            return out
+        # probe side is the right: left must be visible in full
+        if ls and rs:
+            lkey, lok, rkey, rok = self._join_key_arrays(
+                [self.eval(k, lctx) for k in node.left_keys],
+                [self.eval(k, rctx) for k in node.right_keys],
+                lctx, rctx)
+            lctx, _ = self._exchange_ctx(lctx, lkey, lok)
+            rctx, _ = self._exchange_ctx(rctx, rkey, rok)
+            # after the exchange all matches are device-local, so the
+            # base expanding join (incl. left-outer block B) is exact:
+            # exchanged shards are disjoint across devices
+            out = self._join_cached(node, lctx, rctx)
+            out.sharded = True
+            return out
+        if ls:
+            lctx = self._replicate(lctx)
+        if rs and node.kind == "left":
+            # left outer with replicated left + sharded right: the base
+            # join computes 'matched' per device, so a left row matched
+            # only on another device would ALSO null-extend from every
+            # device's block B (duplicates). Replicate the right side —
+            # correctness over memory until a pmax-matched path lands.
+            rctx = self._replicate(rctx)
+            rs = False
+        out = self._join_cached(node, lctx, rctx)
+        out.sharded = rs
+        return out
+
+    def _join_cached(self, node, lctx, rctx):
+        """Run the single-device join logic on prepared child contexts."""
+        self._cache[id(node.left)] = lctx
+        self._cache[id(node.right)] = rctx
+        self._cache.pop(id(node), None)
+        return super()._run_join(node)
+
+    def _cross_replicated(self, node, lctx, rctx, ls, rs):
+        lctx = self._replicate(lctx) if ls else lctx
+        rctx = self._replicate(rctx) if rs else rctx
+        self._cache[id(node.left)] = lctx
+        self._cache[id(node.right)] = rctx
+        out = self._cross_join(node, lctx, rctx)
+        out.sharded = False
+        return out
+
+    def _run_semijoin(self, node: P.SemiJoin) -> DCtx:
+        lctx, rctx = self.run(node.left), self.run(node.right)
+        ls = getattr(lctx, "sharded", False)
+        if getattr(rctx, "sharded", False):
+            rctx = self._replicate(rctx)
+        self._cache[id(node.left)] = lctx
+        self._cache[id(node.right)] = rctx
+        self._cache.pop(id(node), None)
+        out = super()._run_semijoin(node)
+        out.sharded = ls
+        return out
+
+    def _run_aggregate(self, node: P.Aggregate) -> DCtx:
+        ctx = self.run(node.child)
+        if not getattr(ctx, "sharded", False):
+            out = super()._run_aggregate(node)
+            out.sharded = False
+            return out
+        if not node.group_keys:
+            return self._global_agg_sharded(node, ctx)
+        # repartition by group key so each group is wholly local, then the
+        # single-device aggregate is exact (distinct/avg included)
+        try:
+            key, kok = self._key_of(ctx, [e for _, e in node.group_keys])
+        except DeviceExecError:
+            self._cache[id(node.child)] = self._replicate(ctx)
+            self._cache.pop(id(node), None)
+            out = super()._run_aggregate(node)
+            out.sharded = False
+            return out
+        # NULL group keys: kok False would keep rows home — fine, they
+        # still form their own (local) group only if all-null; TPC group
+        # keys are non-null so route by key, keep row presence as-is
+        new, _ = self._exchange_ctx(ctx, key, ctx.row)
+        self._cache[id(node.child)] = new
+        self._cache.pop(id(node), None)
+        out = super()._run_aggregate(node)
+        out.sharded = True
+        return out
+
+    def _global_agg_sharded(self, node: P.Aggregate, ctx: DCtx) -> DCtx:
+        b = node.binding
+        if any(spec.distinct for _, spec in node.aggs):
+            self._cache[id(node.child)] = self._replicate(ctx)
+            self._cache.pop(id(node), None)
+            out = super()._run_aggregate(node)
+            out.sharded = False
+            return out
+        out = DCtx(1, jnp.ones(1, dtype=bool))
+        out.sharded = False
+        for name, spec in node.aggs:
+            arr, valid, sdict = self._psum_agg(spec, ctx)
+            out.cols[(b, name)] = DVal(arr, valid, sdict)
+        return out
+
+    def _psum_agg(self, spec: P.AggSpec, ctx: DCtx):
+        import jax.numpy as jnp
+        from nds_tpu.engine.device_exec import I64_MAX, I64_MIN, _to_float
+        from nds_tpu.engine.types import FloatType
+        dv = self._agg_arg(spec, ctx)
+        if spec.func == "count" and dv is None:
+            cnt = lax.psum(jnp.sum(ctx.row), DATA_AXIS)
+            return cnt.reshape(1).astype(jnp.int64), jnp.ones(1, bool), None
+        w = _ok(dv, ctx.row)
+        cnt = lax.psum(jnp.sum(w), DATA_AXIS)
+        valid = (cnt > 0).reshape(1)
+        if spec.func == "count":
+            return cnt.reshape(1).astype(jnp.int64), jnp.ones(1, bool), None
+        if spec.func == "sum":
+            if isinstance(spec.dtype, FloatType):
+                s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.float64), 0.0))
+            else:
+                s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.int64), 0))
+            return lax.psum(s, DATA_AXIS).reshape(1), valid, None
+        if spec.func == "avg":
+            f = _to_float(dv.arr, spec.arg.dtype)
+            s = lax.psum(jnp.sum(jnp.where(w, f, 0.0)), DATA_AXIS)
+            return (s / jnp.maximum(cnt, 1)).reshape(1), valid, None
+        if spec.func in ("min", "max"):
+            isf = jnp.issubdtype(dv.arr.dtype, jnp.floating)
+            if isf:
+                fill = jnp.inf if spec.func == "min" else -jnp.inf
+                masked = jnp.where(w, dv.arr, fill)
+            else:
+                fill = I64_MAX if spec.func == "min" else I64_MIN
+                masked = jnp.where(w, dv.arr.astype(jnp.int64), fill)
+            red = jnp.min(masked) if spec.func == "min" else jnp.max(masked)
+            red = (lax.pmin(red, DATA_AXIS) if spec.func == "min"
+                   else lax.pmax(red, DATA_AXIS))
+            return red.reshape(1), valid, dv.sdict
+        raise DeviceExecError(spec.func)
+
+    def _run_sort(self, node: P.Sort) -> DCtx:
+        child = self.run(node.child)
+        if getattr(child, "sharded", False):
+            self._cache[id(node.child)] = self._replicate(child)
+            self._cache.pop(id(node), None)
+        out = super()._run_sort(node)
+        out.sharded = False
+        return out
+
+    def _run_limit(self, node: P.Limit) -> DCtx:
+        child = self.run(node.child)
+        if getattr(child, "sharded", False):
+            self._cache[id(node.child)] = self._replicate(child)
+            self._cache.pop(id(node), None)
+        out = super()._run_limit(node)
+        out.sharded = False
+        return out
+
+    def _run_distinct(self, node: P.Distinct) -> DCtx:
+        child = self.run(node.child)
+        if getattr(child, "sharded", False):
+            self._cache[id(node.child)] = self._replicate(child)
+            self._cache.pop(id(node), None)
+        out = super()._run_distinct(node)
+        out.sharded = False
+        return out
+
+    def _run_setop(self, node: P.SetOp) -> DCtx:
+        for side in (node.left, node.right):
+            c = self.run(side)
+            if getattr(c, "sharded", False):
+                self._cache[id(side)] = self._replicate(c)
+        self._cache.pop(id(node), None)
+        out = super()._run_setop(node)
+        out.sharded = False
+        return out
+
+    def run_query(self, planned: P.PlannedQuery):
+        for i, sub in enumerate(planned.scalar_subplans):
+            ctx = self._replicate(self.run(sub))
+            self._cache[id(sub)] = ctx
+            name, dt = sub.output[0]
+            dv = ctx.cols[(sub.binding, name)]
+            pos = jnp.argmax(ctx.row)
+            v = dv.arr[pos]
+            ok = ctx.row[pos]
+            if dv.valid is not None:
+                ok = ok & dv.valid[pos]
+            self.scalars[i] = (v, ok, dv.sdict, dt)
+        ctx = self._replicate(self.run(planned.root))
+        root = planned.root
+        outs, dicts = [], []
+        for name, _dt in root.output:
+            dv = ctx.cols[(root.binding, name)]
+            valid = dv.valid if dv.valid is not None else jnp.ones(
+                ctx.n, dtype=bool)
+            outs.append((dv.arr, valid))
+            dicts.append(dv.sdict)
+        return ctx.row, outs, dicts
+
+
+def make_distributed_factory(mesh=None, n_devices=None,
+                             shard_tables=None,
+                             shard_threshold=DEFAULT_SHARD_THRESHOLD):
+    """Session executor factory for the distributed engine (one executor
+    per table registry, like `device_exec.make_device_factory`)."""
+    holder: dict = {}
+
+    def factory(tables):
+        ex = holder.get("ex")
+        if ex is None or ex.tables is not tables:
+            ex = DistributedExecutor(
+                tables, mesh=mesh, n_devices=n_devices,
+                shard_tables=shard_tables,
+                shard_threshold=shard_threshold)
+            holder["ex"] = ex
+        return ex
+
+    return factory

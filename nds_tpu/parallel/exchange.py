@@ -1,0 +1,83 @@
+"""Hash-partition exchange over ICI: the engine's shuffle operator.
+
+This is the component the reference leaves entirely to Spark's
+block-based shuffle (config-only, `spark.sql.shuffle.partitions`,
+SURVEY.md §2.6): here it is first-class and TPU-native — rows hash to a
+destination device and move in ONE `lax.all_to_all` across the mesh axis
+(ICI within a pod, DCN across slices; XLA picks the transport).
+
+Static-shape contract: each device sends a fixed-capacity bucket of
+``ceil(local_rows / n_dev * slack)`` rows to every peer. Hash
+partitioning spreads keys uniformly, so slack=2 covers real skew; rows
+that overflow a bucket are dropped AND counted — the executor surfaces
+the count so the host can retry with a bigger slack (adaptive, one
+recompile, never silent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nds_tpu.parallel.mesh import DATA_AXIS
+
+
+def _mix64(x):
+    """splitmix64 finalizer: avalanche int64 keys before bucketing (raw
+    TPC keys are sequential — modulo alone would stripe, not spread)."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> 31)
+    return x
+
+
+def exchange(arrays: list, key, ok, n_dev: int, slack: float = 2.0,
+             axis: str = DATA_AXIS):
+    """Repartition rows by hash(key) across the mesh axis.
+
+    arrays: per-row payload arrays (local shard). key: int64 per row.
+    ok: bool per row (invalid rows don't travel).
+    Returns (out_arrays, out_ok, overflow_count) where out_* have
+    capacity n_dev * bucket ( = local_n * slack rounded up).
+    """
+    n = key.shape[0]
+    bucket = max(1, int(-(-n * slack // n_dev)))
+    dest = (_mix64(key) % jnp.uint64(n_dev)).astype(jnp.int32)
+    # dead rows get a sentinel dest PAST every real bucket so they never
+    # consume rank slots (a heavily filtered shard must not overflow its
+    # own bucket with corpses)
+    dest = jnp.where(ok, dest, jnp.int32(n_dev))
+    # stable-group rows by destination
+    order = jnp.argsort(dest)
+    dest_s = jnp.take(dest, order)
+    ok_s = jnp.take(ok, order)
+    iota = jnp.arange(n)
+    first_of_dest = jnp.searchsorted(dest_s, jnp.arange(n_dev))
+    rank = iota - jnp.take(first_of_dest,
+                           jnp.clip(dest_s, 0, n_dev - 1))
+    overflow = ok_s & (rank >= bucket)
+    n_overflow = jnp.sum(overflow)
+    keep = ok_s & (rank < bucket)
+    # kept rows get unique slots; everything else lands in a trash slot
+    # past the buffer (sliced off below) so it can't clobber a kept row
+    trash = n_dev * bucket
+    slot = jnp.where(keep, dest_s * bucket + jnp.clip(rank, 0, bucket - 1),
+                     trash)
+
+    def scatter(vals_sorted, fill):
+        buf = jnp.full((n_dev * bucket + 1,), fill, dtype=vals_sorted.dtype)
+        return buf.at[slot].set(vals_sorted)[:-1]
+
+    send_ok = jnp.zeros((n_dev * bucket + 1,), dtype=bool).at[slot].set(
+        keep)[:-1]
+    out_ok = lax.all_to_all(
+        send_ok.reshape(n_dev, bucket), axis, 0, 0).reshape(-1)
+    outs = []
+    for a in arrays:
+        a_s = jnp.take(a, order, axis=0)
+        sent = scatter(a_s, jnp.zeros((), a.dtype))
+        outs.append(lax.all_to_all(
+            sent.reshape(n_dev, bucket), axis, 0, 0).reshape(-1))
+    return outs, out_ok, n_overflow

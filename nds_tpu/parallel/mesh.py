@@ -1,0 +1,32 @@
+"""Device mesh helpers.
+
+The reference's executor topology is fixed Spark config
+(`nds/base.template:29-31`); ours is a jax.sharding.Mesh. The benchmark
+workload is data-parallel over rows with explicit exchanges, so the mesh
+is 1-D ("d"); multi-host TPU slices extend the same axis over DCN —
+collectives are inserted by XLA per the sharding, not hand-coded
+(SURVEY.md §2.6 TPU-native mapping).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

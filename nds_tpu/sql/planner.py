@@ -673,14 +673,25 @@ class Planner:
                 # disconnected: cross join the smallest remaining
                 nxt = min(remaining.values(), key=lambda r: r.size)
                 keys = ([], [])
+                right_unique = False
             else:
-                nxt = min((remaining[b] for b in cand), key=lambda r: r.size)
-                pairs = cand[nxt.binding]
+                # prefer candidates UNIQUE on their join keys, then by
+                # size: a unique build side makes every join a
+                # key-preserving gather join on the device engine (no row
+                # expansion, static output shape); joining a non-unique
+                # side early (q5's customer-via-nationkey edge) would
+                # force an expanding join the TPU plan can't bound
+                def _uniq(b: str) -> bool:
+                    r = remaining[b]
+                    names = {k.name for _lk, k in cand[b]
+                             if isinstance(k, ir.ColRef)}
+                    return bool(r.unique_on) and set(r.unique_on) <= names
+                best = min(cand, key=lambda b: (not _uniq(b),
+                                                remaining[b].size))
+                nxt = remaining[best]
+                pairs = cand[best]
                 keys = ([p[0] for p in pairs], [p[1] for p in pairs])
-            right_unique = (bool(nxt.unique_on) and
-                            set(nxt.unique_on) <= {
-                                k.name for k in keys[1]
-                                if isinstance(k, ir.ColRef)})
+                right_unique = _uniq(best)
             current = P.Join("inner", current, nxt.node, keys[0], keys[1],
                              None, right_unique,
                              output=current.output + nxt.node.output,

@@ -10,9 +10,17 @@ Must run before any jax import — pytest imports conftest first.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, don't setdefault: the ambient environment may point JAX at a
+# remote TPU tunnel (axon); tests must run on the local virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+# the environment's sitecustomize can override jax_platforms back to the
+# remote TPU plugin after import — pin the config itself to cpu
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
