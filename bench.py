@@ -36,7 +36,7 @@ def _gen_data():
             for t in schemas}
 
 
-def _power_run(session, timed: bool = True, warmup: int = 1):
+def _power_run(session, label: str, warmup: int = 1):
     from nds_tpu.nds_h import streams
     times = {}
     for qn in range(1, 23):
@@ -50,6 +50,8 @@ def _power_run(session, timed: bool = True, warmup: int = 1):
         for s in stmts:
             session.sql(s)
         times[qn] = time.perf_counter() - t0
+        print(f"[bench] {label} q{qn}: {times[qn]*1000:.0f} ms",
+              file=sys.stderr, flush=True)
     return times
 
 
@@ -57,19 +59,24 @@ def main() -> None:
     from nds_tpu.engine.device_exec import make_device_factory
     from nds_tpu.engine.session import Session
 
+    print(f"[bench] generating SF{SF:g} data...", file=sys.stderr,
+          flush=True)
     tables = _gen_data()
 
+    import jax
+    print(f"[bench] backend: {jax.default_backend()} {jax.devices()}",
+          file=sys.stderr, flush=True)
     dev = Session.for_nds_h(make_device_factory())
     for t in tables.values():
         dev.register_table(t)
     # q15 creates/drops a view per pass; warmup handled inside _power_run
-    dev_times = _power_run(dev, warmup=1)
+    dev_times = _power_run(dev, "tpu", warmup=1)
     dev_total = sum(dev_times.values())
 
     cpu = Session.for_nds_h()
     for t in tables.values():
         cpu.register_table(t)
-    cpu_times = _power_run(cpu, warmup=0)
+    cpu_times = _power_run(cpu, "cpu-oracle", warmup=0)
     cpu_total = sum(cpu_times.values())
 
     result = {

@@ -475,6 +475,9 @@ class CpuExecutor:
             return ctx.cols[(e.binding, e.name)], ctx.valid.get(
                 (e.binding, e.name))
         if isinstance(e, ir.Lit):
+            if e.value is None:  # NULL literal: value 0, nothing valid
+                return (np.zeros(ctx.nrows, dtype=np.int64),
+                        np.zeros(ctx.nrows, dtype=bool))
             return np.full(ctx.nrows, e.value), None
         if isinstance(e, ir.ScalarRef):
             v, _ = self.scalars[e.plan_id]
@@ -504,17 +507,18 @@ class CpuExecutor:
             a, v = self.eval(e.operand, ctx)
             return -a, v
         if isinstance(e, ir.CaseIR):
-            conds, vals = [], []
+            conds, vals, bvalids = [], [], []
             for c, v in e.whens:
                 ca, cv = self.eval(c, ctx)
-                va, _vv = self.eval(v, ctx)
+                va, vv = self.eval(v, ctx)
                 conds.append(ca.astype(bool) if cv is None
                              else (ca.astype(bool) & cv))
                 vals.append(self._coerce(va, v.dtype, e.dtype))
+                bvalids.append(vv)
             if e.else_ is not None:
-                ea, _ev = self.eval(e.else_, ctx)
+                ea, ev = self.eval(e.else_, ctx)
                 default = self._coerce(ea, e.else_.dtype, e.dtype)
-                valid = None
+                default_valid = ev
             else:
                 # CASE with no ELSE: rows matching no branch are NULL
                 if isinstance(e.dtype, FloatType):
@@ -523,7 +527,15 @@ class CpuExecutor:
                     default = np.full(ctx.nrows, "", dtype=object)
                 else:
                     default = np.zeros(ctx.nrows, dtype=np.int64)
-                valid = np.logical_or.reduce(conds)
+                default_valid = np.zeros(ctx.nrows, dtype=bool)
+            # result validity follows the SELECTED branch's validity
+            if default_valid is None and all(v is None for v in bvalids):
+                valid = None
+            else:
+                ones = np.ones(ctx.nrows, dtype=bool)
+                valid = ones if default_valid is None else default_valid
+                for c, bv in zip(reversed(conds), reversed(bvalids)):
+                    valid = np.where(c, ones if bv is None else bv, valid)
             return np.select(conds, vals, default=default), valid
         if isinstance(e, ir.LikeIR):
             a, v = self.eval(e.operand, ctx)

@@ -166,6 +166,18 @@ def _epoch_days_to_civil(days):
     return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
 
 
+def _narrow_key(dv: DVal):
+    """int64 sort keys whose host bounds fit int32 narrow to int32 —
+    TPU sorts i32 natively but emulates s64. Negation headroom (for
+    descending keys) is why the bound check excludes INT32_MIN."""
+    arr = dv.arr
+    if (arr.dtype == jnp.int64 and dv.lo is not None
+            and dv.hi is not None and -2**31 < dv.lo
+            and dv.hi < 2**31 - 1):
+        return arr.astype(jnp.int32)
+    return arr
+
+
 def _plan_bindings(node: P.Node) -> set:
     """All binding names produced anywhere inside a plan subtree."""
     out = set()
@@ -384,8 +396,13 @@ class _Trace:
         if len(lvals) == 1 and lvals[0].sdict is None \
                 and rvals[0].sdict is None:
             lv, rv = lvals[0], rvals[0]
-            return (lv.arr.astype(jnp.int64), _ok(lv, lok),
-                    rv.arr.astype(jnp.int64), _ok(rv, rok))
+            lk, rk = lv.arr.astype(jnp.int64), rv.arr.astype(jnp.int64)
+            # int32 keys sort/search natively on TPU; int64 is emulated
+            if (lv.lo is not None and rv.lo is not None
+                    and min(lv.lo, rv.lo) > -2**31
+                    and max(lv.hi, rv.hi) < 2**31 - 1):
+                lk, rk = lk.astype(jnp.int32), rk.astype(jnp.int32)
+            return lk, _ok(lv, lok), rk, _ok(rv, rok)
         lks, rks, widths = [], [], []
         for lv, rv in zip(lvals, rvals):
             la, ra, lo, hi = self._align_pair(lv, rv)
@@ -400,6 +417,9 @@ class _Trace:
                 f"join key too wide to pack: {widths} bits")
         lkey = self._pack(lks, widths)
         rkey = self._pack(rks, widths)
+        if sum(widths) <= 30:
+            lkey = lkey.astype(jnp.int32)
+            rkey = rkey.astype(jnp.int32)
         return lkey, lok, rkey, rok
 
     @staticmethod
@@ -436,7 +456,8 @@ class _Trace:
     @staticmethod
     def _build_lookup(key, ok):
         """Sort build keys (invalid rows to the sentinel end)."""
-        k = jnp.where(ok, key, I64_MAX)
+        sentinel = jnp.iinfo(key.dtype).max
+        k = jnp.where(ok, key, sentinel)
         order = jnp.argsort(k)
         return jnp.take(k, order), order
 
@@ -580,14 +601,17 @@ class _Trace:
         lcol = self.eval(l_ir, lctx)
         rcol = self.eval(r_ir, rctx)
         # count of right rows per key
-        ks = jnp.sort(jnp.where(rok, rkey, I64_MAX))
+        sent = jnp.iinfo(rkey.dtype).max
+        ks = jnp.sort(jnp.where(rok, rkey, sent))
         c_all = (jnp.searchsorted(ks, lkey, side="right")
                  - jnp.searchsorted(ks, lkey, side="left"))
         # count of right rows per (key, col)
         la, ra, lo, hi = self._align_pair(lcol, rcol)
         w = max((hi - lo).bit_length(), 1)
-        lkey2 = (lkey << w) | jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo)
-        rkey2 = (rkey << w) | jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo)
+        lkey2 = ((lkey.astype(jnp.int64) << w)
+                 | jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo))
+        rkey2 = ((rkey.astype(jnp.int64) << w)
+                 | jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo))
         lok2 = _ok(lcol, lok)
         rok2 = _ok(rcol, rok)
         ks2 = jnp.sort(jnp.where(rok2, rkey2, I64_MAX))
@@ -609,7 +633,8 @@ class _Trace:
             return out
         keyvals = [self.eval(e, ctx) for _, e in node.group_keys]
         perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
-        G = ctx.n
+        G = self._group_capacity(ctx.n, keyvals)
+        gid = jnp.minimum(gid, G - 1)
         out_row = jnp.arange(G) < ngroups
         out = DCtx(G, out_row)
         # representative (first) sorted position per group
@@ -648,6 +673,26 @@ class _Trace:
             return min(0, dv.lo) * ctx.n, max(0, dv.hi) * ctx.n
         return None, None
 
+    @staticmethod
+    def _group_capacity(n: int, keyvals) -> int:
+        """Static bound on distinct groups: min(rows, product of key
+        domains). Collapses the post-aggregation capacity for
+        small-domain keys (q1: returnflag x linestatus -> ~6 slots
+        instead of the scan's millions), which shrinks every downstream
+        sort — the big TPU win since s64 sorts are emulated."""
+        prod = 1
+        for kv in keyvals:
+            if kv.sdict is not None:
+                dom = max(len(kv.sdict), 1)
+            elif kv.lo is not None and kv.hi is not None:
+                dom = max(int(kv.hi) - int(kv.lo) + 1, 1)
+            else:
+                return n
+            prod *= dom
+            if prod >= n:
+                return n
+        return max(min(prod, n), 1)
+
     def _group_ids(self, ctx: DCtx, keyvals):
         """Stable sort rows by (presence, key validity+values...); returns
         (perm, gid per sorted row, first-flag, presence per sorted row,
@@ -660,8 +705,9 @@ class _Trace:
                 vop = jnp.where(kv.valid, 0, 1).astype(jnp.int32)
                 ops.append(vop)
                 key_ops.append(len(ops) - 1)
-            filled = jnp.where(_ok(kv, ctx.row), kv.arr,
-                               jnp.zeros((), dtype=kv.arr.dtype))
+            arr = _narrow_key(kv)
+            filled = jnp.where(_ok(kv, ctx.row), arr,
+                               jnp.zeros((), dtype=arr.dtype))
             ops.append(filled)
             key_ops.append(len(ops) - 1)
         ops.append(jnp.arange(n))
@@ -814,12 +860,15 @@ class _Trace:
                 rank = jnp.where(dv.valid, 1, 0) if nulls_first \
                     else jnp.where(dv.valid, 0, 1)
                 ops.append(rank.astype(jnp.int32))
-            arr = dv.arr
+            arr = _narrow_key(dv)
             if jnp.issubdtype(arr.dtype, jnp.bool_):
                 arr = arr.astype(jnp.int32)
-            key = arr if asc else -arr.astype(
-                jnp.float64 if jnp.issubdtype(arr.dtype, jnp.floating)
-                else jnp.int64)
+            if asc:
+                key = arr
+            elif jnp.issubdtype(arr.dtype, jnp.floating):
+                key = -arr.astype(jnp.float64)
+            else:
+                key = -arr  # negation stays in range: bounds checked
             if dv.valid is not None:
                 key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
             ops.append(key)

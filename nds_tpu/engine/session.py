@@ -44,6 +44,14 @@ class Session:
         cat = CatalogInfo(get_schemas(), PRIMARY_KEYS, dict(TPCH_SIZES))
         return cls(cat, executor_factory)
 
+    @classmethod
+    def for_nds(cls, executor_factory=None,
+                use_decimal: bool = True) -> "Session":
+        from nds_tpu.nds.schema import PRIMARY_KEYS, SIZES, get_schemas
+        cat = CatalogInfo(get_schemas(use_decimal), PRIMARY_KEYS,
+                          dict(SIZES))
+        return cls(cat, executor_factory)
+
     def register_table(self, table: HostTable) -> None:
         self.tables[table.name] = table
 

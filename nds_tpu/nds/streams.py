@@ -1,0 +1,139 @@
+"""NDS (TPC-DS) query + stream generation and stream parsing.
+
+Counterpart of the reference's dsqgen wrapper
+(`nds/nds_gen_query_stream.py:42-103`): renders query templates with
+substitution parameters and emits permuted streams, each query framed by
+the dsqgen-style marker the power driver parses
+(`-- start query N in stream S using template queryNN.tpl`, parsed by
+`nds/nds_power.py:50-77`). Two-statement templates (q14/23/24/39 in the
+full set) split into _part1/_part2 the same way
+(`nds/nds_gen_query_stream.py:91-103`).
+
+Template coverage grows with the engine; TEMPLATES lists what is
+implemented so stream generation and the orchestrator agree on the set.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+from collections import OrderedDict
+
+TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "query_templates")
+
+
+def available_templates() -> list[int]:
+    out = []
+    for f in os.listdir(TEMPLATE_DIR):
+        m = re.match(r"q(\d+)\.sql$", f)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+# qualification substitution parameters (spec-shaped defaults bound to
+# the builtin generator's value domains)
+QUALIFICATION: dict[int, dict] = {
+    3: {"manufact": 128, "month": 11},
+    7: {"gender": "M", "marital": "S", "education": "College",
+        "year": 2000},
+    9: {"t1": 3000, "t2": 3000, "t3": 3000, "t4": 3000, "t5": 3000},
+    13: {"year": 2001, "ms1": "M", "es1": "Advanced Degree",
+         "ms2": "S", "es2": "College", "ms3": "W", "es3": "2 yr Degree",
+         "s1": "TX", "s2": "OH", "s3": "TX", "s4": "OR", "s5": "NM",
+         "s6": "KY", "s7": "VA", "s8": "TX", "s9": "MS"},
+    15: {"qoy": 2, "year": 2001},
+    19: {"manager": 8, "month": 11, "year": 1998},
+    26: {"gender": "M", "marital": "S", "education": "College",
+         "year": 2000},
+    42: {"month": 11, "year": 2000},
+    43: {"gmt": -5, "year": 2000},
+    48: {"year": 2000, "ms1": "M", "es1": "4 yr Degree", "ms2": "D",
+         "es2": "2 yr Degree", "ms3": "S", "es3": "College",
+         "s1": "TX", "s2": "OH", "s3": "TX", "s4": "OR", "s5": "NM",
+         "s6": "KY", "s7": "VA", "s8": "TX", "s9": "MS"},
+    52: {"month": 11, "year": 2000},
+    55: {"manager": 28, "month": 11, "year": 1999},
+    61: {"gmt": -5, "category": "Jewelry", "year": 1998},
+    62: {"dms": 1200},
+    65: {"dms": 1176},
+    68: {"dep": 4, "veh": 3, "year": 1999, "city1": "Midway",
+         "city2": "Fairview"},
+    69: {"s1": "KY", "s2": "GA", "s3": "TX", "year": 2001, "month": 4},
+    73: {"year": 1999, "bp1": ">10000", "bp2": "Unknown",
+         "county1": "Williamson County", "county2": "Walker County",
+         "county3": "Franklin County", "county4": "Ziebach County"},
+    79: {"dep": 6, "veh": 2, "year": 1999},
+    84: {"city": "Fairview", "income": 38128},
+    88: {"d1": 4, "d2": 2, "d3": 0},
+    90: {"hour_am": 8, "hour_pm": 19, "dep": 6},
+    91: {"year": 1998, "month": 11},
+    93: {"reason": "Did not fit"},
+    96: {"hour": 20, "dep": 7},
+    99: {"dms": 1200},
+}
+
+
+def render_query(template_number: int, params: dict | None = None) -> str:
+    with open(os.path.join(TEMPLATE_DIR, f"q{template_number}.sql")) as f:
+        tpl = f.read()
+    if params is None:
+        params = QUALIFICATION.get(template_number, {})
+    return tpl.format(**params)
+
+
+def stream_order(stream: int, rng_seed: int | None = None,
+                 templates: list[int] | None = None) -> list[int]:
+    order = list(templates if templates is not None
+                 else available_templates())
+    if stream == 0:
+        return order
+    rng = random.Random((rng_seed or 0) * 1000 + stream)
+    rng.shuffle(order)
+    return order
+
+
+def generate_query_streams(output_dir: str, streams: int,
+                           rng_seed: int | None = None,
+                           templates: list[int] | None = None) -> list[str]:
+    """Write query_{i}.sql stream files (reference layout:
+    `nds/nds_gen_query_stream.py:42-89` emits query_0.sql .. query_N.sql)."""
+    os.makedirs(output_dir, exist_ok=True)
+    paths = []
+    for i in range(streams):
+        parts = []
+        for qn in stream_order(i, rng_seed, templates):
+            sql = render_query(qn)
+            parts.append(
+                f"-- start query {qn} in stream {i} using template "
+                f"query{qn}.tpl\n{sql}\n-- end query {qn} in stream {i} "
+                f"using template query{qn}.tpl\n")
+        path = os.path.join(output_dir, f"query_{i}.sql")
+        with open(path, "w") as f:
+            f.write("\n".join(parts))
+        paths.append(path)
+    return paths
+
+
+_MARKER_RE = re.compile(
+    r"-- start query (\d+) in stream \d+ using template "
+    r"query(\d+)\.tpl\n(.*?)-- end query \1 in stream",
+    re.DOTALL)
+
+
+def parse_query_stream(path: str) -> "OrderedDict[str, str]":
+    """Stream file -> {query_name: sql}, splitting multi-statement
+    templates into _part1/_part2 (reference: `nds/nds_power.py:50-77` +
+    `nds_gen_query_stream.split_special_query:91-103`)."""
+    with open(path) as f:
+        stream = f.read()
+    queries: "OrderedDict[str, str]" = OrderedDict()
+    for _num, tpl, body in _MARKER_RE.findall(stream):
+        stmts = [s.strip() for s in body.split(";") if s.strip()]
+        if len(stmts) == 1:
+            queries[f"query{tpl}"] = stmts[0]
+        else:
+            for i, s in enumerate(stmts, 1):
+                queries[f"query{tpl}_part{i}"] = s
+    return queries

@@ -224,10 +224,8 @@ class Planner:
         ds = P.DerivedScan(node, binding,
                            [(n, t) for n, t in node.output])
         cols = {n: t for n, t in node.output}
-        unique = ()
-        if isinstance(node, P.Aggregate):
-            unique = tuple(n for n, _ in node.group_keys)
-        return Relation(binding, ds, cols, size=10_000.0, unique_on=unique)
+        return Relation(binding, ds, cols, size=10_000.0,
+                        unique_on=_unique_key_of(node))
 
     # ------------------------------------------------------- main planning
 
@@ -245,7 +243,8 @@ class Planner:
             if op in ("union", "intersect", "except"):
                 node = P.Distinct(node)
 
-        if sel.order_by or sel.limit is not None:
+        if sel.set_ops and (sel.order_by or sel.limit is not None):
+            # over a set-op result, order keys can only name output columns
             node = self._plan_order_limit(node, sel)
         return node
 
@@ -295,6 +294,7 @@ class Planner:
         residuals: list[ir.IR] = []
         semis: list[P.SemiJoin] = []
         left_joins: list[tuple] = []   # (Relation, equi_pairs, residual)
+        late: list[ir.IR] = []         # conjuncts touching left-join rels
 
         # explicit joins: INNER folds into the comma graph; LEFT is structural
         for jc in sel.joins:
@@ -311,19 +311,65 @@ class Planner:
             else:
                 raise PlanError(f"unsupported join kind {jc.kind}")
 
+        left_bindings = {rel.binding for rel, _p, _r in left_joins}
         if sel.where is not None:
             conjuncts = _hoist_common_disjuncts(_flatten_and(sel.where))
             self._classify(conjuncts, scope, edges, residuals, semis,
-                           ordered_rels, local_views)
+                           ordered_rels, local_views,
+                           external=left_bindings, late=late)
+
+        # rels whose only connections go through a left-join output (q93's
+        # `, reason where sr_reason_sk = r_reason_sk`) must join AFTER the
+        # left join, or the graph would cross-join them
+        deferred: list = []
+        edge_bindings = set()
+        for ra, _ia, rb, _ib in edges:
+            edge_bindings.add(ra.binding if ra is not None else None)
+            edge_bindings.add(rb.binding if rb is not None else None)
+        for rel in list(ordered_rels):
+            if rel.binding in edge_bindings:
+                continue
+            if any(rel.binding in self._bindings_of(e) for e in late):
+                ordered_rels.remove(rel)
+                deferred.append(rel)
 
         node = self._join_graph(ordered_rels, edges)
 
         for rel, pairs, resid in left_joins:
+            rnames = {p[1].name for p in pairs
+                      if isinstance(p[1], ir.ColRef)}
+            right_unique = (bool(rel.unique_on)
+                            and set(rel.unique_on) <= rnames)
             node = P.Join("left", node, rel.node,
                           [p[0] for p in pairs], [p[1] for p in pairs],
-                          resid, right_unique=False,
+                          resid, right_unique=right_unique,
                           output=node.output + rel.node.output,
                           binding=node.binding)
+
+        for rel in deferred:
+            pairs2, rest = [], []
+            for e in late:
+                if isinstance(e, ir.Cmp) and e.op == "=":
+                    lb = self._bindings_of(e.left)
+                    rb = self._bindings_of(e.right)
+                    if rb == {rel.binding} and rel.binding not in lb:
+                        pairs2.append((e.left, e.right))
+                        continue
+                    if lb == {rel.binding} and rel.binding not in rb:
+                        pairs2.append((e.right, e.left))
+                        continue
+                rest.append(e)
+            late = rest
+            rnames = {p[1].name for p in pairs2
+                      if isinstance(p[1], ir.ColRef)}
+            right_unique = (bool(rel.unique_on)
+                            and set(rel.unique_on) <= rnames)
+            node = P.Join("inner", node, rel.node,
+                          [p[0] for p in pairs2], [p[1] for p in pairs2],
+                          None, right_unique=right_unique,
+                          output=node.output + rel.node.output,
+                          binding=node.binding)
+        residuals.extend(late)
 
         for s in semis:
             s.left = node
@@ -364,7 +410,8 @@ class Planner:
         return {x.binding for x in ir.walk(e) if isinstance(x, ir.ColRef)}
 
     def _classify(self, conjuncts, scope, edges, residuals, semis,
-                  rels, local_views):
+                  rels, local_views, external: set | None = None,
+                  late: list | None = None):
         by_binding = {r.binding: r for r in rels}
         for c in conjuncts:
             handled = self._try_subquery_conjunct(
@@ -373,6 +420,11 @@ class Planner:
             if handled:
                 continue
             e, depth = self._lower(c, scope, allow_agg=False)
+            if external and (self._bindings_of(e) & external):
+                # touches a left-join output: can only apply after the
+                # left join is attached
+                (late if late is not None else residuals).append(e)
+                continue
             bs = self._bindings_of(e) & set(by_binding)
             if (isinstance(e, ir.Cmp) and e.op == "=" and len(bs) == 2):
                 lb = self._bindings_of(e.left)
@@ -775,10 +827,15 @@ class Planner:
                                     else f"_c{i}")
                 exprs.append((name, e))
             proj = P.Project(node, exprs, self._fresh("proj"))
-            out: P.Node = proj
             if sel.distinct:
-                out = P.Distinct(out)
-            return out
+                out: P.Node = P.Distinct(proj)
+                if not sel.set_ops and (sel.order_by
+                                        or sel.limit is not None):
+                    out = self._plan_order_limit(out, sel)
+                return out
+            if not sel.set_ops:
+                return self._finish_select(proj, sel, scope, None, proj)
+            return proj
 
         # aggregate path
         group_keys = []
@@ -806,10 +863,61 @@ class Planner:
             post, [(n, self._remap_post_agg(e, agg_node))
                    for n, e in lowered_items],
             self._fresh("proj"))
-        out2: P.Node = proj
         if sel.distinct:
-            out2 = P.Distinct(out2)
-        return out2
+            out2: P.Node = P.Distinct(proj)
+            if not sel.set_ops and (sel.order_by or sel.limit is not None):
+                out2 = self._plan_order_limit(out2, sel)
+            return out2
+        if not sel.set_ops:
+            return self._finish_select(proj, sel, scope, agg_node, proj)
+        return proj
+
+    def _finish_select(self, out: P.Node, sel: ast.Select, base_scope,
+                       agg_node, proj: P.Project) -> P.Node:
+        """ORDER BY / LIMIT for a plain (non-distinct, non-setop) select.
+
+        SQL lets ORDER BY reference pre-projection columns and aggregates
+        not in the select list (TPC-DS q19/q84/q96 order by base columns
+        or bare aggregates). Resolution order: projected output names
+        first, then the FROM scope (with agg remapping under GROUP BY);
+        scope-resolved keys ride hidden projection columns that a final
+        trim Project removes."""
+        if not sel.order_by and sel.limit is None:
+            return out
+        if not sel.order_by:
+            return P.Limit(out, sel.limit)
+        visible = list(proj.output)
+        out_scope = Scope()
+        out_scope.add(Relation(proj.binding, proj,
+                               {n: t for n, t in proj.output}))
+        keys = []
+        hidden = 0
+        for item in sel.order_by:
+            try:
+                e, _ = self._lower(item.expr, out_scope, allow_agg=False)
+            except PlanError:
+                if agg_node is not None:
+                    raw, _ = self._lower(item.expr, base_scope,
+                                         allow_agg=True,
+                                         agg_sink=(agg_node.aggs,
+                                                   base_scope))
+                    lowered = self._remap_post_agg(raw, agg_node)
+                else:
+                    lowered, _ = self._lower(item.expr, base_scope,
+                                             allow_agg=False)
+                name = f"__ord{hidden}"
+                hidden += 1
+                proj.exprs.append((name, lowered))
+                e = ir.ColRef(proj.binding, name, lowered.dtype)
+            keys.append((e, item.ascending, item.nulls_first))
+        node: P.Node = P.Sort(out, keys)
+        if sel.limit is not None:
+            node = P.Limit(node, sel.limit)
+        if hidden:
+            node = P.Project(
+                node, [(n, ir.ColRef(proj.binding, n, t))
+                       for n, t in visible], self._fresh("trim"))
+        return node
 
     # ------------------------------------------------------------- lowering
 
@@ -901,7 +1009,7 @@ class Planner:
                 e_ir = rec(x.expr)
                 vals = []
                 for item in x.items:
-                    lit = rec(item)
+                    lit = _fold_const(rec(item))
                     if not isinstance(lit, ir.Lit):
                         raise PlanError("IN list items must be literals")
                     vals.append(lit.value)
@@ -963,6 +1071,49 @@ class Planner:
         if x.kind == "null":
             return ir.Lit(None, BOOL)
         raise PlanError(f"unknown literal kind {x.kind}")
+
+
+def _unique_key_of(node: P.Node) -> tuple:
+    """Output column names a derived table is unique on, traced through
+    Project/Filter/Sort/Limit wrappers down to an Aggregate's group keys
+    (q65's per-store average subquery is Project(Aggregate) — losing the
+    key there forces expanding joins the device engine can't bound)."""
+    if isinstance(node, P.Aggregate):
+        return tuple(n for n, _ in node.group_keys)
+    if isinstance(node, P.Distinct):
+        return tuple(n for n, _ in node.output)
+    if isinstance(node, (P.Filter, P.Sort, P.Limit)):
+        return _unique_key_of(node.child)
+    if isinstance(node, P.Project):
+        inner = _unique_key_of(node.child)
+        if not inner:
+            return ()
+        child_binding = getattr(node.child, "binding", "")
+        mapping = {}
+        for name, e in node.exprs:
+            if isinstance(e, ir.ColRef) and e.binding == child_binding:
+                mapping.setdefault(e.name, name)
+        out = []
+        for k in inner:
+            if k not in mapping:
+                return ()
+            out.append(mapping[k])
+        return tuple(out)
+    return ()
+
+
+def _fold_const(e: ir.IR) -> ir.IR:
+    """Fold integer arithmetic over literals (IN (1999, 1999 + 1, ...))."""
+    if isinstance(e, ir.Arith):
+        l = _fold_const(e.left)
+        r = _fold_const(e.right)
+        if (isinstance(l, ir.Lit) and isinstance(r, ir.Lit)
+                and isinstance(l.value, int) and isinstance(r.value, int)):
+            v = {"+": l.value + r.value, "-": l.value - r.value,
+                 "*": l.value * r.value}.get(e.op)
+            if v is not None:
+                return ir.Lit(v, e.dtype)
+    return e
 
 
 def _flip(op: str) -> str:

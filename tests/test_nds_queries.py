@@ -1,0 +1,92 @@
+"""NDS (TPC-DS) differential tests: device engine vs CPU oracle.
+
+Same layered-oracle strategy as the NDS-H suite (tests/test_device_engine
+.py): pandas spot-checks anchor the oracle (test_cpu_oracle-style), the
+oracle anchors the device engine on every implemented template.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from nds_tpu.datagen import tpcds
+from nds_tpu.engine.device_exec import make_device_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds import streams
+from nds_tpu.nds.schema import get_schemas
+
+from tests.test_device_engine import assert_frames_close
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def raw():
+    return {t: tpcds.gen_table(t, SF) for t in get_schemas()}
+
+
+def _mk(raw, factory=None):
+    schemas = get_schemas()
+    sess = Session.for_nds(factory)
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    return sess
+
+
+@pytest.fixture(scope="module")
+def cpu_session(raw):
+    return _mk(raw)
+
+
+@pytest.fixture(scope="module")
+def dev_session(raw):
+    return _mk(raw, make_device_factory())
+
+
+def test_q7_oracle_vs_pandas(raw, cpu_session):
+    ss, cd, dd, it, pr = (pd.DataFrame(raw[t]) for t in (
+        "store_sales", "customer_demographics", "date_dim", "item",
+        "promotion"))
+    m = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    m = m.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    m = m.merge(pr, left_on="ss_promo_sk", right_on="p_promo_sk")
+    m = m[(m.cd_gender == "M") & (m.cd_marital_status == "S")
+          & (m.cd_education_status == "College")
+          & ((m.p_channel_email == "N") | (m.p_channel_event == "N"))
+          & (m.d_year == 2000)]
+    exp = m.groupby("i_item_id").agg(
+        agg1=("ss_quantity", "mean")).reset_index().sort_values(
+        "i_item_id").head(100)
+    got = cpu_session.sql(streams.render_query(7)).to_pandas()
+    assert list(got["i_item_id"]) == list(exp["i_item_id"])
+    np.testing.assert_allclose(got["agg1"].to_numpy(dtype=float),
+                               exp["agg1"].to_numpy(), rtol=1e-9)
+
+
+def test_q93_oracle_vs_pandas(raw, cpu_session):
+    ss = pd.DataFrame(raw["store_sales"])
+    sr = pd.DataFrame(raw["store_returns"])
+    rs = pd.DataFrame(raw["reason"])
+    r_sk = rs[rs.r_reason_desc == "Did not fit"].r_reason_sk
+    srr = sr[sr.sr_reason_sk.isin(r_sk)]
+    m = ss.merge(srr, how="inner",
+                 left_on=["ss_item_sk", "ss_ticket_number"],
+                 right_on=["sr_item_sk", "sr_ticket_number"])
+    m["act"] = (m.ss_quantity - m.sr_return_quantity) * m.ss_sales_price
+    exp = m.groupby("ss_customer_sk")["act"].sum() / 100
+    got = cpu_session.sql(streams.render_query(93)).to_pandas()
+    got_map = dict(zip(got.ss_customer_sk, got.sumsales))
+    exp_head = exp.reset_index().sort_values(
+        ["act", "ss_customer_sk"]).head(100)
+    for cust, val in zip(exp_head.ss_customer_sk, exp_head.act):
+        assert got_map[cust] == pytest.approx(val, rel=1e-9)
+
+
+@pytest.mark.parametrize("qn", streams.available_templates())
+def test_nds_query_matches_oracle(qn, cpu_session, dev_session):
+    sql = streams.render_query(qn)
+    exp = cpu_session.sql(sql).to_pandas()
+    got = dev_session.sql(sql).to_pandas()
+    assert_frames_close(got, exp, qn)
