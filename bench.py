@@ -1,91 +1,153 @@
 """Round benchmark: NDS-H power run, TPU engine vs CPU oracle.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (last
+line of stdout).
 
 Methodology follows the reference power run (bracketed wall-clock around
-execute+collect per query, `nds/PysparkBenchReport.py:87-105`): the 22
-qualification queries run on the JAX device engine (real TPU chip when
-available) after one untimed warmup pass (steady-state compile cache, the
-reference's warmed-JVM analog), and the same stream runs on the CPU
-oracle as the baseline — the reference publishes no numbers
-(BASELINE.md), so CPU wall-clock is the denominator.
+execute+collect per query, `nds/PysparkBenchReport.py:87-105`): each of
+the 22 qualification queries compiles once (untimed, AOT — the
+reference's warmed-JVM analog), then runs timed on the JAX device engine
+(real TPU chip when available), then on the CPU oracle as the baseline —
+the reference publishes no numbers (BASELINE.md), so CPU wall-clock is
+the denominator.
+
+Budget-robust by design (a timeout must still yield a metric):
+- generated data persists under .bench_data/ and reloads on re-runs;
+- the XLA persistent compilation cache (.xla_cache/) makes compiles
+  one-time costs across processes;
+- results bank incrementally per query and SIGTERM/SIGINT prints the
+  final JSON from whatever has completed, pairing device and CPU times
+  over the same completed-query set.
 
 value = device power-run total seconds; vs_baseline = cpu_total /
-device_total (>1 means the TPU engine beats the CPU baseline).
+device_total over completed queries (>1 means the TPU engine wins).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
 SF = float(os.environ.get("BENCH_SF", "0.1"))
-DATA_DIR = os.environ.get("BENCH_DATA", os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), ".bench_data",
-    f"sf{SF:g}"))
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.environ.get(
+    "BENCH_DATA", os.path.join(HERE, ".bench_data", f"sf{SF:g}"))
+
+# banked per-query results: qn -> {"device_s": float, "cpu_s": float}
+BANK: dict[int, dict] = {}
+_done = False
 
 
-def _gen_data():
+def _partial_line() -> str:
+    """The running metric over completed queries. Printed after EVERY
+    query (last line of stdout wins), so a hard kill mid-compile — where
+    the SIGTERM handler can be deferred inside XLA C++ — still leaves a
+    parseable metric on stdout."""
+    paired = {qn: r for qn, r in BANK.items()
+              if "device_s" in r and "cpu_s" in r}
+    dev_total = sum(r["device_s"] for r in paired.values())
+    cpu_total = sum(r["cpu_s"] for r in paired.values())
+    return json.dumps({
+        "metric": f"nds_h_sf{SF:g}_power_total",
+        "value": round(dev_total, 4),
+        "unit": "s",
+        "vs_baseline": (round(cpu_total / dev_total, 4)
+                        if dev_total else 0.0),
+        "queries_completed": len(paired),
+        "queries_total": 22,
+    })
+
+
+def _emit_final() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    print(_partial_line(), flush=True)
+
+
+def _on_term(signum, frame):
+    print(f"[bench] signal {signum}: emitting partial metric "
+          f"({len(BANK)} queries banked)", file=sys.stderr, flush=True)
+    _emit_final()
+    sys.exit(0)
+
+
+def _load_or_gen_data():
     from nds_tpu.datagen import tpch
+    from nds_tpu.io import table_cache
     from nds_tpu.io.host_table import from_arrays
     from nds_tpu.nds_h.schema import get_schemas
     schemas = get_schemas()
-    return {t: from_arrays(t, schemas[t], tpch.gen_table(t, SF))
-            for t in schemas}
-
-
-def _power_run(session, label: str, warmup: int = 1):
-    from nds_tpu.nds_h import streams
-    times = {}
-    for qn in range(1, 23):
-        sql = streams.render_query(qn)
-        stmts = ([s for s in sql.split(";") if s.strip()]
-                 if qn == 15 else [sql])
-        for _ in range(warmup):
-            for s in stmts:
-                session.sql(s)
-        t0 = time.perf_counter()
-        for s in stmts:
-            session.sql(s)
-        times[qn] = time.perf_counter() - t0
-        print(f"[bench] {label} q{qn}: {times[qn]*1000:.0f} ms",
+    cached = table_cache.load_tables(DATA_DIR, schemas)
+    if cached is not None:
+        print(f"[bench] loaded SF{SF:g} data from {DATA_DIR}",
               file=sys.stderr, flush=True)
-    return times
+        return cached
+    print(f"[bench] generating SF{SF:g} data...", file=sys.stderr,
+          flush=True)
+    tables = {t: from_arrays(t, schemas[t], tpch.gen_table(t, SF))
+              for t in schemas}
+    table_cache.save_tables(DATA_DIR, tables)
+    return tables
+
+
+def _run_query(session, qn: int, sql: str) -> float:
+    from nds_tpu.nds_h.streams import statements
+    t0 = time.perf_counter()
+    for s in statements(qn, sql):
+        session.sql(s)
+    return time.perf_counter() - t0
 
 
 def main() -> None:
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    from nds_tpu.utils.xla_cache import enable as enable_xla_cache
+    cache_dir = enable_xla_cache()
+    print(f"[bench] xla cache: {cache_dir}", file=sys.stderr, flush=True)
+
     from nds_tpu.engine.device_exec import make_device_factory
     from nds_tpu.engine.session import Session
+    from nds_tpu.nds_h import streams
 
-    print(f"[bench] generating SF{SF:g} data...", file=sys.stderr,
-          flush=True)
-    tables = _gen_data()
+    tables = _load_or_gen_data()
 
     import jax
     print(f"[bench] backend: {jax.default_backend()} {jax.devices()}",
           file=sys.stderr, flush=True)
-    dev = Session.for_nds_h(make_device_factory())
-    for t in tables.values():
-        dev.register_table(t)
-    # q15 creates/drops a view per pass; warmup handled inside _power_run
-    dev_times = _power_run(dev, "tpu", warmup=1)
-    dev_total = sum(dev_times.values())
 
+    dev = Session.for_nds_h(make_device_factory())
     cpu = Session.for_nds_h()
     for t in tables.values():
+        dev.register_table(t)
         cpu.register_table(t)
-    cpu_times = _power_run(cpu, "cpu-oracle", warmup=0)
-    cpu_total = sum(cpu_times.values())
 
-    result = {
-        "metric": f"nds_h_sf{SF:g}_power_total",
-        "value": round(dev_total, 4),
-        "unit": "s",
-        "vs_baseline": round(cpu_total / dev_total, 4) if dev_total else 0.0,
-    }
-    print(json.dumps(result))
+    dev_ex = None
+    for qn in range(1, 23):
+        sql = streams.render_query(qn)
+        # untimed warmup: AOT compile + one execution per statement
+        for s in streams.statements(qn, sql):
+            dev.sql(s)
+        dev_s = _run_query(dev, qn, sql)
+        BANK.setdefault(qn, {})["device_s"] = dev_s
+        # engine-side perf accounting (compile vs execute vs materialize)
+        if dev_ex is None:
+            dev_ex = dev._executor_factory(dev.tables)
+        tm = dict(dev_ex.last_timings)
+        cpu_s = _run_query(cpu, qn, sql)
+        BANK[qn]["cpu_s"] = cpu_s
+        print(f"[bench] q{qn}: tpu {dev_s*1000:.0f} ms "
+              f"(exec {tm.get('execute_ms', 0):.0f} "
+              f"mat {tm.get('materialize_ms', 0):.0f}) | "
+              f"cpu {cpu_s*1000:.0f} ms", file=sys.stderr, flush=True)
+        print(_partial_line(), flush=True)
+
+    _emit_final()
 
 
 if __name__ == "__main__":

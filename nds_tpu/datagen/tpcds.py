@@ -139,14 +139,21 @@ def _ids(prefix: str, sk: np.ndarray, width: int = 16) -> np.ndarray:
 
 
 def _null_out(arr: np.ndarray, h: np.ndarray, pct: int) -> np.ndarray:
-    """~pct% of FK values become 0 placeholders with a null mask applied
-    downstream via value -1 convention: we use -1 sentinel? The engine
-    carries explicit masks only from IO; generator emits value 0 rows as
-    legitimate 'unknown' members like dsdgen's NULL sks."""
+    """~pct% of values become the -1 NULL sentinel (dsdgen's NULLable FK
+    sks). Inside the generators the sentinel stays -1 so derived columns
+    can branch on it; ``gen_table`` converts sentinels to genuine null
+    masks (``col#null`` companion arrays) before handing data to IO, so
+    IS NULL / join / aggregate NULL semantics match dsdgen output."""
     mask = (h % np.uint64(100)) < np.uint64(pct)
     out = arr.copy()
     out[mask] = -1
     return out
+
+
+def _is_sentinel_nullable(name: str) -> bool:
+    """Columns whose -1 values are NULL sentinels: surrogate keys (domain
+    starts at 1) and the one nulled measure, inv_quantity_on_hand."""
+    return name.endswith("_sk") or name == "inv_quantity_on_hand"
 
 
 SEED = 20260729
@@ -160,7 +167,21 @@ def gen_table(table: str, sf: float, parallel: int = 1, step: int = 1,
     total = table_rows(table, sf)
     lo, hi = _chunk(total, parallel, step)
     idx = np.arange(lo, hi, dtype=np.int64)
-    return fn(idx, sf, seed, total)
+    out = fn(idx, sf, seed, total)
+    # -1 sentinels -> genuine null masks ('<col>#null' companion arrays,
+    # True = valid), consumed by io.host_table.from_arrays
+    masks = {}
+    for name, arr in out.items():
+        if (isinstance(arr, np.ndarray) and arr.dtype.kind == "i"
+                and _is_sentinel_nullable(name)):
+            isnull = arr == -1
+            if isnull.any():
+                masks[name + "#null"] = ~isnull
+                fixed = arr.copy()
+                fixed[isnull] = 0
+                out[name] = fixed
+    out.update(masks)
+    return out
 
 
 # ---- dimensions -----------------------------------------------------------

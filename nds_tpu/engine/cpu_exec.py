@@ -304,23 +304,33 @@ class CpuExecutor:
                 else:
                     out.put((b, name), np.array([v]))
             return out
+        # SQL GROUP BY: NULL keys form one group and the output key is
+        # NULL — grouping must factor in validity, never the raw fill
+        # value (ADVICE r1: NULL group corruption)
         keyframes = {}
+        keyvals = []
         for i, (kname, kexpr) in enumerate(node.group_keys):
-            arr, _ = self.eval(kexpr, ctx)
-            keyframes[f"k{i}"] = arr if arr.dtype != object else arr.astype(str)
+            arr, v = self.eval(kexpr, ctx)
+            keyvals.append((arr, v))
+            col = arr if arr.dtype != object else arr.astype(str)
+            if v is not None:
+                fill = col[0] if len(col) else 0
+                col = np.where(v, col, fill)
+                keyframes[f"k{i}n"] = ~v
+            keyframes[f"k{i}"] = col
         df = pd.DataFrame(keyframes)
         codes, uniques = pd.factorize(
-            pd.MultiIndex.from_frame(df) if n_keys > 1 else df["k0"],
-            sort=False)
+            pd.MultiIndex.from_frame(df) if len(df.columns) > 1
+            else df.iloc[:, 0], sort=False)
         ngroups = len(uniques)
         out = Context(ngroups)
         # representative (first-occurrence) row per group for key values
         rev = np.arange(len(codes))[::-1]
         first = np.full(ngroups, -1, dtype=np.int64)
         first[codes[rev]] = rev
-        for i, (kname, kexpr) in enumerate(node.group_keys):
-            arr, _ = self.eval(kexpr, ctx)
-            out.put((b, kname), arr[first])
+        for (kname, _kexpr), (arr, v) in zip(node.group_keys, keyvals):
+            out.put((b, kname), arr[first],
+                    None if v is None else v[first])
         for name, spec in node.aggs:
             out.put((b, name), self._agg_grouped(spec, ctx, codes, ngroups))
         return out
@@ -419,10 +429,15 @@ class CpuExecutor:
     def _run_distinct(self, node: P.Distinct) -> Context:
         ctx = self.run(node.child)
         b = node.binding
-        df = pd.DataFrame({
-            n: (ctx.cols[(b, n)].astype(str)
-                if ctx.cols[(b, n)].dtype == object else ctx.cols[(b, n)])
-            for n, _ in node.output})
+        data = {}
+        for n, _ in node.output:
+            arr = ctx.cols[(b, n)]
+            data[n] = arr.astype(str) if arr.dtype == object else arr
+            v = ctx.valid[(b, n)]
+            if v is not None:  # NULLs compare equal under DISTINCT
+                data[n + "#n"] = ~v
+                data[n] = np.where(v, data[n], data[n][0] if len(arr) else 0)
+        df = pd.DataFrame(data)
         keep = ~df.duplicated().to_numpy()
         return ctx.mask(keep)
 

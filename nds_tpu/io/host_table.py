@@ -79,22 +79,31 @@ def from_arrays(name: str, schema: Schema, arrays: dict[str, np.ndarray]) -> Hos
     """Build a HostTable from generator output ({col: numpy array}).
 
     Numeric/date/decimal columns pass through (decimals already scaled
-    int64); object arrays are dictionary-encoded.
+    int64); object arrays are dictionary-encoded. A companion
+    ``"<col>#null"`` boolean array (True = valid) becomes the column's
+    null mask — how the TPC-DS generator conveys dsdgen-style NULL FKs.
     """
     cols: dict[str, HostColumn] = {}
     for f in schema:
         arr = arrays[f.name]
+        mask = arrays.get(f.name + "#null")
+        if mask is not None:
+            mask = np.asarray(mask, dtype=bool)
+            if mask.all():
+                mask = None
         if isinstance(f.dtype, StringType):
             codes, dictionary = encode_strings(arr)
-            cols[f.name] = HostColumn(f.dtype, codes, dictionary)
+            cols[f.name] = HostColumn(f.dtype, codes, dictionary, mask)
         elif isinstance(f.dtype, DecimalType):
-            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int64))
+            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int64), None, mask)
         elif isinstance(f.dtype, DateType):
-            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int32))
+            cols[f.name] = HostColumn(f.dtype, arr.astype(np.int32), None, mask)
         elif isinstance(f.dtype, IntType):
-            cols[f.name] = HostColumn(f.dtype, arr.astype(f"int{f.dtype.bits}"))
+            cols[f.name] = HostColumn(
+                f.dtype, arr.astype(f"int{f.dtype.bits}"), None, mask)
         elif isinstance(f.dtype, FloatType):
-            cols[f.name] = HostColumn(f.dtype, arr.astype(f"float{f.dtype.bits}"))
+            cols[f.name] = HostColumn(
+                f.dtype, arr.astype(f"float{f.dtype.bits}"), None, mask)
         else:
-            cols[f.name] = HostColumn(f.dtype, arr)
+            cols[f.name] = HostColumn(f.dtype, arr, None, mask)
     return HostTable(name, schema, cols)

@@ -151,6 +151,19 @@ def render_query(template_number: int, params: dict | None = None,
     return tpl.format(**params)
 
 
+def statements(template_number: int, sql: str | None = None,
+               stream: int = 0) -> list[str]:
+    """Executable statements of one query. q15 is the multi-statement
+    template (create view; select; drop view — the reference runs the
+    three parts separately, `nds-h/nds_h_power.py:78-82`); every other
+    query is a single statement."""
+    if sql is None:
+        sql = render_query(template_number, stream=stream)
+    if template_number == 15:
+        return [s for s in sql.split(";") if s.strip()]
+    return [sql]
+
+
 def stream_order(stream: int, rng_seed: int | None = None) -> list[int]:
     """Query ordering for one stream. Stream 0 (power run) is sequential,
     as with qgen; throughput streams are seeded permutations."""

@@ -306,7 +306,15 @@ class _DistTrace(dx._Trace):
                     [self.eval(k, lctx) for k in node.left_keys],
                     [self.eval(k, rctx) for k in node.right_keys],
                     lctx, rctx)
-                lctx, _lk = self._exchange_ctx(lctx, lkey, lok)
+                if node.kind == "left":
+                    # NULL-key left rows must SURVIVE the exchange to be
+                    # null-extended: route them by a sentinel key (can't
+                    # match — local probe re-checks key validity)
+                    lkey = jnp.where(lok, lkey,
+                                     jnp.zeros((), lkey.dtype))
+                    lctx, _lk = self._exchange_ctx(lctx, lkey, lctx.row)
+                else:
+                    lctx, _lk = self._exchange_ctx(lctx, lkey, lok)
                 rctx, _rk = self._exchange_ctx(rctx, rkey, rok)
             elif rs:
                 rctx = self._replicate(rctx)
@@ -319,7 +327,13 @@ class _DistTrace(dx._Trace):
                 [self.eval(k, lctx) for k in node.left_keys],
                 [self.eval(k, rctx) for k in node.right_keys],
                 lctx, rctx)
-            lctx, _ = self._exchange_ctx(lctx, lkey, lok)
+            if node.kind == "left":
+                # block B emits unmatched LEFT rows: NULL-key left rows
+                # must survive the exchange (see gather-join path above)
+                lkey = jnp.where(lok, lkey, jnp.zeros((), lkey.dtype))
+                lctx, _ = self._exchange_ctx(lctx, lkey, lctx.row)
+            else:
+                lctx, _ = self._exchange_ctx(lctx, lkey, lok)
             rctx, _ = self._exchange_ctx(rctx, rkey, rok)
             # after the exchange all matches are device-local, so the
             # base expanding join (incl. left-outer block B) is exact:

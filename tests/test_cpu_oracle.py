@@ -44,11 +44,8 @@ def session(raw):
 
 
 def run_query(session, qn):
-    sql = streams.render_query(qn)
-    stmts = ([s for s in sql.split(";") if s.strip()]
-             if qn == 15 else [sql])
     result = None
-    for s in stmts:
+    for s in streams.statements(qn):
         r = session.sql(s)
         if r is not None:
             result = r

@@ -26,6 +26,17 @@ def raw():
     return {t: tpcds.gen_table(t, SF) for t in get_schemas()}
 
 
+def _frame(d: dict) -> pd.DataFrame:
+    """Raw generator dict -> pandas frame with '#null' masks applied
+    (NULL FKs become NaN, like dsdgen data read with a schema)."""
+    df = pd.DataFrame(
+        {k: v for k, v in d.items() if not k.endswith("#null")})
+    for k, m in d.items():
+        if k.endswith("#null"):
+            df[k[:-5]] = df[k[:-5]].where(m)
+    return df
+
+
 def _mk(raw, factory=None):
     schemas = get_schemas()
     sess = Session.for_nds(factory)
@@ -45,7 +56,7 @@ def dev_session(raw):
 
 
 def test_q7_oracle_vs_pandas(raw, cpu_session):
-    ss, cd, dd, it, pr = (pd.DataFrame(raw[t]) for t in (
+    ss, cd, dd, it, pr = (_frame(raw[t]) for t in (
         "store_sales", "customer_demographics", "date_dim", "item",
         "promotion"))
     m = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
@@ -66,9 +77,9 @@ def test_q7_oracle_vs_pandas(raw, cpu_session):
 
 
 def test_q93_oracle_vs_pandas(raw, cpu_session):
-    ss = pd.DataFrame(raw["store_sales"])
-    sr = pd.DataFrame(raw["store_returns"])
-    rs = pd.DataFrame(raw["reason"])
+    ss = _frame(raw["store_sales"])
+    sr = _frame(raw["store_returns"])
+    rs = _frame(raw["reason"])
     r_sk = rs[rs.r_reason_desc == "Did not fit"].r_reason_sk
     srr = sr[sr.sr_reason_sk.isin(r_sk)]
     m = ss.merge(srr, how="inner",

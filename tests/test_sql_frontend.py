@@ -65,10 +65,7 @@ class TestParser:
 
     def test_all_22_templates_parse(self):
         for qn in range(1, 23):
-            sql = streams.render_query(qn)
-            stmts = ([x for x in sql.split(";") if x.strip()]
-                     if qn == 15 else [sql])
-            for stmt in stmts:
+            for stmt in streams.statements(qn):
                 parse(stmt)
 
 
