@@ -362,6 +362,9 @@ class CpuExecutor:
             return arr.max()
         if spec.func == "avg":
             return _to_float(arr, spec.arg.dtype).mean()
+        if spec.func in ("stddev_samp", "stddev"):
+            f = _to_float(arr, spec.arg.dtype)
+            return np.nan if len(f) < 2 else float(np.std(f, ddof=1))
         raise ExecError(spec.func)
 
     def _agg_grouped(self, spec: P.AggSpec, ctx: Context,
@@ -407,19 +410,185 @@ class CpuExecutor:
             out = np.zeros(ngroups, dtype=vals.dtype)
             out[s.index.to_numpy()] = s.to_numpy()
             return out
+        if spec.func in ("stddev_samp", "stddev"):
+            f = _to_float(vals, spec.arg.dtype)
+            s = pd.DataFrame({"g": gcodes, "v": f}).groupby("g")["v"].std(
+                ddof=1)
+            out = np.full(ngroups, np.nan)
+            out[s.index.to_numpy()] = s.to_numpy()
+            return out
         raise ExecError(spec.func)
+
+    def _run_window(self, node: P.Window) -> Context:
+        """Namespace-extending window evaluation (pandas per spec)."""
+        ctx = self.run(node.child)
+        out = Context(ctx.nrows)
+        out.cols.update(ctx.cols)
+        out.valid.update(ctx.valid)
+        for name, spec in node.specs:
+            arr, valid = self._window_col(spec, ctx)
+            out.put((node.binding, name), arr, valid)
+        return out
+
+    def _window_col(self, spec: P.WindowSpec, ctx: Context):
+        n = ctx.nrows
+        # partition codes (validity-aware, like GROUP BY)
+        if spec.partition:
+            frames = {}
+            for i, p in enumerate(spec.partition):
+                a, v = self.eval(p, ctx)
+                col = a.astype(str) if a.dtype == object else a
+                if v is not None:
+                    frames[f"p{i}n"] = ~v
+                    col = np.where(v, col, col[0] if len(col) else 0)
+                frames[f"p{i}"] = col
+            pdf = pd.DataFrame(frames)
+            codes, _ = pd.factorize(
+                pd.MultiIndex.from_frame(pdf) if len(pdf.columns) > 1
+                else pdf.iloc[:, 0], sort=False)
+        else:
+            codes = np.zeros(n, dtype=np.int64)
+        # sorted space: partition-major, order-minor (stable); NULL order
+        # keys sort per nulls_first (default last), matching the device
+        idx = np.arange(n)
+        for e, asc, nf in reversed(spec.order):
+            a, v = self.eval(e, ctx)
+            a2 = a[idx]
+            if a2.dtype == object:
+                a2 = a2.astype(str)
+            key = a2 if asc else _rank_desc(a2)
+            idx = idx[np.argsort(key, kind="stable")]
+            if v is not None:
+                v2 = v[idx]
+                rank = np.where(v2, 1, 0) if nf else np.where(v2, 0, 1)
+                idx = idx[np.argsort(rank, kind="stable")]
+        idx = idx[np.argsort(codes[idx], kind="stable")]
+        pc = codes[idx]
+        part_start = np.concatenate([[True], pc[1:] != pc[:-1]])
+        pos = np.arange(n)
+        start_pos = np.maximum.accumulate(np.where(part_start, pos, 0))
+
+        def scatter(res_sorted, valid_sorted=None):
+            o = np.empty(n, dtype=np.asarray(res_sorted).dtype)
+            o[idx] = res_sorted
+            vo = None
+            if valid_sorted is not None and not valid_sorted.all():
+                vo = np.empty(n, dtype=bool)
+                vo[idx] = valid_sorted
+            return o, vo
+
+        def order_change(base):
+            """OR in order-key (value AND validity) change flags."""
+            change = base.copy()
+            for e, _asc, _nf in spec.order:
+                a, v = self.eval(e, ctx)
+                a2 = a[idx]
+                if a2.dtype == object:
+                    a2 = a2.astype(str)
+                if v is not None:
+                    v2 = v[idx]
+                    a2 = np.where(v2, a2, a2[0] if len(a2) else 0)
+                    change |= np.concatenate([[True], v2[1:] != v2[:-1]])
+                change |= np.concatenate([[True], a2[1:] != a2[:-1]])
+            return change
+
+        if spec.func in ("rank", "dense_rank", "row_number"):
+            if spec.func == "row_number":
+                return scatter(pos - start_pos + 1)
+            change = order_change(part_start)
+            if spec.func == "dense_rank":
+                c = np.cumsum(change)
+                cstart = np.maximum.accumulate(np.where(part_start, c, 0))
+                return scatter(c - cstart + 1)
+            lastchg = np.maximum.accumulate(np.where(change, pos, 0))
+            return scatter(lastchg - start_pos + 1)
+
+        # aggregate windows
+        if spec.arg is not None:
+            a, v = self.eval(spec.arg, ctx)
+            w = np.ones(n, bool) if v is None else v
+            vals = a[idx]
+            w = w[idx]
+        else:  # count(*)
+            vals = np.ones(n, dtype=np.int64)
+            w = np.ones(n, bool)
+        running = bool(spec.order)
+        df = pd.DataFrame({"g": pc})
+        if spec.func == "count":
+            cnt_src = w.astype(np.int64)
+            res = (df.assign(v=cnt_src).groupby("g")["v"].cumsum()
+                   if running else
+                   df.assign(v=cnt_src).groupby("g")["v"].transform("sum"))
+            res = res.to_numpy()
+            out_valid = None
+            cnt = None
+        else:
+            is_f = vals.dtype.kind == "f"
+            fvals = vals.astype(np.float64) if is_f else vals
+            if spec.func == "avg":
+                fvals = _to_float(vals, spec.arg.dtype)
+                is_f = True
+            g = df.assign(
+                v=np.where(w, fvals, 0 if spec.func in ("sum", "avg")
+                           else fvals),
+                c=w.astype(np.int64)).groupby("g")
+            if running:
+                cnt = g["c"].cumsum().to_numpy()
+            else:
+                cnt = g["c"].transform("sum").to_numpy()
+            if spec.func in ("sum", "avg"):
+                res = (g["v"].cumsum() if running
+                       else g["v"].transform("sum")).to_numpy()
+                if spec.func == "avg":
+                    with np.errstate(invalid="ignore"):
+                        res = res / np.maximum(cnt, 1)
+            elif spec.func in ("min", "max"):
+                masked = pd.Series(
+                    fvals.astype(np.float64)).where(w)
+                g2 = pd.DataFrame({"g": pc, "v": masked}).groupby("g")
+                if running:
+                    res = (g2["v"].cummin() if spec.func == "min"
+                           else g2["v"].cummax()).to_numpy()
+                else:
+                    res = g2["v"].transform(spec.func).to_numpy()
+                res = np.nan_to_num(res)
+                if not is_f:
+                    res = np.round(res).astype(np.int64)
+            else:
+                raise ExecError(f"window func {spec.func}")
+            out_valid = cnt > 0
+        if spec.func == "sum" and not is_f:
+            res = np.round(res).astype(np.int64)
+        if running and spec.frame is None:
+            # SQL default frame with ORDER BY: RANGE ... CURRENT ROW —
+            # tie rows (order-key peers) share the value at the peer
+            # group's last row
+            change = order_change(part_start)
+            pg = np.cumsum(change)
+            res = pd.DataFrame({"g": pg, "v": res}).groupby(
+                "g")["v"].transform("last").to_numpy()
+            if out_valid is not None:
+                out_valid = pd.DataFrame(
+                    {"g": pg, "v": out_valid}).groupby("g")["v"].transform(
+                    "last").to_numpy().astype(bool)
+        return scatter(res, out_valid)
 
     def _run_sort(self, node: P.Sort) -> Context:
         ctx = self.run(node.child)
         idx = np.arange(ctx.nrows)
-        # stable sort from last key to first
-        for e, asc, _nf in reversed(node.keys):
-            arr, _ = self.eval(e, ctx)
+        # stable sort from last key to first; NULL keys per nulls_first
+        # (default last), matching the device engine
+        for e, asc, nf in reversed(node.keys):
+            arr, v = self.eval(e, ctx)
             arr = arr[idx]
             if arr.dtype == object:
                 arr = arr.astype(str)
             key = arr if asc else _rank_desc(arr)
             idx = idx[np.argsort(key, kind="stable")]
+            if v is not None:
+                v2 = v[idx]
+                rank = np.where(v2, 1, 0) if nf else np.where(v2, 0, 1)
+                idx = idx[np.argsort(rank, kind="stable")]
         return ctx.take(idx)
 
     def _run_limit(self, node: P.Limit) -> Context:
@@ -490,9 +659,14 @@ class CpuExecutor:
             return ctx.cols[(e.binding, e.name)], ctx.valid.get(
                 (e.binding, e.name))
         if isinstance(e, ir.Lit):
-            if e.value is None:  # NULL literal: value 0, nothing valid
-                return (np.zeros(ctx.nrows, dtype=np.int64),
-                        np.zeros(ctx.nrows, dtype=bool))
+            if e.value is None:  # typed NULL literal: fill value, no valid
+                if isinstance(e.dtype, StringType):
+                    z = np.full(ctx.nrows, "", dtype=object)
+                elif isinstance(e.dtype, FloatType):
+                    z = np.zeros(ctx.nrows, dtype=np.float64)
+                else:
+                    z = np.zeros(ctx.nrows, dtype=np.int64)
+                return z, np.zeros(ctx.nrows, dtype=bool)
             return np.full(ctx.nrows, e.value), None
         if isinstance(e, ir.ScalarRef):
             v, _ = self.scalars[e.plan_id]

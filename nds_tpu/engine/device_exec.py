@@ -150,6 +150,18 @@ def _and_valid(a, b):
     return a & b
 
 
+def _seg_scan(op, vals, flags):
+    """Segmented inclusive scan: restart `op` accumulation at every True
+    flag. Classic (value, reset-flag) associative combiner — O(n log n)
+    on the VPU via lax.associative_scan."""
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+    out, _ = lax.associative_scan(comb, (vals, flags))
+    return out
+
+
 def _epoch_days_to_civil(days):
     """Hinnant's algorithm: epoch days -> (year, month, day), integer ops
     only so it vectorizes onto the VPU."""
@@ -798,6 +810,16 @@ class _Trace:
                 masked = jnp.where(w, dv.arr.astype(jnp.int64), fill)
             red = jnp.min(masked) if spec.func == "min" else jnp.max(masked)
             return red.reshape(1), valid, dv.sdict
+        if spec.func in ("stddev_samp", "stddev"):
+            f = _to_float(dv.arr, spec.arg.dtype)
+            s1 = jnp.sum(jnp.where(w, f, 0.0))
+            s2 = jnp.sum(jnp.where(w, f * f, 0.0))
+            c = cnt.astype(jnp.float64)
+            var = (s2 - s1 * s1 / jnp.maximum(c, 1)) / jnp.maximum(
+                c - 1, 1)
+            sd = jnp.sqrt(jnp.maximum(var, 0.0))
+            return (jnp.where(cnt > 1, sd, jnp.nan).reshape(1),
+                    valid, None)
         raise DeviceExecError(spec.func)
 
     def _agg_grouped(self, spec: P.AggSpec, ctx: DCtx, perm, gid,
@@ -847,6 +869,19 @@ class _Trace:
                                           (FloatType, DecimalType)):
                 red = red.astype(arr_s.dtype)
             return red, valid, dv.sdict
+        if spec.func in ("stddev_samp", "stddev"):
+            f = _to_float(arr_s, spec.arg.dtype)
+            s1 = jax.ops.segment_sum(jnp.where(w, f, 0.0), gid,
+                                     num_segments=G,
+                                     indices_are_sorted=True)
+            s2 = jax.ops.segment_sum(jnp.where(w, f * f, 0.0), gid,
+                                     num_segments=G,
+                                     indices_are_sorted=True)
+            c = cnt.astype(jnp.float64)
+            var = (s2 - s1 * s1 / jnp.maximum(c, 1)) / jnp.maximum(
+                c - 1, 1)
+            sd = jnp.sqrt(jnp.maximum(var, 0.0))
+            return jnp.where(cnt > 1, sd, jnp.nan), valid, None
         raise DeviceExecError(spec.func)
 
     def _count_distinct_grouped(self, spec, ctx, perm, gid, present_s, G):
@@ -874,6 +909,182 @@ class _Trace:
         flag = w2 & newpair
         cnt = jax.ops.segment_sum(flag.astype(jnp.int64), g2, num_segments=G)
         return cnt, None, None
+
+    # ------------------------------------------------------------- windows
+
+    def _run_window(self, node: P.Window) -> DCtx:
+        """Sort-based window evaluation: ONE multi-operand lax.sort into
+        partition-major/order-minor space, then segmented scans/segment
+        reductions, scattered back through the permutation. Stays inside
+        the single XLA program (no host round trips)."""
+        ctx = self.run(node.child)
+        out = DCtx(ctx.n, ctx.row)
+        out.cols.update(ctx.cols)
+        for name, spec in node.specs:
+            out.cols[(node.binding, name)] = self._window_col(spec, ctx)
+        return out
+
+    def _window_col(self, spec: P.WindowSpec, ctx: DCtx) -> DVal:
+        n = ctx.n
+        iota = jnp.arange(n)
+        ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32)]
+        part_ops = []
+        for p in spec.partition:
+            dv = self.eval(p, ctx)
+            if dv.valid is not None:
+                vop = jnp.where(dv.valid, 0, 1).astype(jnp.int32)
+                ops.append(vop)
+                part_ops.append(len(ops) - 1)
+            arr = _narrow_key(dv)
+            filled = jnp.where(_ok(dv, ctx.row), arr,
+                               jnp.zeros((), dtype=arr.dtype))
+            ops.append(filled)
+            part_ops.append(len(ops) - 1)
+        order_ops = []
+        for e, asc, nulls_first in spec.order:
+            dv = self.eval(e, ctx)
+            if dv.valid is not None:
+                rank = (jnp.where(dv.valid, 1, 0) if nulls_first
+                        else jnp.where(dv.valid, 0, 1))
+                ops.append(rank.astype(jnp.int32))
+                order_ops.append(len(ops) - 1)
+            arr = _narrow_key(dv)
+            if jnp.issubdtype(arr.dtype, jnp.bool_):
+                arr = arr.astype(jnp.int32)
+            if asc:
+                key = arr
+            elif jnp.issubdtype(arr.dtype, jnp.floating):
+                key = -arr.astype(jnp.float64)
+            else:
+                key = -arr
+            if dv.valid is not None:
+                key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
+            ops.append(key)
+            order_ops.append(len(ops) - 1)
+        ops.append(iota)
+        sorted_ops = lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
+        perm = sorted_ops[-1]
+        present_s = jnp.take(ctx.row, perm)
+        part_start = jnp.zeros(n, dtype=bool).at[0].set(True)
+        for i in part_ops:
+            o = sorted_ops[i]
+            part_start = part_start | jnp.concatenate(
+                [jnp.ones(1, bool), o[1:] != o[:-1]])
+        start_pos = lax.cummax(jnp.where(part_start, iota, 0))
+        pid = jnp.cumsum(part_start.astype(jnp.int32)) - 1
+
+        def scatter(res_sorted, valid_sorted=None, lo=None, hi=None):
+            arr = jnp.zeros(n, res_sorted.dtype).at[perm].set(res_sorted)
+            valid = None
+            if valid_sorted is not None:
+                valid = jnp.zeros(n, bool).at[perm].set(valid_sorted)
+            return DVal(arr, valid, None, lo, hi)
+
+        if spec.func in ("rank", "dense_rank", "row_number"):
+            if spec.func == "row_number":
+                return scatter((iota - start_pos + 1).astype(jnp.int64),
+                               lo=1, hi=n)
+            change = part_start
+            for i in order_ops:
+                o = sorted_ops[i]
+                change = change | jnp.concatenate(
+                    [jnp.ones(1, bool), o[1:] != o[:-1]])
+            if spec.func == "dense_rank":
+                c = jnp.cumsum(change.astype(jnp.int64))
+                cstart = lax.cummax(jnp.where(part_start, c, 0))
+                return scatter(c - cstart + 1, lo=1, hi=n)
+            lastchg = lax.cummax(jnp.where(change, iota, 0))
+            return scatter((lastchg - start_pos + 1).astype(jnp.int64),
+                           lo=1, hi=n)
+
+        # aggregate windows
+        if spec.arg is not None:
+            dv = self.eval(spec.arg, ctx)
+            w = jnp.take(_ok(dv, ctx.row), perm)
+            vals = jnp.take(dv.arr, perm)
+        else:  # count(*)
+            w = present_s
+            vals = jnp.ones(n, dtype=jnp.int64)
+        running = bool(spec.order)
+        is_f = isinstance(spec.dtype, FloatType)
+        if spec.func == "avg":
+            vals = _to_float(vals, spec.arg.dtype)
+        elif is_f:
+            vals = vals.astype(jnp.float64)
+        else:
+            vals = vals.astype(jnp.int64)
+        G = n
+        if spec.func == "count":
+            src = w.astype(jnp.int64)
+            if running:
+                res = _seg_scan(lambda a, b: a + b, src, part_start)
+            else:
+                tot = jax.ops.segment_sum(src, pid, num_segments=G,
+                                          indices_are_sorted=True)
+                res = jnp.take(tot, pid)
+            return self._window_range_fix(
+                spec, scatter, res, None, part_start, order_ops,
+                sorted_ops, pid, running)
+        cnt_src = w.astype(jnp.int64)
+        if running:
+            cnt = _seg_scan(lambda a, b: a + b, cnt_src, part_start)
+        else:
+            tot = jax.ops.segment_sum(cnt_src, pid, num_segments=G,
+                                      indices_are_sorted=True)
+            cnt = jnp.take(tot, pid)
+        valid = cnt > 0
+        if spec.func in ("sum", "avg"):
+            data = jnp.where(w, vals, jnp.zeros((), vals.dtype))
+            if running:
+                res = _seg_scan(lambda a, b: a + b, data, part_start)
+            else:
+                tot = jax.ops.segment_sum(data, pid, num_segments=G,
+                                          indices_are_sorted=True)
+                res = jnp.take(tot, pid)
+            if spec.func == "avg":
+                res = res.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        elif spec.func in ("min", "max"):
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                fill = jnp.inf if spec.func == "min" else -jnp.inf
+            else:
+                fill = I64_MAX if spec.func == "min" else I64_MIN
+            data = jnp.where(w, vals, fill)
+            op = jnp.minimum if spec.func == "min" else jnp.maximum
+            if running:
+                res = _seg_scan(op, data, part_start)
+            else:
+                seg = (jax.ops.segment_min if spec.func == "min"
+                       else jax.ops.segment_max)
+                tot = seg(data, pid, num_segments=G,
+                          indices_are_sorted=True)
+                res = jnp.take(tot, pid)
+        else:
+            raise DeviceExecError(f"window func {spec.func}")
+        return self._window_range_fix(
+            spec, scatter, res, valid, part_start, order_ops, sorted_ops,
+            pid, running)
+
+    def _window_range_fix(self, spec, scatter, res, valid, part_start,
+                          order_ops, sorted_ops, pid, running):
+        """SQL default frame with ORDER BY is RANGE ..CURRENT ROW: peer
+        (order-key-tied) rows share the value at the peer group's LAST
+        row. 'cum' (ROWS) keeps the per-row running value."""
+        if running and spec.frame is None:
+            n = res.shape[0]
+            iota = jnp.arange(n)
+            change = part_start
+            for i in order_ops:
+                o = sorted_ops[i]
+                change = change | jnp.concatenate(
+                    [jnp.ones(1, bool), o[1:] != o[:-1]])
+            g2 = jnp.cumsum(change.astype(jnp.int32)) - 1
+            last = jax.ops.segment_max(iota, g2, num_segments=n,
+                                       indices_are_sorted=True)
+            last = jnp.clip(last, 0, n - 1)
+            res = jnp.take(res, jnp.take(last, g2))
+            if valid is not None:
+                valid = jnp.take(valid, jnp.take(last, g2))
+        return scatter(res, valid)
 
     # ------------------------------------------------------- sort and misc
 
@@ -1001,7 +1212,44 @@ class _Trace:
                     dctx.cols[(lb, name)] = kv.with_arrays(arr_g, valid_g)
                 return dctx
             return out
-        raise DeviceExecError(f"setop {node.kind} not yet on device")
+        # INTERSECT / EXCEPT: whole-row membership against the right
+        # side. Rows pack into one int64 (pair-aligned per column, plus a
+        # validity bit so NULLs compare equal, the SQL set-op rule); the
+        # probe is a sorted-membership check. A Distinct above (planner-
+        # inserted) provides the set semantics.
+        lvals = [lctx.cols[(lb, name)] for name, _ in node.left.output]
+        rvals = [rctx.cols[(rb, name)] for name, _ in node.right.output]
+        lkey = jnp.zeros(lctx.n, dtype=jnp.int64)
+        rkey = jnp.zeros(rctx.n, dtype=jnp.int64)
+        total_w = 0
+        for lv, rv in zip(lvals, rvals):
+            la, ra, lo, hi = self._align_pair(lv, rv)
+            w = max((hi - lo).bit_length(), 1)
+            ln = jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo)
+            rn = jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo)
+            if lv.valid is not None or rv.valid is not None:
+                lval = (lv.valid if lv.valid is not None
+                        else jnp.ones(lctx.n, bool))
+                rval = (rv.valid if rv.valid is not None
+                        else jnp.ones(rctx.n, bool))
+                ln = jnp.where(lval, ln, 0) | (
+                    lval.astype(jnp.int64) << w)
+                rn = jnp.where(rval, rn, 0) | (
+                    rval.astype(jnp.int64) << w)
+                w += 1
+            total_w += w
+            if total_w > 62:
+                raise DeviceExecError(
+                    f"set-op row too wide to pack ({total_w} bits)")
+            lkey = (lkey << w) | ln
+            rkey = (rkey << w) | rn
+        ks = jnp.sort(jnp.where(rctx.row, rkey, I64_MAX))
+        pos = jnp.clip(jnp.searchsorted(ks, lkey), 0, rctx.n - 1)
+        hit = jnp.take(ks, pos) == lkey
+        keep = hit if node.kind == "intersect" else ~hit
+        out = DCtx(lctx.n, lctx.row & keep)
+        out.cols = lctx.cols
+        return out
 
     @staticmethod
     def _union_dict(lv: DVal, rv: DVal):
@@ -1090,12 +1338,20 @@ class _Trace:
         if isinstance(e.dtype, StringType):
             # string literals only appear inside comparisons, which bind
             # them against a dictionary; standalone use keeps the raw value
+            if e.value is None:  # NULL string (rolled-up group key)
+                return DVal(jnp.zeros(ctx.n, jnp.int32),
+                            jnp.zeros(ctx.n, dtype=bool),
+                            np.array([""], dtype=object), 0, 0)
             return DVal(jnp.zeros(ctx.n, jnp.int32), None,
                         np.array([e.value], dtype=object), 0, 0)
         v = e.value
         if v is None:
-            return DVal(jnp.zeros(ctx.n, jnp.int64),
-                        jnp.zeros(ctx.n, dtype=bool))
+            if isinstance(e.dtype, FloatType):
+                return DVal(jnp.zeros(ctx.n, jnp.float64),
+                            jnp.zeros(ctx.n, dtype=bool))
+            dt = jnp.int32 if isinstance(e.dtype, DateType) else jnp.int64
+            return DVal(jnp.zeros(ctx.n, dt),
+                        jnp.zeros(ctx.n, dtype=bool), None, 0, 0)
         if isinstance(e.dtype, FloatType):
             arr = jnp.full(ctx.n, float(v), dtype=jnp.float64)
             return DVal(arr, None)

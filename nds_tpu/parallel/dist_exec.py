@@ -500,6 +500,17 @@ class _DistTrace(dx._Trace):
         out.sharded = False
         return out
 
+    def _run_window(self, node: P.Window) -> DCtx:
+        # windows run post-aggregation on small relations; replicate
+        # (an exchange-by-partition-key path can land later)
+        child = self.run(node.child)
+        if getattr(child, "sharded", False):
+            self._cache[id(node.child)] = self._replicate(child)
+            self._cache.pop(id(node), None)
+        out = super()._run_window(node)
+        out.sharded = False
+        return out
+
     def run_query(self, planned: P.PlannedQuery):
         for i, sub in enumerate(planned.scalar_subplans):
             ctx = self._replicate(self.run(sub))

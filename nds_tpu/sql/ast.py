@@ -146,6 +146,20 @@ class Exists(Expr):
 
 
 @dataclass
+class WindowFunc(Expr):
+    """func(args) OVER (PARTITION BY ... ORDER BY ... [frame]).
+
+    frame: None = default (whole partition for plain aggregates; the
+    ranking functions ignore it); 'cum' = ROWS BETWEEN UNBOUNDED
+    PRECEDING AND CURRENT ROW (running aggregate, TPC-DS q51)."""
+    name: str
+    args: list[Expr] = field(default_factory=list)
+    partition_by: list[Expr] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    frame: Optional[str] = None
+
+
+@dataclass
 class Star(Expr):
     table: Optional[str] = None
 
@@ -214,6 +228,9 @@ class Select:
     joins: list[JoinClause] = field(default_factory=list)
     where: Optional[Expr] = None
     group_by: list[Expr] = field(default_factory=list)
+    # GROUP BY ROLLUP(...) / GROUPING SETS(...): list of grouping sets,
+    # each a list of indexes into group_by. None = plain GROUP BY.
+    grouping_sets: Optional[list[list[int]]] = None
     having: Optional[Expr] = None
     order_by: list[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None

@@ -155,6 +155,29 @@ class ScalarRef(IR):
         return f"scalar#{self.plan_id}"
 
 
+@dataclass
+class WindowRef(IR):
+    """Reference to window column #index of the enclosing Window node."""
+    index: int
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"win#{self.index}"
+
+
+@dataclass
+class GroupingRef(IR):
+    """grouping(<key>) marker: 0 when the key participates in the row's
+    grouping set, 1 when rolled up (NULL-filled). Resolved per grouping-
+    set branch to a constant column (key_index = index into the select's
+    group_by list)."""
+    key_index: int
+    dtype: DType = INT32
+
+    def __repr__(self):
+        return f"grouping#{self.key_index}"
+
+
 def is_decimal(t: DType) -> bool:
     return isinstance(t, DecimalType)
 
@@ -186,7 +209,7 @@ def arith_type(op: str, lt: DType, rt: DType) -> DType:
 def agg_type(func: str, arg_t: DType | None) -> DType:
     if func == "count":
         return INT64
-    if func == "avg":
+    if func in ("avg", "stddev_samp", "stddev"):
         return FLOAT64
     if func in ("sum", "min", "max"):
         if arg_t is None:

@@ -169,14 +169,69 @@ class Parser:
         if self.at_kw("group"):
             self.next()
             self.expect_kw("by")
-            while True:
-                sel.group_by.append(self.parse_expr())
-                if not self.take_punct(","):
-                    break
+            self._parse_group_by(sel)
         if self.take_kw("having"):
             sel.having = self.parse_expr()
         self._parse_order_limit(sel)
         return sel
+
+    def _parse_group_by(self, sel: ast.Select) -> None:
+        """Plain list, ROLLUP(...), CUBE(...), or GROUPING SETS((..),..).
+        All lower to sel.group_by (the full key list) + sel.grouping_sets
+        (index lists), matching the Spark dialect the reference's patched
+        templates use (`nds/tpcds-gen/patches/templates.patch`)."""
+        if self.at_kw("rollup") or self.at_kw("cube"):
+            kind = self.next().value.lower()
+            self.expect_punct("(")
+            keys = [self.parse_expr()]
+            while self.take_punct(","):
+                keys.append(self.parse_expr())
+            self.expect_punct(")")
+            sel.group_by = keys
+            n = len(keys)
+            if kind == "rollup":
+                sel.grouping_sets = [list(range(k))
+                                     for k in range(n, -1, -1)]
+            else:  # cube: all subsets, spec enumeration order
+                sel.grouping_sets = [
+                    [i for i in range(n) if mask & (1 << i)]
+                    for mask in range((1 << n) - 1, -1, -1)]
+            return
+        if self.at_kw("grouping"):
+            save = self.i
+            self.next()
+            if not self.take_kw("sets"):
+                self.i = save
+            else:
+                self.expect_punct("(")
+                keys: list = []
+                key_index: dict = {}
+                sets: list[list[int]] = []
+                while True:
+                    self.expect_punct("(")
+                    one: list[int] = []
+                    if not self.at_punct(")"):
+                        while True:
+                            e = self.parse_expr()
+                            r = repr(e)
+                            if r not in key_index:
+                                key_index[r] = len(keys)
+                                keys.append(e)
+                            one.append(key_index[r])
+                            if not self.take_punct(","):
+                                break
+                    self.expect_punct(")")
+                    sets.append(one)
+                    if not self.take_punct(","):
+                        break
+                self.expect_punct(")")
+                sel.group_by = keys
+                sel.grouping_sets = sets
+                return
+        while True:
+            sel.group_by.append(self.parse_expr())
+            if not self.take_punct(","):
+                break
 
     def _parse_order_limit(self, sel: ast.Select) -> None:
         if self.at_kw("order"):
@@ -455,15 +510,16 @@ class Parser:
             self.next()  # (
             if self.take_punct("*"):
                 self.expect_punct(")")
-                return ast.FuncCall(name, star=True)
+                return self._maybe_window(ast.FuncCall(name, star=True))
             if self.take_punct(")"):
-                return ast.FuncCall(name)
+                return self._maybe_window(ast.FuncCall(name))
             distinct = bool(self.take_kw("distinct"))
             args = [self.parse_expr()]
             while self.take_punct(","):
                 args.append(self.parse_expr())
             self.expect_punct(")")
-            return ast.FuncCall(name, args, distinct)
+            return self._maybe_window(
+                ast.FuncCall(name, args, distinct))
         # column, possibly qualified
         name = self.next().value.lower()
         if self.at_punct(".") and self.peek(1).kind == "ident":
@@ -471,6 +527,54 @@ class Parser:
             col = self.next().value.lower()
             return ast.Column(col, name)
         return ast.Column(name)
+
+    def _maybe_window(self, fc: ast.FuncCall) -> ast.Expr:
+        """fc [OVER (PARTITION BY ... ORDER BY ... [ROWS ...])]."""
+        if not self.at_kw("over"):
+            return fc
+        if fc.distinct:
+            raise ParseError(
+                f"DISTINCT window aggregate {fc.name} is unsupported")
+        self.next()
+        self.expect_punct("(")
+        partition: list[ast.Expr] = []
+        order: list[ast.OrderItem] = []
+        frame = None
+        if self.take_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.take_punct(","):
+                partition.append(self.parse_expr())
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.take_kw("desc"):
+                    asc = False
+                else:
+                    self.take_kw("asc")
+                nulls_first = None
+                if self.take_kw("nulls"):
+                    nulls_first = bool(self.take_kw("first"))
+                    if nulls_first is False:
+                        self.expect_kw("last")
+                order.append(ast.OrderItem(e, asc, nulls_first))
+                if not self.take_punct(","):
+                    break
+        if self.take_kw("rows"):
+            # the workload's only frame: running aggregate (q51)
+            self.expect_kw("between")
+            self.expect_kw("unbounded")
+            self.expect_kw("preceding")
+            self.expect_kw("and")
+            self.expect_kw("current")
+            self.expect_kw("row")
+            frame = "cum"
+        self.expect_punct(")")
+        return ast.WindowFunc(fc.name, [] if fc.star else fc.args,
+                              partition, order, frame)
 
     def _parse_case(self) -> ast.Expr:
         self.expect_kw("case")

@@ -122,6 +122,34 @@ class Aggregate(Node):
 
 
 @dataclass
+class WindowSpec:
+    """One window column. frame: None = SQL default (whole partition
+    when there is no ORDER BY; RANGE UNBOUNDED PRECEDING..CURRENT ROW —
+    running with ties sharing a value — when there is); 'cum' = ROWS
+    UNBOUNDED PRECEDING..CURRENT ROW. Ranking funcs ignore frame."""
+    func: str                 # rank|dense_rank|row_number|sum|avg|min|max|count
+    arg: Optional[ir.IR]
+    partition: list = field(default_factory=list)   # list[ir.IR]
+    order: list = field(default_factory=list)  # (ir.IR, asc, nulls_first)
+    frame: Optional[str] = None
+    dtype: DType = None
+
+
+@dataclass
+class Window(Node):
+    """Namespace-extending operator: keeps the child's row set and adds
+    one column per spec under this node's own binding (a Project above
+    reads both namespaces)."""
+    child: Node = None
+    specs: list = field(default_factory=list)       # list[(name, WindowSpec)]
+    binding: str = ""
+
+    @property
+    def output(self):
+        return [(n, s.dtype) for n, s in self.specs]
+
+
+@dataclass
 class Sort(Node):
     child: Node = None
     keys: list = field(default_factory=list)  # list[(ir.IR, ascending, nulls_first)]
@@ -222,3 +250,10 @@ def all_exprs(node: Node):
     elif isinstance(node, Sort):
         for e, _, _ in node.keys:
             yield e
+    elif isinstance(node, Window):
+        for _, s in node.specs:
+            if s.arg is not None:
+                yield s.arg
+            yield from s.partition
+            for e, _, _ in s.order:
+                yield e

@@ -35,7 +35,9 @@ from nds_tpu.engine.types import (
 from nds_tpu.sql import ast, ir
 from nds_tpu.sql import plan as P
 
-AGG_FUNCS = {"sum", "avg", "min", "max", "count"}
+AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev_samp",
+             "stddev"}
+WINDOW_RANK_FUNCS = {"rank", "dense_rank", "row_number"}
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -762,6 +764,13 @@ class Planner:
     def _contains_agg(self, e: ast.Expr) -> bool:
         if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
             return True
+        if isinstance(e, ast.WindowFunc):
+            # a window's inputs may aggregate the enclosing GROUP BY
+            # (rank() over (order by sum(x))); the window itself is not
+            # an aggregate
+            return any(self._contains_agg(a) for a in e.args) or any(
+                self._contains_agg(p) for p in e.partition_by) or any(
+                self._contains_agg(oi.expr) for oi in e.order_by)
         for v in vars(e).values():
             if isinstance(v, ast.Expr) and self._contains_agg(v):
                 return True
@@ -775,6 +784,7 @@ class Planner:
                             return True
         return False
 
+
     def _remap_post_agg(self, e: ir.IR, agg: P.Aggregate) -> ir.IR:
         """Rewrite AggRef -> ColRef(agg.binding, aggname) and group-key
         expressions -> ColRef(agg.binding, keyname)."""
@@ -784,6 +794,9 @@ class Planner:
             if isinstance(x, ir.AggRef):
                 name, spec = agg.aggs[x.index]
                 return ir.ColRef(agg.binding, name, spec.dtype)
+            if isinstance(x, ir.GroupingRef):
+                # plain GROUP BY: every key participates -> constant 0
+                return ir.Lit(0, INT32)
             r = repr(x)
             if r in key_by_repr:
                 n, t = key_by_repr[r]
@@ -820,13 +833,21 @@ class Planner:
                 items.append(it)
 
         if not has_agg:
+            wins: list[P.WindowSpec] = []
             exprs = []
             for i, it in enumerate(items):
-                e, _ = self._lower(it.expr, scope, allow_agg=False)
+                e, _ = self._lower(it.expr, scope, allow_agg=False,
+                                   win_sink=wins)
                 name = it.alias or (e.name if isinstance(e, ir.ColRef)
                                     else f"_c{i}")
                 exprs.append((name, e))
-            proj = P.Project(node, exprs, self._fresh("proj"))
+            post: P.Node = node
+            if wins:
+                win_node, wremap = self._attach_window(
+                    post, wins, lambda x: x)
+                post = win_node
+                exprs = [(n, wremap(e)) for n, e in exprs]
+            proj = P.Project(post, exprs, self._fresh("proj"))
             if sel.distinct:
                 out: P.Node = P.Distinct(proj)
                 if not sel.set_ops and (sel.order_by
@@ -839,38 +860,122 @@ class Planner:
 
         # aggregate path
         group_keys = []
-        for g in sel.group_by:
+        gk_map: dict[str, int] = {}
+        for idx, g in enumerate(sel.group_by):
             e, _ = self._lower(g, scope, allow_agg=False)
             name = e.name if isinstance(e, ir.ColRef) else self._fresh("k")
             group_keys.append((name, e))
+            gk_map[repr(e)] = idx
         aggs: list[tuple[str, P.AggSpec]] = []
+        wins2: list[P.WindowSpec] = []
+        lower_kw = dict(agg_sink=(aggs, scope), win_sink=wins2,
+                        grouping_keys=gk_map)
         lowered_items = []
         for i, it in enumerate(items):
-            e, _ = self._lower(it.expr, scope, allow_agg=True,
-                               agg_sink=(aggs, scope))
+            e, _ = self._lower(it.expr, scope, allow_agg=True, **lower_kw)
             name = it.alias or (e.name if isinstance(e, ir.ColRef)
                                 else f"_c{i}")
             lowered_items.append((name, e))
         having_ir = None
         if sel.having is not None:
             having_ir, _ = self._lower(sel.having, scope, allow_agg=True,
-                                       agg_sink=(aggs, scope))
-        agg_node = P.Aggregate(node, group_keys, aggs, self._fresh("agg"))
-        post: P.Node = agg_node
+                                       **lower_kw)
+        agg_node = None
+        if sel.grouping_sets is not None:
+            post, remap = self._plan_grouping_sets(
+                node, group_keys, aggs, sel.grouping_sets)
+        else:
+            agg_node = P.Aggregate(node, group_keys, aggs,
+                                   self._fresh("agg"))
+            post = agg_node
+            remap = lambda x: self._remap_post_agg(x, agg_node)  # noqa: E731
         if having_ir is not None:
-            post = P.Filter(post, self._remap_post_agg(having_ir, agg_node))
-        proj = P.Project(
-            post, [(n, self._remap_post_agg(e, agg_node))
-                   for n, e in lowered_items],
-            self._fresh("proj"))
+            post = P.Filter(post, remap(having_ir))
+        mapped_items = [(n, remap(e)) for n, e in lowered_items]
+        if wins2:
+            win_node, wremap = self._attach_window(post, wins2, remap)
+            post = win_node
+            mapped_items = [(n, wremap(e)) for n, e in mapped_items]
+        proj = P.Project(post, mapped_items, self._fresh("proj"))
         if sel.distinct:
             out2: P.Node = P.Distinct(proj)
             if not sel.set_ops and (sel.order_by or sel.limit is not None):
                 out2 = self._plan_order_limit(out2, sel)
             return out2
         if not sel.set_ops:
-            return self._finish_select(proj, sel, scope, agg_node, proj)
+            return self._finish_select(
+                proj, sel, scope,
+                agg_node if sel.grouping_sets is None else None, proj)
         return proj
+
+    def _attach_window(self, post: P.Node, wins: list, remap):
+        """Build a Window node over `post` (specs remapped onto post's
+        output namespace); returns (node, WindowRef-resolving remap)."""
+        b = self._fresh("win")
+        specs = []
+        for i, s in enumerate(wins):
+            specs.append((f"_win{i}", P.WindowSpec(
+                s.func,
+                remap(s.arg) if s.arg is not None else None,
+                [remap(p) for p in s.partition],
+                [(remap(e), asc, nf) for e, asc, nf in s.order],
+                s.frame, s.dtype)))
+        win_node = P.Window(post, specs, b)
+
+        def wremap(x: ir.IR) -> ir.IR:
+            return _replace_refs(x, lambda y: (
+                ir.ColRef(b, f"_win{y.index}", y.dtype)
+                if isinstance(y, ir.WindowRef) else None))
+
+        return win_node, wremap
+
+    def _plan_grouping_sets(self, child: P.Node, group_keys, aggs, sets):
+        """Expand GROUP BY ROLLUP / GROUPING SETS into one Aggregate per
+        set over the SHARED child (executors cache the child by node id,
+        so it computes once), each projected onto a common column layout
+        (rolled-up keys as typed NULLs + __grp markers), unioned ALL.
+        Returns (union node, remap fn for item/having expressions)."""
+        branches = []
+        for S in sets:
+            sset = set(S)
+            agg_b = P.Aggregate(child, [group_keys[i] for i in S], aggs,
+                                self._fresh("agg"))
+            exprs: list = []
+            for i, (name, e) in enumerate(group_keys):
+                if i in sset:
+                    exprs.append((name, ir.ColRef(agg_b.binding, name,
+                                                  e.dtype)))
+                else:
+                    exprs.append((name, ir.Lit(None, e.dtype)))
+            for i in range(len(group_keys)):
+                exprs.append((f"__grp{i}",
+                              ir.Lit(0 if i in sset else 1, INT32)))
+            for aname, aspec in aggs:
+                exprs.append((aname, ir.ColRef(agg_b.binding, aname,
+                                               aspec.dtype)))
+            branches.append(P.Project(agg_b, exprs, self._fresh("gsb")))
+        union: P.Node = branches[0]
+        for bnode in branches[1:]:
+            union = P.SetOp("union all", union, bnode)
+        out_bind = branches[0].binding
+        key_by_repr = {repr(e): (n, e.dtype) for n, e in group_keys}
+
+        def remap(x: ir.IR) -> ir.IR:
+            def sub(y: ir.IR):
+                if isinstance(y, ir.AggRef):
+                    name, spec = aggs[y.index]
+                    return ir.ColRef(out_bind, name, spec.dtype)
+                if isinstance(y, ir.GroupingRef):
+                    return ir.ColRef(out_bind, f"__grp{y.key_index}",
+                                     INT32)
+                r = repr(y)
+                if r in key_by_repr:
+                    n, t = key_by_repr[r]
+                    return ir.ColRef(out_bind, n, t)
+                return None
+            return _replace_refs(x, sub)
+
+        return union, remap
 
     def _finish_select(self, out: P.Node, sel: ast.Select, base_scope,
                        agg_node, proj: P.Project) -> P.Node:
@@ -922,11 +1027,34 @@ class Planner:
     # ------------------------------------------------------------- lowering
 
     def _lower(self, e: ast.Expr, scope: Scope, allow_agg: bool,
-               agg_sink=None):
+               agg_sink=None, win_sink=None, grouping_keys=None):
         """AST expr -> (ir.IR, max_outer_depth)."""
         depth_seen = [0]
 
         def rec(x: ast.Expr) -> ir.IR:
+            if isinstance(x, ast.WindowFunc):
+                if win_sink is None:
+                    raise PlanError("window function not allowed here")
+                arg_ir = rec(x.args[0]) if x.args else None
+                part = [rec(p) for p in x.partition_by]
+                order = [(rec(oi.expr), oi.ascending, oi.nulls_first)
+                         for oi in x.order_by]
+                if x.name in WINDOW_RANK_FUNCS:
+                    dt = INT64
+                else:
+                    dt = ir.agg_type(
+                        x.name, arg_ir.dtype if arg_ir is not None
+                        else None)
+                spec = P.WindowSpec(x.name, arg_ir, part, order,
+                                    x.frame, dt)
+                sig = (x.name, repr(arg_ir), repr(part), repr(order),
+                       x.frame)
+                for i, s in enumerate(win_sink):
+                    if (s.func, repr(s.arg), repr(s.partition),
+                            repr(s.order), s.frame) == sig:
+                        return ir.WindowRef(i, s.dtype)
+                win_sink.append(spec)
+                return ir.WindowRef(len(win_sink) - 1, dt)
             if isinstance(x, ast.Column):
                 ref, depth = scope.resolve(x)
                 depth_seen[0] = max(depth_seen[0], depth)
@@ -989,6 +1117,36 @@ class Planner:
                     name = f"_agg{len(aggs)}"
                     aggs.append((name, spec))
                     return ir.AggRef(len(aggs) - 1, spec.dtype)
+                if x.name == "grouping":
+                    if grouping_keys is None:
+                        raise PlanError("grouping() outside GROUP BY "
+                                        "ROLLUP/GROUPING SETS")
+                    arg_ir = rec(x.args[0])
+                    idx = grouping_keys.get(repr(arg_ir))
+                    if idx is None:
+                        raise PlanError(
+                            f"grouping() argument {arg_ir!r} is not a "
+                            "group key")
+                    return ir.GroupingRef(idx)
+                if x.name == "coalesce":
+                    args = [rec(a) for a in x.args]
+                    dt = args[0].dtype
+                    for a in args[1:]:
+                        if not isinstance(a, ir.Lit) or a.value is not None:
+                            dt = _unify(dt, a.dtype)
+                    whens = [(ir.IsNullIR(a, negated=True), a)
+                             for a in args[:-1]]
+                    return ir.CaseIR(whens, args[-1], dt)
+                if x.name == "nullif":
+                    a, b = rec(x.args[0]), rec(x.args[1])
+                    return ir.CaseIR([(ir.Cmp("=", a, b),
+                                       ir.Lit(None, a.dtype))], a, a.dtype)
+                if x.name == "abs":
+                    a = rec(x.args[0])
+                    zero = ir.Lit(0, INT32)
+                    return ir.CaseIR(
+                        [(ir.Cmp("<", a, zero), ir.Neg(a, a.dtype))], a,
+                        a.dtype)
                 raise PlanError(f"unknown function {x.name}")
             if isinstance(x, ast.CaseWhen):
                 whens = [(rec(c), rec(v)) for c, v in x.whens]
@@ -1100,6 +1258,28 @@ def _unique_key_of(node: P.Node) -> tuple:
             out.append(mapping[k])
         return tuple(out)
     return ()
+
+
+def _replace_refs(e: ir.IR, sub) -> ir.IR:
+    """Structurally clone `e`, replacing any node where sub(node) returns
+    non-None (applied pre-order; replaced subtrees are not descended)."""
+    if e is None:
+        return None
+    r = sub(e)
+    if r is not None:
+        return r
+    clone = e.__class__(**vars(e))
+    for fname, v in vars(clone).items():
+        if isinstance(v, ir.IR):
+            setattr(clone, fname, _replace_refs(v, sub))
+        elif isinstance(v, list):
+            setattr(clone, fname, [
+                tuple(_replace_refs(y, sub) if isinstance(y, ir.IR) else y
+                      for y in it) if isinstance(it, tuple)
+                else (_replace_refs(it, sub) if isinstance(it, ir.IR)
+                      else it)
+                for it in v])
+    return clone
 
 
 def _fold_const(e: ir.IR) -> ir.IR:

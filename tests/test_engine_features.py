@@ -1,0 +1,252 @@
+"""Unit tests for the TPC-DS-motivated engine features: window
+functions, ROLLUP/GROUPING SETS, INTERSECT/EXCEPT, stddev, coalesce.
+
+Each feature is checked three ways where practical: CPU oracle vs
+hand-computed pandas, then device engine vs CPU oracle (the standard
+differential contract).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from nds_tpu.engine.device_exec import make_device_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.engine.types import INT32, INT64, Schema, decimal, varchar
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.sql.planner import CatalogInfo
+
+from tests.test_device_engine import assert_frames_close
+
+N = 500
+
+
+def _catalog():
+    sales = Schema.of(
+        ("s_id", INT32, False), ("s_cat", varchar(10), False),
+        ("s_store", INT32, False), ("s_qty", INT32, True),
+        ("s_price", decimal(12, 2), False), ("s_day", INT32, False))
+    other = Schema.of(("o_cat", varchar(10), False),
+                      ("o_store", INT32, False))
+    return CatalogInfo({"sales": sales, "other": other},
+                       {"sales": ["s_id"]},
+                       {"sales": N, "other": 60})
+
+
+def _data():
+    rng = np.random.default_rng(42)
+    cats = np.array(["alpha", "beta", "gamma", "delta"], dtype=object)
+    qty = rng.integers(1, 50, N)
+    qty_valid = rng.random(N) >= 0.08
+    sales = {
+        "s_id": np.arange(N, dtype=np.int32),
+        "s_cat": cats[rng.integers(0, 4, N)],
+        "s_store": rng.integers(1, 6, N).astype(np.int32),
+        "s_qty": np.where(qty_valid, qty, 0).astype(np.int32),
+        "s_qty#null": qty_valid,
+        "s_price": rng.integers(100, 99999, N).astype(np.int64),
+        "s_day": rng.integers(1, 31, N).astype(np.int32),
+    }
+    other = {
+        "o_cat": cats[rng.integers(0, 3, 60)],
+        "o_store": rng.integers(1, 8, 60).astype(np.int32),
+    }
+    return sales, other
+
+
+@pytest.fixture(scope="module")
+def sessions():
+    cat = _catalog()
+    sales, other = _data()
+
+    def build(factory=None):
+        s = Session(cat, factory)
+        s.register_table(from_arrays(
+            "sales", cat.schemas["sales"], sales))
+        s.register_table(from_arrays(
+            "other", cat.schemas["other"], other))
+        return s
+
+    return build(), build(make_device_factory())
+
+
+@pytest.fixture(scope="module")
+def pdf():
+    sales, _ = _data()
+    df = pd.DataFrame({k: v for k, v in sales.items()
+                       if not k.endswith("#null")})
+    df["s_qty"] = df["s_qty"].where(sales["s_qty#null"])
+    return df
+
+
+def both(sessions, sql):
+    cpu, dev = sessions
+    exp = cpu.sql(sql).to_pandas()
+    got = dev.sql(sql).to_pandas()
+    assert_frames_close(got, exp, sql[:40])
+    return exp
+
+
+# ---------------------------------------------------------------- windows
+
+def test_rank_window(sessions, pdf):
+    sql = ("select s_id, rank() over (partition by s_cat "
+           "order by s_price desc) rk from sales order by s_id")
+    exp = both(sessions, sql)
+    pr = pdf.sort_values("s_id")
+    expected = pdf.groupby("s_cat")["s_price"].rank(
+        method="min", ascending=False).astype(np.int64)
+    assert list(exp.sort_values("s_id")["rk"]) == list(
+        expected[pr.index])
+
+
+def test_dense_rank_and_row_number(sessions, pdf):
+    sql = ("select s_id, dense_rank() over (partition by s_store "
+           "order by s_day) dr, row_number() over (partition by "
+           "s_store order by s_day, s_id) rn from sales order by s_id")
+    exp = both(sessions, sql)
+    dr = pdf.groupby("s_store")["s_day"].rank(
+        method="dense").astype(np.int64)
+    assert list(exp.sort_values("s_id")["dr"]) == list(
+        dr[pdf.sort_values("s_id").index])
+
+
+def test_partition_sum_avg(sessions, pdf):
+    sql = ("select s_id, sum(s_price) over (partition by s_cat) tot, "
+           "avg(s_qty) over (partition by s_store) aq "
+           "from sales order by s_id")
+    exp = both(sessions, sql)
+    tot = pdf.groupby("s_cat")["s_price"].transform("sum") / 100.0
+    np.testing.assert_allclose(
+        exp.sort_values("s_id")["tot"].to_numpy(dtype=float),
+        tot[pdf.sort_values("s_id").index].to_numpy(), rtol=1e-9)
+    aq = pdf.groupby("s_store")["s_qty"].transform("mean")
+    np.testing.assert_allclose(
+        exp.sort_values("s_id")["aq"].to_numpy(dtype=float),
+        aq[pdf.sort_values("s_id").index].to_numpy(), rtol=1e-9)
+
+
+def test_cumulative_window(sessions, pdf):
+    sql = ("select s_id, sum(s_price) over (partition by s_cat "
+           "order by s_id rows between unbounded preceding and "
+           "current row) c from sales order by s_id")
+    exp = both(sessions, sql)
+    c = pdf.sort_values("s_id").groupby("s_cat")["s_price"].cumsum() / 100
+    np.testing.assert_allclose(
+        exp.sort_values("s_id")["c"].to_numpy(dtype=float),
+        c.to_numpy(), rtol=1e-9)
+
+
+def test_range_default_frame_ties_share_value(sessions, pdf):
+    # default frame with ORDER BY: peers (same s_day) share the
+    # peer-group-final running sum
+    sql = ("select s_id, sum(s_qty) over (partition by s_cat "
+           "order by s_day) rs from sales order by s_id")
+    exp = both(sessions, sql)
+    df = pdf.copy()
+    base = (df.sort_values(["s_cat", "s_day"], kind="stable")
+            .groupby("s_cat")["s_qty"].cumsum())
+    df["_cum"] = base
+    peers = df.groupby(["s_cat", "s_day"])["_cum"].transform("max")
+    np.testing.assert_allclose(
+        exp.sort_values("s_id")["rs"].to_numpy(dtype=float),
+        peers[pdf.sort_values("s_id").index].to_numpy(), rtol=1e-9)
+
+
+def test_window_over_aggregate(sessions, pdf):
+    sql = ("select s_cat, s_store, sum(s_price) sp, "
+           "rank() over (partition by s_cat order by sum(s_price) desc) "
+           "rk from sales group by s_cat, s_store order by s_cat, rk")
+    exp = both(sessions, sql)
+    g = pdf.groupby(["s_cat", "s_store"])["s_price"].sum().reset_index()
+    g["rk"] = g.groupby("s_cat")["s_price"].rank(
+        method="min", ascending=False).astype(np.int64)
+    g = g.sort_values(["s_cat", "rk"])
+    assert list(exp["rk"]) == list(g["rk"])
+
+
+# ------------------------------------------------------------------ rollup
+
+def test_rollup_counts(sessions, pdf):
+    sql = ("select s_cat, s_store, count(*) c, sum(s_price) sp "
+           "from sales group by rollup(s_cat, s_store) "
+           "order by s_cat nulls last, s_store nulls last")
+    exp = both(sessions, sql)
+    # grand-total row: NULL cat, NULL store, count == N
+    total = exp[exp["s_cat"].isna() & exp["s_store"].isna()]
+    assert len(total) == 1
+    assert int(total["c"].iloc[0]) == N
+    # per-cat subtotal rows (store IS NULL, cat NOT NULL)
+    sub = exp[exp["s_cat"].notna() & exp["s_store"].isna()]
+    gc = pdf.groupby("s_cat").size()
+    assert dict(zip(sub["s_cat"], sub["c"].astype(int))) == dict(gc)
+    # full detail rows count
+    detail = exp[exp["s_cat"].notna() & exp["s_store"].notna()]
+    assert len(detail) == len(pdf.groupby(["s_cat", "s_store"]))
+
+
+def test_grouping_function(sessions, pdf):
+    sql = ("select s_cat, grouping(s_cat) g1, grouping(s_store) g2, "
+           "count(*) c from sales group by rollup(s_cat, s_store) "
+           "order by g1, g2, s_cat nulls last")
+    exp = both(sessions, sql)
+    assert set(zip(exp["g1"], exp["g2"])) == {(0, 0), (0, 1), (1, 1)}
+
+
+def test_grouping_sets(sessions, pdf):
+    sql = ("select s_cat, s_store, count(*) c from sales "
+           "group by grouping sets((s_cat), (s_store)) "
+           "order by s_cat nulls last, s_store nulls last")
+    exp = both(sessions, sql)
+    assert len(exp) == pdf["s_cat"].nunique() + pdf["s_store"].nunique()
+
+
+def test_rollup_with_rank_window(sessions, pdf):
+    # the q36/q70/q86 shape: rank within rollup level
+    sql = ("select s_cat, s_store, sum(s_price) sp, "
+           "grouping(s_cat) + grouping(s_store) lochierarchy, "
+           "rank() over (partition by grouping(s_cat) + "
+           "grouping(s_store) order by sum(s_price) desc) rk "
+           "from sales group by rollup(s_cat, s_store) "
+           "order by lochierarchy desc, rk")
+    both(sessions, sql)
+
+
+# ----------------------------------------------------------------- set ops
+
+def test_intersect(sessions, pdf):
+    sql = ("select s_cat, s_store from sales intersect "
+           "select o_cat, o_store from other order by s_cat, s_store")
+    exp = both(sessions, sql)
+    _, other = _data()
+    l = set(zip(pdf["s_cat"], pdf["s_store"]))
+    r = set(zip(other["o_cat"], other["o_store"]))
+    assert len(exp) == len(l & r)
+
+
+def test_except(sessions, pdf):
+    sql = ("select s_cat, s_store from sales except "
+           "select o_cat, o_store from other order by s_cat, s_store")
+    exp = both(sessions, sql)
+    _, other = _data()
+    l = set(zip(pdf["s_cat"], pdf["s_store"]))
+    r = set(zip(other["o_cat"], other["o_store"]))
+    assert len(exp) == len(l - r)
+
+
+# ------------------------------------------------------------- aggregates
+
+def test_stddev_samp(sessions, pdf):
+    sql = ("select s_cat, stddev_samp(s_qty) sd from sales "
+           "group by s_cat order by s_cat")
+    exp = both(sessions, sql)
+    sd = pdf.groupby("s_cat")["s_qty"].std(ddof=1)
+    np.testing.assert_allclose(exp["sd"].to_numpy(dtype=float),
+                               sd.to_numpy(), rtol=1e-9)
+
+
+def test_coalesce(sessions, pdf):
+    sql = ("select s_id, coalesce(s_qty, 0) q from sales order by s_id")
+    exp = both(sessions, sql)
+    q = pdf["s_qty"].fillna(0).astype(np.int64)
+    assert list(exp.sort_values("s_id")["q"].astype(int)) == list(q)
