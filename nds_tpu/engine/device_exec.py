@@ -221,43 +221,54 @@ class DeviceExecutor:
 
     # ------------------------------------------------------------------ API
 
+    DEFAULT_SLACK = 2.0
+
     def execute(self, planned: P.PlannedQuery, key: object = None):
         import time as _time
         key = key if key is not None else id(planned)
         self.last_timings = {"compile_ms": 0.0}
-        if key not in self._compiled:
-            # the cache entry holds a strong ref to the plan: id()-keyed
-            # entries must keep their plan alive or a recycled address
-            # could serve another query's compiled program
-            t0 = _time.perf_counter()
-            jitted, side = self._compile(planned)
+        # the cache entry holds a strong ref to the plan: id()-keyed
+        # entries must keep their plan alive or a recycled address
+        # could serve another query's compiled program
+        entry = self._compiled.setdefault(
+            key, {"slack": self.DEFAULT_SLACK, "ref": planned})
+        for _attempt in range(4):
+            if "compiled" not in entry:
+                t0 = _time.perf_counter()
+                jitted, side = self._compile(planned, entry["slack"])
+                bufs = self._collect_buffers(planned)
+                # AOT-compile now so compile cost is attributed
+                # separately from steady-state execution
+                entry["compiled"] = jitted.lower(bufs).compile()
+                entry["side"] = side
+                self.last_timings["compile_ms"] += (
+                    _time.perf_counter() - t0) * 1000
             bufs = self._collect_buffers(planned)
-            # AOT-compile now so compile cost is attributed separately
-            # from steady-state execution
-            compiled = jitted.lower(bufs).compile()
-            self.last_timings["compile_ms"] = (
-                _time.perf_counter() - t0) * 1000
-            self._compiled[key] = (compiled, side, planned)
-        compiled, side, _ref = self._compiled[key]
-        bufs = self._collect_buffers(planned)
-        t1 = _time.perf_counter()
-        row, outs = compiled(bufs)
-        jax.block_until_ready(row)
-        t2 = _time.perf_counter()
-        out = self._materialize(planned, row, outs, side)
-        t3 = _time.perf_counter()
-        self.last_timings["execute_ms"] = (t2 - t1) * 1000
-        self.last_timings["materialize_ms"] = (t3 - t2) * 1000
-        return out
+            t1 = _time.perf_counter()
+            row, outs, overflow = entry["compiled"](bufs)
+            jax.block_until_ready(row)
+            t2 = _time.perf_counter()
+            if int(overflow) == 0:
+                out = self._materialize(planned, row, outs,
+                                        entry["side"])
+                t3 = _time.perf_counter()
+                self.last_timings["execute_ms"] = (t2 - t1) * 1000
+                self.last_timings["materialize_ms"] = (t3 - t2) * 1000
+                return out
+            # M:N join capacity exceeded: recompile with doubled slack
+            entry.pop("compiled", None)
+            entry["slack"] *= 2
+        raise DeviceExecError("join expansion overflow after retries")
 
-    def _compile(self, planned: P.PlannedQuery):
+    def _compile(self, planned: P.PlannedQuery,
+                 slack: float = DEFAULT_SLACK):
         side = {}
 
         def fn(bufs):
-            tr = _Trace(self, bufs)
+            tr = _Trace(self, bufs, slack)
             row, outs, dicts = tr.run_query(planned)
             side["dicts"] = dicts
-            return row, outs
+            return row, outs, tr.total_overflow()
 
         return jax.jit(fn), side
 
@@ -332,11 +343,22 @@ class _Trace:
     flow here runs at trace time; host-side numpy work (dictionary
     predicate tables, key bounds) becomes XLA constants."""
 
-    def __init__(self, ex: DeviceExecutor, bufs: dict):
+    def __init__(self, ex: DeviceExecutor, bufs: dict,
+                 slack: float = 2.0):
         self.ex = ex
         self.bufs = bufs
+        self.slack = slack
         self.scalars: dict[int, tuple] = {}
         self._cache: dict[int, DCtx] = {}
+        self._overflows: list = []
+
+    def total_overflow(self):
+        if not self._overflows:
+            return jnp.zeros((), jnp.int64)
+        tot = self._overflows[0].astype(jnp.int64)
+        for o in self._overflows[1:]:
+            tot = tot + o.astype(jnp.int64)
+        return tot
 
     def run_query(self, planned: P.PlannedQuery):
         for i, sub in enumerate(planned.scalar_subplans):
@@ -538,18 +560,42 @@ class _Trace:
             if node.residual is not None:
                 out = self._apply_filter(out, node.residual)
             return out
-        # right side not unique: probe from the right against a unique left
-        # (FK-side expansion; the planner orients star joins the other way,
-        # this path serves customer LEFT JOIN orders-style plans, q13)
-        ks, order = self._build_lookup(lkey, lok)
-        lidx, hit = self._probe(ks, order, rkey, rok)
+        # right side not unique
         if node.kind == "inner":
-            out = DCtx(rctx.n, rctx.row & hit)
-            out.cols.update(rctx.cols)
-            out.cols.update(lctx.gather(lidx).cols)
+            # generic M:N join: sort the left side by key, find each
+            # right row's match RANGE via two searchsorteds, expand into
+            # a fixed-capacity slot array (cumsum offsets -> slot->pair
+            # mapping). Capacity = slack * max(|L|, |R|); overflow is
+            # counted in-program and the executor retries with doubled
+            # slack — the static-shape answer to data-dependent join
+            # cardinality (SURVEY §7 hard part 2)
+            ks, order = self._build_lookup(lkey, lok)
+            lo = jnp.searchsorted(ks, rkey, side="left")
+            hi = jnp.searchsorted(ks, rkey, side="right")
+            cnt = jnp.where(rok, hi - lo, 0).astype(jnp.int64)
+            offs = jnp.cumsum(cnt)
+            total = offs[-1]
+            K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
+            slots = jnp.arange(K)
+            ridx = jnp.clip(jnp.searchsorted(offs, slots, side="right"),
+                            0, rctx.n - 1)
+            prev = jnp.where(ridx > 0, jnp.take(offs, ridx - 1), 0)
+            within = slots - prev
+            lpos = jnp.clip(jnp.take(lo, ridx) + within, 0, lctx.n - 1)
+            lidx2 = jnp.take(order, lpos)
+            present = slots < jnp.minimum(total, K)
+            self._overflows.append(jnp.maximum(total - K, 0))
+            out = DCtx(K, present)
+            out.cols.update(lctx.gather(lidx2).cols)
+            out.cols.update(rctx.gather(ridx).cols)
             if node.residual is not None:
                 out = self._apply_filter(out, node.residual)
             return out
+        # left outer: probe from the right against a unique left
+        # (FK-side expansion; the planner orients star joins the other
+        # way, this path serves customer LEFT JOIN orders plans, q13)
+        ks, order = self._build_lookup(lkey, lok)
+        lidx, hit = self._probe(ks, order, rkey, rok)
         # left outer with expansion: block A = matched right rows with
         # gathered left columns; block B = left rows with no surviving match
         presentA = rctx.row & hit
@@ -1442,7 +1488,7 @@ class _Trace:
 
     def _eval_case(self, e: ir.CaseIR, ctx: DCtx) -> DVal:
         if isinstance(e.dtype, StringType):
-            raise DeviceExecError("string-valued CASE not yet on device")
+            return self._eval_case_string(e, ctx)
         conds, vals, branch_valids = [], [], []
         for c, v in e.whens:
             cdv = self.eval(c, ctx)
@@ -1476,6 +1522,47 @@ class _Trace:
                 bvv = bv if bv is not None else jnp.ones(ctx.n, bool)
                 valid = jnp.where(c, bvv, valid)
         return DVal(out, valid)
+
+    def _eval_case_string(self, e: ir.CaseIR, ctx: DCtx) -> DVal:
+        """String-valued CASE: union the branch dictionaries on the host,
+        remap every branch's codes, then where-chain over int codes —
+        strings still never reach the device."""
+        branches = []       # (cond_mask, DVal)
+        for c, v in e.whens:
+            cdv = self.eval(c, ctx)
+            cm = cdv.arr.astype(bool)
+            if cdv.valid is not None:
+                cm = cm & cdv.valid
+            branches.append((cm, self.eval(v, ctx)))
+        else_dv = (self.eval(e.else_, ctx) if e.else_ is not None
+                   else DVal(jnp.zeros(ctx.n, jnp.int32),
+                             jnp.zeros(ctx.n, dtype=bool),
+                             np.array([""], dtype=object)))
+        dvals = [dv for _, dv in branches] + [else_dv]
+        for dv in dvals:
+            if dv.sdict is None:
+                raise DeviceExecError(
+                    "string CASE branch without dictionary")
+        union = np.array(sorted(set().union(
+            *[set(dv.sdict.astype(str)) for dv in dvals])), dtype=object)
+        remapped = []
+        for dv in dvals:
+            table = jnp.asarray(np.searchsorted(
+                union.astype(str), dv.sdict.astype(str)).astype(np.int32))
+            arr = jnp.take(table, dv.arr)
+            if arr.ndim == 0:
+                arr = jnp.broadcast_to(arr, (ctx.n,))
+            remapped.append(arr)
+        out = remapped[-1]
+        valid = (else_dv.valid if else_dv.valid is not None
+                 else jnp.ones(ctx.n, dtype=bool))
+        for (cm, dv), arr in zip(reversed(branches),
+                                 reversed(remapped[:-1])):
+            out = jnp.where(cm, arr, out)
+            bv = (dv.valid if dv.valid is not None
+                  else jnp.ones(ctx.n, dtype=bool))
+            valid = jnp.where(cm, bv, valid)
+        return DVal(out, valid, union, 0, max(len(union) - 1, 0))
 
     def _coerce(self, dv: DVal, src: DType, dst: DType):
         if repr(src) == repr(dst):

@@ -35,7 +35,36 @@ def available_templates() -> list[int]:
 # qualification substitution parameters (spec-shaped defaults bound to
 # the builtin generator's value domains)
 QUALIFICATION: dict[int, dict] = {
+    1: {"year": 2000, "state": "TX"},
     3: {"manufact": 128, "month": 11},
+    6: {"year": 2001, "month": 1},
+    10: {"county1": "Williamson County", "county2": "Walker County",
+         "county3": "Ziebach County", "county4": "Franklin County",
+         "county5": "Bronx County", "year": 2002, "month": 1},
+    12: {"cat1": "Sports", "cat2": "Books", "cat3": "Home",
+         "date": "1999-02-22"},
+    16: {"date": "2002-02-01", "state": "GA",
+         "county": "Williamson County"},
+    17: {"year": 2001},
+    20: {"cat1": "Sports", "cat2": "Books", "cat3": "Home",
+         "date": "1999-02-22"},
+    25: {"year": 2001},
+    28: {"lp1": 90, "ca1": 459, "wc1": 31,
+         "lp2": 142, "ca2": 1000, "wc2": 50,
+         "lp3": 66, "ca3": 1500, "wc3": 20,
+         "lp4": 135, "ca4": 200, "wc4": 60,
+         "lp5": 28, "ca5": 800, "wc5": 40,
+         "lp6": 120, "ca6": 600, "wc6": 70},
+    29: {"year": 2000},
+    32: {"manufact": 320, "date": "1998-03-18"},
+    37: {"price": 62, "date": "2000-02-01", "m1": 129, "m2": 270,
+         "m3": 821, "m4": 423},
+    82: {"price": 62, "date": "2000-05-25", "m1": 129, "m2": 270,
+         "m3": 821, "m4": 423},
+    92: {"manufact": 350, "date": "2000-01-27"},
+    94: {"date": "1999-02-01", "state": "IL", "company": "pri"},
+    98: {"cat1": "Sports", "cat2": "Books", "cat3": "Home",
+         "date": "1999-02-22"},
     7: {"gender": "M", "marital": "S", "education": "College",
         "year": 2000},
     9: {"t1": 3000, "t2": 3000, "t3": 3000, "t4": 3000, "t5": 3000},

@@ -161,19 +161,17 @@ class DistributedExecutor(dx.DeviceExecutor):
 
 class _DistTrace(dx._Trace):
     def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float):
-        super().__init__(ex, bufs)
+        super().__init__(ex, bufs, slack)
         self.n_dev = ex.n_dev
-        self.slack = slack
-        self._overflows: list = []
 
     def total_overflow(self):
         if not self._overflows:
             return jnp.zeros((), jnp.int64)
-        tot = self._overflows[0]
+        tot = self._overflows[0].astype(jnp.int64)
         for o in self._overflows[1:]:
-            tot = tot + o
+            tot = tot + o.astype(jnp.int64)
         # every device sees every exchange; max across devices is enough
-        return lax.pmax(tot.astype(jnp.int64), DATA_AXIS)
+        return lax.pmax(tot, DATA_AXIS)
 
     # ------------------------------------------------------------- helpers
 

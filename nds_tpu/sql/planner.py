@@ -497,6 +497,11 @@ class Planner:
             rels.append(rel)
         if sub.joins:
             raise PlanError("explicit JOIN inside subquery not supported yet")
+        if sub.set_ops:
+            # would silently plan only the first branch — template must
+            # wrap the union in a derived table instead
+            raise PlanError("set operation directly inside IN/EXISTS "
+                            "subquery: wrap it in a derived table")
 
         edges: list[tuple] = []
         residuals: list[ir.IR] = []
@@ -643,6 +648,9 @@ class Planner:
             else:
                 root = P.Project(node, [("__scalar__", item_ir)],
                                  self._fresh("scalp"))
+                if sub.distinct:
+                    # (select distinct <expr> ...) used as a scalar
+                    root = P.Distinct(root)
             sid = len(self.scalar_subplans)
             self.scalar_subplans.append(root)
             sref = ir.ScalarRef(sid, root.output[0][1])
@@ -1141,6 +1149,16 @@ class Planner:
                     a, b = rec(x.args[0]), rec(x.args[1])
                     return ir.CaseIR([(ir.Cmp("=", a, b),
                                        ir.Lit(None, a.dtype))], a, a.dtype)
+                if x.name == "round":
+                    a = rec(x.args[0])
+                    nd = 0
+                    if len(x.args) > 1:
+                        d = rec(x.args[1])
+                        if not isinstance(d, ir.Lit):
+                            raise PlanError("round() digits must be "
+                                            "literal")
+                        nd = int(d.value)
+                    return ir.CastIR(a, DecimalType(38, nd))
                 if x.name == "abs":
                     a = rec(x.args[0])
                     zero = ir.Lit(0, INT32)

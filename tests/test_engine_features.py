@@ -250,3 +250,23 @@ def test_coalesce(sessions, pdf):
     exp = both(sessions, sql)
     q = pdf["s_qty"].fillna(0).astype(np.int64)
     assert list(exp.sort_values("s_id")["q"].astype(int)) == list(q)
+
+
+# -------------------------------------------------------------- M:N joins
+
+def test_many_to_many_inner_join(sessions, pdf):
+    """Neither side unique on the join key: the device engine must
+    expand match ranges (slack-capacity path), not pick one match."""
+    sql = ("select a.s_id id_a, b.s_id id_b from sales a, sales b "
+           "where a.s_store = b.s_store "
+           "and a.s_cat = 'alpha' and b.s_cat = 'beta' "
+           "and a.s_day = 1 and b.s_day <= 3 "
+           "order by id_a, id_b")
+    exp = both(sessions, sql)
+    a = pdf[(pdf.s_cat == "alpha") & (pdf.s_day == 1)]
+    b = pdf[(pdf.s_cat == "beta") & (pdf.s_day <= 3)]
+    m = a.merge(b, on=["s_store"])
+    # exact pandas-merge cardinality is the M:N correctness contract
+    # (the old unique-build path would keep one match per probe row)
+    assert len(exp) == len(m)
+    assert exp["id_a"].duplicated().any(), "join must expand matches"
