@@ -50,21 +50,63 @@ class Session:
 
     @classmethod
     def for_nds(cls, executor_factory=None,
-                use_decimal: bool = True) -> "Session":
-        from nds_tpu.nds.schema import PRIMARY_KEYS, SIZES, get_schemas
-        cat = CatalogInfo(get_schemas(use_decimal), PRIMARY_KEYS,
-                          dict(SIZES))
+                use_decimal: bool = True,
+                include_maintenance: bool = False) -> "Session":
+        from nds_tpu.nds.schema import (
+            PRIMARY_KEYS, SIZES, get_maintenance_schemas, get_schemas,
+        )
+        schemas = get_schemas(use_decimal)
+        keys = dict(PRIMARY_KEYS)
+        sizes = dict(SIZES)
+        if include_maintenance:
+            # the 12 s_*/delete staging tables the LF_*/DF_* refresh
+            # functions read (`nds/nds_maintenance.py:270-274` registers
+            # them as temp views)
+            schemas = {**schemas, **get_maintenance_schemas(use_decimal)}
+            keys.update({"s_purchase": ("purc_purchase_id",),
+                         "s_catalog_order": ("cord_order_id",),
+                         "s_web_order": ("word_order_id",)})
+            sizes.update({t: 100.0 for t in
+                          get_maintenance_schemas(use_decimal)})
+        cat = CatalogInfo(schemas, keys, sizes)
         return cls(cat, executor_factory)
 
     def register_table(self, table: HostTable) -> None:
         self.tables[table.name] = table
 
     def plan(self, sql_text: str):
+        return self.plan_ast(parse(sql_text))
+
+    def plan_ast(self, stmt):
         planner = Planner(self.catalog, self.views)
-        return planner.plan_statement(parse(sql_text))
+        return planner.plan_statement(stmt)
 
     def _views_signature(self) -> frozenset:
         return frozenset(self._view_sql.items())
+
+    def invalidate(self) -> None:
+        """Drop every content-derived cache after a table mutation: the
+        plan cache (plans bake in table stats/bounds) and any executor
+        the factory holds (device buffers + XLA programs key on table
+        shapes). The analog of Spark re-planning on a new table version."""
+        self._plan_cache.clear()
+        inv = getattr(self._executor_factory, "invalidate", None)
+        if inv is not None:
+            inv()
+
+    def _run_dml(self, action: str, name: str, payload) -> None:
+        from nds_tpu.engine import dml
+        table = self.tables.get(name)
+        if table is None:
+            raise ValueError(f"DML target {name!r} is not registered")
+        if action == "insert":
+            executor = self._executor_factory(self.tables)
+            result = executor.execute(payload)
+            self.tables[name] = dml.append_rows(table, result)
+        else:  # delete
+            keep = dml.delete_mask(self, table, payload)
+            self.tables[name] = dml.filter_rows(table, keep)
+        self.invalidate()
 
     def sql(self, sql_text: str) -> ResultTable | None:
         key = (sql_text, self._views_signature())
@@ -81,8 +123,15 @@ class Session:
                 self._view_sql[name] = sql_text
                 return None
             if action == "drop_view":
+                if name not in self.views and node != "if_exists":
+                    raise ValueError(f"view {name!r} does not exist")
                 self.views.pop(name, None)
                 self._view_sql.pop(name, None)
+                return None
+            if action in ("insert", "delete"):
+                # never replay a stale DML plan against mutated tables
+                self._plan_cache.pop(key, None)
+                self._run_dml(action, name, node)
                 return None
         executor = self._executor_factory(self.tables)
         return executor.execute(planned)

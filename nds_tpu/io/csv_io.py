@@ -69,9 +69,15 @@ def read_tbl(paths: list[str] | str, name: str, schema: Schema,
 
 
 def from_arrow(name: str, schema: Schema, t: pa.Table) -> HostTable:
+    """Arrow table -> HostTable, carrying arrow validity bitmaps over as
+    engine null masks (True = valid). Null slots are filled with 0/"" in
+    the value arrays so downstream numpy code never sees NaN."""
     cols: dict[str, HostColumn] = {}
     for f in schema:
         arr = t.column(f.name).combine_chunks()
+        mask = None
+        if arr.null_count:
+            mask = arr.is_valid().to_numpy(zero_copy_only=False)
         if isinstance(f.dtype, StringType):
             # arrow-native dictionary encode, then remap codes so the
             # dictionary is sorted (code order == lexicographic order);
@@ -79,29 +85,35 @@ def from_arrow(name: str, schema: Schema, t: pa.Table) -> HostTable:
             if not pa.types.is_dictionary(arr.type):
                 arr = arr.dictionary_encode()
             raw_dict = np.asarray(arr.dictionary.to_pylist(), dtype=object)
-            raw_codes = arr.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+            raw_codes = arr.indices.fill_null(0).to_numpy(
+                zero_copy_only=False).astype(np.int32)
             order = np.argsort(raw_dict.astype(str), kind="stable")
             remap = np.empty(len(raw_dict), dtype=np.int32)
             remap[order] = np.arange(len(raw_dict), dtype=np.int32)
             codes = remap[raw_codes] if len(raw_dict) else raw_codes
-            cols[f.name] = HostColumn(f.dtype, codes, raw_dict[order])
+            cols[f.name] = HostColumn(f.dtype, codes, raw_dict[order], mask)
         elif isinstance(f.dtype, DecimalType):
             s = f.dtype.scale
             if f.dtype.precision <= 15:
                 # float64 is exact for <= 15 significant digits: vectorized
                 as_f = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
-                ints = np.round(as_f * 10**s).astype(np.int64)
+                ints = np.round(np.nan_to_num(as_f) * 10**s).astype(np.int64)
             else:
                 ints = np.array(
                     [0 if v is None else int(v.scaleb(s)) for v in arr.to_pylist()],
                     dtype=np.int64)
-            cols[f.name] = HostColumn(f.dtype, ints)
+            cols[f.name] = HostColumn(f.dtype, ints, None, mask)
         elif isinstance(f.dtype, DateType):
-            d = arr.cast(pa.int32())
-            cols[f.name] = HostColumn(f.dtype, d.to_numpy(zero_copy_only=False))
+            d = arr.cast(pa.int32()).fill_null(0)
+            cols[f.name] = HostColumn(
+                f.dtype, d.to_numpy(zero_copy_only=False), None, mask)
+        elif isinstance(f.dtype, (IntType, FloatType)):
+            cols[f.name] = HostColumn(
+                f.dtype, arr.fill_null(0).to_numpy(zero_copy_only=False),
+                None, mask)
         else:
             cols[f.name] = HostColumn(
-                f.dtype, arr.to_numpy(zero_copy_only=False))
+                f.dtype, arr.to_numpy(zero_copy_only=False), None, mask)
     return HostTable(name, schema, cols)
 
 
@@ -110,9 +122,11 @@ def to_arrow(table: HostTable) -> pa.Table:
     for f in table.schema:
         col = table.columns[f.name]
         names.append(f.name)
+        # arrow mask convention: True = NULL (inverse of the engine's)
+        amask = None if col.null_mask is None else ~col.null_mask
         if col.is_string:
             dict_arr = pa.DictionaryArray.from_arrays(
-                pa.array(col.values, type=pa.int32()),
+                pa.array(col.values, type=pa.int32(), mask=amask),
                 pa.array(list(col.dictionary), type=pa.string()))
             arrays.append(dict_arr)
         elif isinstance(f.dtype, DecimalType):
@@ -121,15 +135,20 @@ def to_arrow(table: HostTable) -> pa.Table:
             if f.dtype.precision <= 15:
                 # exact: |value| < 10^15 so float64 round-trips the cents
                 as_f = col.values.astype(np.float64) / 10**s
-                arrays.append(pa.array(as_f).cast(target, safe=False))
+                arrays.append(
+                    pa.array(as_f, mask=amask).cast(target, safe=False))
             else:
                 from decimal import Decimal
                 vals = [Decimal(int(v)).scaleb(-s) for v in col.values]
+                if amask is not None:
+                    vals = [None if m else v
+                            for v, m in zip(vals, amask)]
                 arrays.append(pa.array(vals, type=target))
         elif isinstance(f.dtype, DateType):
-            arrays.append(pa.array(col.values, type=pa.int32()).cast(pa.date32()))
+            arrays.append(pa.array(col.values, type=pa.int32(),
+                                   mask=amask).cast(pa.date32()))
         else:
-            arrays.append(pa.array(col.values))
+            arrays.append(pa.array(col.values, mask=amask))
     return pa.Table.from_arrays(arrays, names=names)
 
 
@@ -161,12 +180,17 @@ def write_tbl(arrays: dict[str, np.ndarray], schema: Schema, path: str,
             ints = arr.astype(np.int64)
             sign = np.where(ints < 0, "-", "")
             mag = np.abs(ints)
-            cols.append([f"{sign[i]}{mag[i] // 10**s}.{mag[i] % 10**s:0{s}d}"
-                         for i in range(n)])
+            vals = [f"{sign[i]}{mag[i] // 10**s}.{mag[i] % 10**s:0{s}d}"
+                    for i in range(n)]
         elif isinstance(f.dtype, DateType):
-            cols.append([str(_EPOCH + int(v)) for v in arr])
+            vals = [str(_EPOCH + int(v)) for v in arr]
         else:
-            cols.append([str(v) for v in arr])
+            vals = [str(v) for v in arr]
+        valid = arrays.get(f.name + "#null")
+        if valid is not None:
+            # dsdgen's NULL convention: an empty field
+            vals = [v if ok else "" for v, ok in zip(vals, valid)]
+        cols.append(vals)
     end = "|\n" if trailing_delimiter else "\n"
     with open(path, "w") as f:
         for row in zip(*cols):

@@ -1,0 +1,102 @@
+"""Warehouse snapshot log: a minimal versioned table format.
+
+The reference leans on Iceberg snapshots for maintenance rollback
+(`nds/nds_rollback.py:46-51` calls
+``system.rollback_to_timestamp``); this is the TPU-native minimal
+equivalent: a ``_snapshots.json`` manifest at the warehouse root maps
+each committed version to {table: [parquet files]}. Mutations write new
+files and append a manifest entry; nothing is rewritten in place, so
+rolling back is truncating the manifest (old files remain valid).
+
+Layout:
+  warehouse/
+    _snapshots.json                  # [{version, timestamp, tables}]
+    store_sales/...                  # v0 files (transcode output)
+    store_sales/_v1/part-0.parquet   # files written by version 1
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+MANIFEST = "_snapshots.json"
+
+
+def _walk_parquet(tdir: str) -> list[str]:
+    return sorted(
+        os.path.relpath(os.path.join(root, f), os.path.dirname(tdir))
+        for root, dirs, files in os.walk(tdir)
+        if not os.path.basename(root).startswith("_v")
+        for f in files if f.endswith(".parquet"))
+
+
+class SnapshotLog:
+    def __init__(self, warehouse_dir: str):
+        self.dir = warehouse_dir
+        self.path = os.path.join(warehouse_dir, MANIFEST)
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.entries = json.load(f)
+        else:
+            self.entries = []
+
+    def _write(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.entries, f, indent=1)
+        os.replace(tmp, self.path)
+
+    def baseline(self, tables: list[str]) -> dict:
+        """Version-0 file map discovered from the transcode layout."""
+        return {t: _walk_parquet(os.path.join(self.dir, t))
+                for t in tables
+                if os.path.isdir(os.path.join(self.dir, t))}
+
+    def current(self, tables: list[str]) -> dict:
+        """{table: [abs paths]} of the latest committed version (or the
+        on-disk baseline when no commits exist)."""
+        if self.entries:
+            m = self.entries[-1]["tables"]
+        else:
+            m = self.baseline(tables)
+        return {t: [os.path.join(self.dir, p) for p in paths]
+                for t, paths in m.items()}
+
+    def commit(self, new_files: dict, note: str = "") -> int:
+        """Append a version whose table map is the previous version's
+        with ``new_files`` ({table: [rel paths]}) replacing those
+        tables' files."""
+        base = (dict(self.entries[-1]["tables"]) if self.entries
+                else self.baseline(list(new_files)))
+        # baseline() above only covers the mutated tables when this is
+        # the first commit; fill in every other on-disk table so the
+        # manifest is complete
+        for t in os.listdir(self.dir):
+            tdir = os.path.join(self.dir, t)
+            if os.path.isdir(tdir) and t not in base:
+                files = _walk_parquet(tdir)
+                if files:
+                    base[t] = files
+        base.update(new_files)
+        version = (self.entries[-1]["version"] + 1 if self.entries
+                   else 1)
+        self.entries.append({"version": version,
+                             "timestamp": time.time(),
+                             "note": note, "tables": base})
+        self._write()
+        return version
+
+    def rollback_to_timestamp(self, ts: float) -> int | None:
+        """Drop every version committed after ``ts``
+        (`nds/nds_rollback.py:46-51` semantics). Returns the surviving
+        version number, or None if rolled back to the baseline."""
+        self.entries = [e for e in self.entries if e["timestamp"] <= ts]
+        self._write()
+        return self.entries[-1]["version"] if self.entries else None
+
+    def version_dir(self, table: str, version: int) -> str:
+        d = os.path.join(self.dir, table, f"_v{version}")
+        os.makedirs(d, exist_ok=True)
+        return d

@@ -1,0 +1,182 @@
+"""NDS whole-benchmark orchestrator.
+
+Behavioral port of `nds/nds_bench.py:367-498`: run the TPC-DS phases as
+subprocesses in spec order — data-gen (base + per-stream refresh sets)
+-> load (transcode) -> stream-gen (RNGSEED = load end timestamp,
+`nds/nds_bench.py:60-74`) -> power -> throughput 1 -> maintenance 1 ->
+throughput 2 -> maintenance 2 — with crash isolation via report-file
+state passing (SURVEY.md §3.4), then compute the 4-term composite
+metric (`nds/nds_bench.py:334-357`):
+
+    Q   = Sq * 99
+    Tpt = Tpower * Sq / 3600 ;  Ttt = (Ttt1 + Ttt2) / 3600
+    Tdm = (Tdm1 + Tdm2) / 3600 ; Tld = 0.01 * Sq * Tload / 3600
+    metric = int(SF * Q / (Tpt * Ttt * Tdm * Tld) ** (1/4))
+
+Config comes from a YAML like `configs/bench_nds.yml` (the reference's
+`nds/bench.yml:18-59`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import subprocess
+import sys
+import time
+
+import yaml
+
+from nds_tpu.nds.transcode import get_load_time, get_rngseed
+from nds_tpu.utils.timelog import TimeLog
+
+
+def _run(cmd: list[str]) -> None:
+    from nds_tpu.utils.power_core import subprocess_env
+    print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True, env=subprocess_env())
+
+
+def get_power_time(time_log_path: str) -> float:
+    for _app, query, ms in TimeLog.read(time_log_path):
+        if query == "Power Test Time":
+            return ms / 1000.0
+    raise ValueError(f"no Power Test Time row in {time_log_path}")
+
+
+def get_maintenance_time(time_log_path: str) -> float:
+    """Tdm seconds from a maintenance CSV log
+    (`nds/nds_bench.py:176-196` reads per-stream refresh times)."""
+    for _app, query, ms in TimeLog.read(time_log_path):
+        if query == "Data Maintenance Time":
+            return ms / 1000.0
+    raise ValueError(f"no Data Maintenance Time row in {time_log_path}")
+
+
+def get_stream_range(num_streams: int, first_or_second: int) -> list[int]:
+    """Stream numbers per throughput test (`nds/nds_bench.py:126-135`):
+    9 streams -> test 1 runs [1..4], test 2 runs [5..8]."""
+    if first_or_second == 1:
+        return list(range(1, num_streams // 2 + 1))
+    return list(range(num_streams // 2 + 1, num_streams))
+
+
+def get_perf_metric(scale: float, num_streams: int, tload: float,
+                    tpower: float, ttt1: float, ttt2: float,
+                    tdm1: float, tdm2: float) -> int:
+    """4-term composite (`nds/nds_bench.py:334-357`)."""
+    sq = max(num_streams, 1)
+    q = sq * 99
+    tpt = (tpower * sq) / 3600.0
+    ttt = (ttt1 + ttt2) / 3600.0
+    tdm = (tdm1 + tdm2) / 3600.0
+    tld = (0.01 * sq * tload) / 3600.0
+    denom = (tpt * ttt * tdm * tld) ** (1.0 / 4.0)
+    return int(scale * q / denom) if denom > 0 else 0
+
+
+def run_full_bench(cfg: dict) -> dict:
+    paths = cfg["paths"]
+    scale = float(cfg.get("scale_factor", 1))
+    parallel = int(cfg.get("parallel", 2))
+    # total stream count is Sq*2+1 in the reference's bench.yml
+    # convention: stream 0 powers, halves run the two throughput tests
+    num_streams = int(cfg.get("num_streams", 2)) * 2 + 1
+    backend = cfg.get("backend", "tpu")
+    skip = cfg.get("skip", {})
+    raw_dir = paths["raw_data"]
+    refresh_base = paths.get("refresh_data",
+                             os.path.join(raw_dir, "_refresh"))
+    wh_dir = paths["warehouse"]
+    stream_dir = paths["streams"]
+    report_dir = paths.get("reports", "bench_reports")
+    os.makedirs(report_dir, exist_ok=True)
+    load_report = os.path.join(report_dir, "load_report.txt")
+    metrics: dict = {"scale": scale, "streams": num_streams}
+
+    if not skip.get("data_gen", False):
+        _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
+              str(scale), str(parallel), raw_dir, "--overwrite_output"])
+        # one refresh set per maintenance run (2 per full bench)
+        for update in (1, 2):
+            _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
+                  str(scale), "1", f"{refresh_base}{update}",
+                  "--update", str(update), "--overwrite_output"])
+    if not skip.get("load_test", False):
+        _run([sys.executable, "-m", "nds_tpu.nds.transcode",
+              raw_dir, wh_dir, load_report])
+    metrics["load_time_s"] = tld = get_load_time(load_report)
+    rngseed = get_rngseed(load_report)
+
+    if not skip.get("stream_gen", False):
+        from nds_tpu.nds.streams import generate_query_streams
+        generate_query_streams(stream_dir, num_streams,
+                               rng_seed=rngseed)
+
+    power_log = os.path.join(report_dir, "power_time.csv")
+    if not skip.get("power_test", False):
+        _run([sys.executable, "-m", "nds_tpu.nds.power",
+              wh_dir, os.path.join(stream_dir, "query_0.sql"), power_log,
+              "--backend", backend,
+              "--json_summary_folder", os.path.join(report_dir, "json")])
+    metrics["power_time_s"] = tpt = get_power_time(power_log)
+
+    ttts, tdms = [], []
+    for round_no in (1, 2):
+        if not skip.get("throughput_test", False):
+            from nds_tpu.nds.throughput import run_streams
+            streams_n = get_stream_range(num_streams, round_no)
+            tstreams = [os.path.join(stream_dir, f"query_{i}.sql")
+                        for i in streams_n]
+            ttt, codes = run_streams(
+                wh_dir, tstreams,
+                os.path.join(report_dir, f"throughput{round_no}"),
+                backend=backend)
+            if any(codes):
+                raise SystemExit(
+                    f"throughput {round_no} streams failed: {codes}")
+            ttts.append(ttt)
+        if not skip.get("maintenance_test", False):
+            dm_log = os.path.join(report_dir,
+                                  f"maintenance{round_no}_time.csv")
+            _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
+                  wh_dir, f"{refresh_base}{round_no}", dm_log,
+                  "--backend", "cpu"])
+            tdms.append(get_maintenance_time(dm_log))
+    metrics["throughput_times_s"] = ttts
+    metrics["maintenance_times_s"] = tdms
+
+    # all four terms or no composite (a fabricated term would silently
+    # skew the geometric mean)
+    if len(ttts) == 2 and len(tdms) == 2:
+        metrics["metric"] = get_perf_metric(
+            scale, num_streams // 2, tld, tpt, ttts[0], ttts[1],
+            tdms[0], tdms[1])
+    else:
+        metrics["metric"] = None
+    out_csv = paths.get("metrics_csv",
+                        os.path.join(report_dir, "metrics.csv"))
+    with open(out_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["scale", "streams", "load_s", "power_s",
+                    "throughput1_s", "throughput2_s", "maintenance1_s",
+                    "maintenance2_s", "metric", "timestamp"])
+        w.writerow([scale, num_streams, tld, tpt,
+                    *(ttts or [None, None]), *(tdms or [None, None]),
+                    metrics["metric"], int(time.time())])
+    print(f"perf metric: {metrics['metric']} (details in {out_csv})")
+    return metrics
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="full NDS benchmark")
+    p.add_argument("config", help="bench YAML (like configs/bench_nds.yml)")
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        cfg = yaml.safe_load(f)
+    run_full_bench(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""NDS Data Maintenance driver.
+
+Behavioral port of `nds/nds_maintenance.py`: register the refresh
+staging tables (`:270-274`), run the 7 LF_* insert functions and 4 DF_*
+delete functions (`INSERT_FUNCS/DELETE_FUNCS:45-58`) with DATE1/DATE2
+substituted from the generated delete/inventory_delete tables
+(`get_delete_date:60-73`, `replace_date:75-96`), record per-function
+times in JSON summaries + the CSV time log, and exit non-zero on
+failures.
+
+TPU-native: DML mutates the host warehouse through the engine
+(`nds_tpu/engine/dml.py`); after all functions run, the mutated fact
+tables are committed as a new snapshot version
+(`nds_tpu/io/snapshots.py`) — the Iceberg-snapshot analog that
+`nds_tpu.nds.rollback` undoes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from nds_tpu.engine.session import Session
+from nds_tpu.utils import power_core
+from nds_tpu.utils.report import BenchReport
+from nds_tpu.utils.timelog import TimeLog
+
+DM_DIR = os.path.join(os.path.dirname(__file__), "data_maintenance")
+
+INSERT_FUNCS = ["LF_CR", "LF_CS", "LF_I", "LF_SR", "LF_SS", "LF_WR",
+                "LF_WS"]
+DELETE_FUNCS = ["DF_CS", "DF_SS", "DF_WS"]
+INVENTORY_DELETE_FUNCS = ["DF_I"]
+
+# fact tables a full maintenance run can mutate -> committed as one
+# snapshot version (the rollback set, `nds/nds_rollback.py:37-43`)
+MUTABLE_TABLES = ["store_sales", "store_returns", "catalog_sales",
+                  "catalog_returns", "web_sales", "web_returns",
+                  "inventory"]
+
+
+def get_maintenance_queries(funcs: list[str]) -> dict[str, str]:
+    """{function: sql text} from the shipped data_maintenance assets
+    (`nds/nds_maintenance.py:121-147`)."""
+    out = {}
+    for f in funcs:
+        with open(os.path.join(DM_DIR, f + ".sql")) as fh:
+            out[f] = fh.read()
+    return out
+
+
+def get_delete_date(session: Session) -> tuple[str, str, str, str]:
+    """(date1, date2, inv_date1, inv_date2) ISO strings read from the
+    registered delete/inventory_delete tables
+    (`nds/nds_maintenance.py:60-73`)."""
+    import numpy as np
+
+    def iso(table, col):
+        c = session.tables[table].column(col)
+        return str((np.datetime64("1970-01-01", "D")
+                    + int(c.values[0])))
+
+    return (iso("delete", "date1"), iso("delete", "date2"),
+            iso("inventory_delete", "date1"),
+            iso("inventory_delete", "date2"))
+
+
+def replace_date(sql: str, date1: str, date2: str) -> str:
+    """DATE1/DATE2 placeholder substitution
+    (`nds/nds_maintenance.py:75-96`)."""
+    return sql.replace("DATE1", date1).replace("DATE2", date2)
+
+
+def statements(sql: str) -> list[str]:
+    # strip comment lines BEFORE splitting: headers may contain ';'
+    body = "\n".join(ln for ln in sql.splitlines()
+                     if not ln.lstrip().startswith("--"))
+    return [s.strip() for s in body.split(";") if s.strip()]
+
+
+def run_dm_query(session: Session, sql: str) -> None:
+    for stmt in statements(sql):
+        session.sql(stmt)
+
+
+def run_maintenance(data_dir: str, refresh_dir: str, time_log_path: str,
+                    config=None,
+                    json_summary_folder: str | None = None,
+                    refresh_format: str = "raw",
+                    commit: bool = True) -> int:
+    """Run all 11 maintenance functions; returns the failure count."""
+    from nds_tpu.nds.schema import get_maintenance_schemas
+    config = config or power_core.config_from_args(
+        argparse.Namespace(), default_backend="cpu")
+    suite = _maintenance_suite(config)
+    session = power_core.make_session(suite, config)
+    app_id = f"nds-tpu-maintenance-{int(time.time())}"
+    tlog = TimeLog(app_id)
+
+    # base warehouse + refresh staging tables
+    setup = power_core.load_warehouse(
+        suite, session, data_dir,
+        schemas=power_core.suite_schemas(suite, config))
+    use_decimal = not config.get_bool("engine.floats")
+    maint_schemas = get_maintenance_schemas(use_decimal)
+    setup.update(power_core.load_warehouse(
+        suite, session, refresh_dir, refresh_format,
+        schemas=maint_schemas))
+    for tname, secs in setup.items():
+        tlog.add(f"CreateTempView {tname}", int(secs * 1000))
+
+    date1, date2, inv_date1, inv_date2 = get_delete_date(session)
+    queries = get_maintenance_queries(
+        INSERT_FUNCS + DELETE_FUNCS + INVENTORY_DELETE_FUNCS)
+    if json_summary_folder:
+        os.makedirs(json_summary_folder, exist_ok=True)
+    failures = 0
+    dm_start = time.perf_counter()
+    for fname, sql in queries.items():
+        if fname in INVENTORY_DELETE_FUNCS:
+            sql = replace_date(sql, inv_date1, inv_date2)
+        elif fname in DELETE_FUNCS:
+            sql = replace_date(sql, date1, date2)
+        report = BenchReport(fname, config.as_dict())
+        summary = report.report_on(run_dm_query, session, sql)
+        elapsed_ms = summary["queryTimes"][-1]
+        tlog.add(fname, elapsed_ms)
+        print(f"====== Run {fname} ======")
+        print(f"Time taken: {elapsed_ms} millis for {fname}")
+        if not report.is_success():
+            failures += 1
+        if json_summary_folder:
+            cwd = os.getcwd()
+            os.chdir(json_summary_folder)
+            try:
+                report.write_summary(prefix=f"maintenance-{app_id}")
+            finally:
+                os.chdir(cwd)
+    dm_ms = int((time.perf_counter() - dm_start) * 1000)
+    tlog.add("Data Maintenance Time", dm_ms)
+    tlog.write(time_log_path)
+    print(f"Data Maintenance Time: {dm_ms} millis")
+
+    if commit and not failures:
+        version = commit_snapshot(data_dir, session)
+        print(f"committed warehouse snapshot v{version}")
+    return failures
+
+
+def _maintenance_suite(config) -> power_core.Suite:
+    from nds_tpu.nds.schema import get_schemas
+    return power_core.Suite(
+        name="nds",
+        get_schemas=get_schemas,
+        parse_query_stream=None,
+        session_for=lambda factory, **kw: Session.for_nds(
+            factory, include_maintenance=True, **kw),
+        raw_ext=".dat",
+        floats_toggle=True,
+    )
+
+
+def commit_snapshot(data_dir: str, session: Session) -> int:
+    """Persist the mutated fact tables as a new warehouse version."""
+    from nds_tpu.io import csv_io
+    from nds_tpu.io.snapshots import SnapshotLog
+    log = SnapshotLog(data_dir)
+    version = (log.entries[-1]["version"] + 1) if log.entries else 1
+    new_files = {}
+    for t in MUTABLE_TABLES:
+        vdir = log.version_dir(t, version)
+        path = os.path.join(vdir, "part-0.parquet")
+        csv_io.write_parquet(session.tables[t], path)
+        new_files[t] = [os.path.relpath(path, data_dir)]
+    return log.commit(new_files, note="data maintenance")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="NDS data maintenance (LF_*/DF_* refresh functions)")
+    p.add_argument("data_dir", help="warehouse directory (versioned)")
+    p.add_argument("refresh_dir",
+                   help="refresh dataset directory (gen_data --update)")
+    p.add_argument("time_log", help="output CSV time log path")
+    p.add_argument("--backend", choices=["tpu", "cpu", "distributed"],
+                   default=None)
+    p.add_argument("--refresh_format", choices=["raw", "parquet"],
+                   default="raw")
+    p.add_argument("--json_summary_folder")
+    p.add_argument("--no_commit", action="store_true",
+                   help="leave the on-disk warehouse untouched")
+    p.add_argument("--allow_failure", action="store_true",
+                   help="exit 0 even when functions failed")
+    power_core.add_config_args(p)
+    args = p.parse_args(argv)
+    config = power_core.config_from_args(args, default_backend="cpu")
+    failures = run_maintenance(
+        args.data_dir, args.refresh_dir, args.time_log, config=config,
+        json_summary_folder=args.json_summary_folder,
+        refresh_format=args.refresh_format, commit=not args.no_commit)
+    sys.exit(0 if (args.allow_failure or not failures) else 1)
+
+
+if __name__ == "__main__":
+    main()

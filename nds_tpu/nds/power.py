@@ -1,0 +1,73 @@
+"""NDS Power Run driver.
+
+Behavioral port of `nds/nds_power.py:184-410` over the shared power core
+(`nds_tpu/utils/power_core.py`): parse a 99-query stream by its dsqgen
+markers (multi-statement templates q14/23/24/39 split into parts,
+`nds/nds_gen_query_stream.py:91-103`), register the 25 tables, run every
+query in stream order recording per-query wall-clock ms, emit the CSV
+time log + per-query JSON summaries, honor ``--allow_failure``
+(`nds/nds_power.py:391-393`) and the template/property-file config
+layers (`:324-330`), and exit non-zero if any query failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from nds_tpu.engine.session import Session
+from nds_tpu.nds import streams
+from nds_tpu.nds.schema import get_schemas
+from nds_tpu.utils import power_core
+
+SUITE = power_core.Suite(
+    name="nds",
+    get_schemas=get_schemas,
+    parse_query_stream=streams.parse_query_stream,
+    session_for=Session.for_nds,
+    raw_ext=".dat",
+    floats_toggle=True,
+)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="NDS power run on the TPU columnar engine")
+    p.add_argument("data_dir", help="warehouse directory (transcode output)")
+    p.add_argument("query_stream", help="query_N.sql stream file")
+    p.add_argument("time_log", help="output CSV time log path")
+    p.add_argument("--backend", choices=["tpu", "cpu", "distributed"],
+                   default=None,
+                   help="overrides engine.backend from template/property "
+                        "files (default tpu)")
+    p.add_argument("--input_format", choices=["parquet", "raw"],
+                   default="parquet")
+    p.add_argument("--json_summary_folder",
+                   help="folder for per-query JSON summaries")
+    p.add_argument("--output_prefix",
+                   help="save each query's result under this directory")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="untimed runs per query before the timed one")
+    p.add_argument("--allow_failure", action="store_true",
+                   help="exit 0 even when queries failed "
+                        "(`nds/nds_power.py:391-393`)")
+    p.add_argument("--query_subset", nargs="+",
+                   help="run only these query names (e.g. query96)")
+    p.add_argument("--floats", action="store_true",
+                   help="schema uses doubles instead of decimals")
+    power_core.add_config_args(p)
+    args = p.parse_args(argv)
+    config = power_core.config_from_args(args)
+    if args.floats:
+        config.conf["engine.floats"] = "true"
+    failures = power_core.run_query_stream(
+        SUITE, args.data_dir, args.query_stream, args.time_log,
+        config=config, input_format=args.input_format,
+        json_summary_folder=args.json_summary_folder,
+        output_prefix=args.output_prefix, warmup=args.warmup,
+        query_subset=args.query_subset)
+    sys.exit(0 if (args.allow_failure or not failures) else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""NDS Load Test: raw '|'-delimited text -> columnar Parquet warehouse.
+
+Behavioral port of `nds/nds_transcode.py:154-229`: per-table transcode
+timing, the fact-table date partition map (`TABLE_PARTITIONING:45-53`),
+``--update`` switching to the refresh/maintenance schemas (`:170-176`),
+a plain-text report with per-table seconds + Total time, and the
+load-end timestamp the orchestrator reads back as the stream RNGSEED
+(`nds/nds_transcode.py:210-216` -> `nds/nds_bench.py:60-74`).
+
+TPU-native: partitioned facts write one parquet file per distinct
+partition key value under ``<table>/<part_col>=<val>/`` (hive-style —
+the layout multi-host loaders shard by), instead of a Spark
+repartition+sortWithinPartitions shuffle; dictionary-encoded strings are
+re-sorted on read (`nds_tpu/io/csv_io.py`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+from nds_tpu.io import csv_io
+from nds_tpu.nds.schema import get_maintenance_schemas, get_schemas
+
+# fact date-partition columns (`nds/nds_transcode.py:45-53`)
+TABLE_PARTITIONING = {
+    "catalog_sales": "cs_sold_date_sk",
+    "catalog_returns": "cr_returned_date_sk",
+    "inventory": "inv_date_sk",
+    "store_sales": "ss_sold_date_sk",
+    "store_returns": "sr_returned_date_sk",
+    "web_sales": "ws_sold_date_sk",
+    "web_returns": "wr_returned_date_sk",
+}
+
+
+def _raw_paths(input_dir: str, name: str) -> list[str]:
+    tdir = os.path.join(input_dir, name)
+    if os.path.isdir(tdir):
+        return sorted(os.path.join(tdir, f) for f in os.listdir(tdir)
+                      if not f.startswith("."))
+    return [os.path.join(input_dir, f"{name}.dat")]
+
+
+def transcode_table(name, schema, input_dir: str, output_dir: str,
+                    compression: str = "snappy",
+                    partition: bool = True) -> float:
+    t0 = time.perf_counter()
+    table = csv_io.read_tbl(_raw_paths(input_dir, name), name, schema)
+    part_col = TABLE_PARTITIONING.get(name) if partition else None
+    if part_col and table.nrows:
+        col = table.column(part_col)
+        vals = col.values
+        valid = (col.null_mask if col.null_mask is not None
+                 else np.ones(len(vals), dtype=bool))
+        arrow = csv_io.to_arrow(table)
+        # coarse month buckets: one file per ~30-day band keeps file
+        # counts sane while preserving partition-prunable layout
+        band = np.where(valid, vals // 30, -1)
+        for b in np.unique(band):
+            sel = np.nonzero(band == b)[0]
+            sub = arrow.take(sel)
+            label = "__HIVE_DEFAULT_PARTITION__" if b < 0 else str(
+                int(b) * 30)
+            out = os.path.join(output_dir, name, f"{part_col}={label}",
+                               "part-0.parquet")
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            import pyarrow.parquet as pq
+            pq.write_table(sub, out, compression=compression)
+    else:
+        out = os.path.join(output_dir, name, "part-0.parquet")
+        csv_io.write_parquet(table, out, compression=compression)
+    return time.perf_counter() - t0
+
+
+def transcode(input_dir: str, output_dir: str, report_path: str,
+              tables: list[str] | None = None,
+              compression: str = "snappy", update: bool = False,
+              use_decimal: bool = True, partition: bool = True) -> dict:
+    schemas = (get_maintenance_schemas(use_decimal) if update
+               else get_schemas(use_decimal))
+    if tables:
+        unknown = set(tables) - set(schemas)
+        if unknown:
+            raise ValueError(f"unknown tables: {sorted(unknown)}")
+        schemas = {t: schemas[t] for t in tables}
+    os.makedirs(output_dir, exist_ok=True)
+    timings = {}
+    for name, schema in schemas.items():
+        timings[name] = transcode_table(
+            name, schema, input_dir, output_dir, compression, partition)
+        print(f"Time taken: {timings[name]:.3f} s for table {name}")
+    load_end = int(time.time())
+    report = ["Total conversion time for %d tables was %.3fs" % (
+        len(timings), sum(timings.values()))]
+    for name, secs in timings.items():
+        report.append("Time to convert '%s' was %.4fs" % (name, secs))
+    report.append("")
+    # the stream-seed contract: RNGSEED = load end timestamp
+    report.append(f"RNGSEED used: {load_end}")
+    os.makedirs(os.path.dirname(report_path) or ".", exist_ok=True)
+    with open(report_path, "w") as f:
+        f.write("\n".join(report) + "\n")
+    return timings
+
+
+def get_rngseed(report_path: str) -> int:
+    """Parse the RNGSEED back out of a load report
+    (`nds/nds_bench.py:60-74` contract)."""
+    with open(report_path) as f:
+        for line in f:
+            if line.startswith("RNGSEED used:"):
+                return int(line.split(":")[1].strip())
+    raise ValueError(f"no RNGSEED in {report_path}")
+
+
+def get_load_time(report_path: str) -> float:
+    """Total load seconds from the report header line."""
+    with open(report_path) as f:
+        first = f.readline()
+    return float(first.rstrip("s\n").split()[-1].rstrip("s"))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="NDS load test: raw text -> Parquet warehouse")
+    p.add_argument("input_dir", help="raw data directory (datagen output)")
+    p.add_argument("output_dir", help="Parquet warehouse directory")
+    p.add_argument("report_file", help="load-report text file")
+    p.add_argument("--tables", nargs="+", help="subset of tables")
+    p.add_argument("--update", action="store_true",
+                   help="transcode refresh (maintenance) tables instead")
+    p.add_argument("--floats", action="store_true",
+                   help="double columns instead of decimals")
+    p.add_argument("--no_partition", action="store_true",
+                   help="disable fact date partitioning")
+    p.add_argument("--compression", default="snappy")
+    args = p.parse_args(argv)
+    transcode(args.input_dir, args.output_dir, args.report_file,
+              args.tables, args.compression, update=args.update,
+              use_decimal=not args.floats,
+              partition=not args.no_partition)
+
+
+if __name__ == "__main__":
+    main()

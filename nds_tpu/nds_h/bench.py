@@ -32,8 +32,9 @@ from nds_tpu.utils.timelog import TimeLog
 
 
 def _run(cmd: list[str]) -> None:
+    from nds_tpu.utils.power_core import subprocess_env
     print("+", " ".join(cmd))
-    subprocess.run(cmd, check=True)
+    subprocess.run(cmd, check=True, env=subprocess_env())
 
 
 def get_power_time(time_log_path: str) -> float:

@@ -1,7 +1,8 @@
 """NDS-H output validation: diff two power runs' saved query outputs.
 
-Behavioral port of `nds-h/nds_h_validate.py`: per query, row-count check
-then row-by-row compare with epsilon on float/decimal columns
+Behavioral port of `nds-h/nds_h_validate.py` over the shared diff core
+(`nds_tpu/utils/validate_core.py`): per query, row-count check then
+row-by-row compare with epsilon on float/decimal columns
 (`nds/nds_validate.py:166-192` math.isclose semantics), optional
 order-insensitive mode that sorts both sides (`:130-131`), the NDS-H
 skips (query15_part1/3 never produce comparable output,
@@ -13,15 +14,11 @@ compared query matches.
 from __future__ import annotations
 
 import argparse
-import math
 import os
 import sys
 
-import numpy as np
-import pandas as pd
-
-from nds_tpu.io.result_io import read_result
 from nds_tpu.nds_h import streams
+from nds_tpu.utils.validate_core import compare_results as _compare_core
 
 SKIP_QUERIES = {"query15_part1", "query15_part3"}
 # q18: o_orderkey ties at the LIMIT 100 edge make that column's row
@@ -31,60 +28,11 @@ SKIP_COLUMNS = {"query18": [2]}
 
 
 def compare_results(dir1: str, dir2: str, query_name: str,
-                    ignore_ordering: bool = True, epsilon: float = 0.00001,
+                    ignore_ordering: bool = True,
+                    epsilon: float = 0.00001,
                     use_iterator: bool = False) -> bool:
-    df1 = read_result(os.path.join(dir1, query_name))
-    df2 = read_result(os.path.join(dir2, query_name))
-    if len(df1) != len(df2):
-        print(f"[{query_name}] row count mismatch: "
-              f"{len(df1)} vs {len(df2)}")
-        return False
-    if df1.shape[1] != df2.shape[1]:
-        print(f"[{query_name}] column count mismatch: "
-              f"{df1.shape[1]} vs {df2.shape[1]}")
-        return False
-    drop = SKIP_COLUMNS.get(query_name, [])
-    if drop:
-        keep = [i for i in range(df1.shape[1]) if i not in drop]
-        df1 = df1.iloc[:, keep]
-        df2 = df2.iloc[:, keep]
-    if ignore_ordering:
-        df1 = _canon_sort(df1)
-        df2 = _canon_sort(df2)
-    for i in range(df1.shape[1]):
-        a = df1.iloc[:, i]
-        b = df2.iloc[:, i]
-        if not _col_equal(a, b, epsilon):
-            print(f"[{query_name}] column {i} ({df1.columns[i]}) differs")
-            return False
-    return True
-
-
-def _canon_sort(df: pd.DataFrame) -> pd.DataFrame:
-    if not len(df):
-        return df
-    keys = {}
-    for i, c in enumerate(df.columns):
-        col = df.iloc[:, i]
-        if col.dtype.kind == "f":
-            keys[f"k{i}"] = col.round(4)
-        else:
-            keys[f"k{i}"] = col.astype(str)
-    order = pd.DataFrame(keys).sort_values(list(keys)).index
-    return df.loc[order].reset_index(drop=True)
-
-
-def _col_equal(a: pd.Series, b: pd.Series, epsilon: float) -> bool:
-    na, nb = a.isna().to_numpy(), b.isna().to_numpy()
-    if not (na == nb).all():
-        return False
-    a, b = a[~na], b[~nb]
-    if a.dtype.kind == "f" or b.dtype.kind == "f":
-        fa = pd.to_numeric(a, errors="coerce").to_numpy(dtype=float)
-        fb = pd.to_numeric(b, errors="coerce").to_numpy(dtype=float)
-        return all(math.isclose(x, y, rel_tol=epsilon)
-                   for x, y in zip(fa, fb))
-    return list(a.astype(str)) == list(b.astype(str))
+    return _compare_core(dir1, dir2, query_name, ignore_ordering,
+                         epsilon, skip_columns=SKIP_COLUMNS)
 
 
 def iterate_queries(dir1: str, dir2: str, stream_path: str,
@@ -96,6 +44,18 @@ def iterate_queries(dir1: str, dir2: str, stream_path: str,
     for qname in queries:
         if qname in SKIP_QUERIES:
             print(f"=== Skipping {qname} ===")
+            continue
+        here1 = os.path.isdir(os.path.join(dir1, qname))
+        here2 = os.path.isdir(os.path.join(dir2, qname))
+        if not here1 and not here2:
+            # subset runs leave most queries without output; loud so a
+            # double-crash (both engines failed the query) is visible
+            print(f"=== {qname}: no output on either side — "
+                  f"not compared ===")
+            continue
+        if here1 != here2:
+            print(f"=== {qname}: output present on only one side ===")
+            unmatched.append(qname)
             continue
         ok = compare_results(dir1, dir2, qname, ignore_ordering, epsilon)
         status = "MATCH" if ok else "MISMATCH"

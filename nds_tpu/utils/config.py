@@ -75,12 +75,16 @@ class EngineConfig:
                  overrides: dict | None = None) -> None:
         conf = dict(DEFAULTS)
         self.sources = {"template": template_path, "property_file": property_path}
-        if template_path:
-            conf.update(load_properties(template_path))
-        if property_path:
-            conf.update(load_properties(property_path))
-        if overrides:
-            conf.update({k: str(v) for k, v in overrides.items()})
+        # keys set by an explicit layer (vs DEFAULTS) — lets drivers
+        # apply their own fallback default without trampling templates
+        self.explicit: set[str] = set()
+        for layer in (load_properties(template_path) if template_path
+                      else {},
+                      load_properties(property_path) if property_path
+                      else {},
+                      {k: str(v) for k, v in (overrides or {}).items()}):
+            conf.update(layer)
+            self.explicit.update(layer)
         self.conf = conf
 
     def get(self, key: str, default=None):

@@ -1,0 +1,240 @@
+"""Suite-independent power-run core.
+
+The reference duplicates its power loop between the NDS and NDS-H suites
+(`nds/nds_power.py:184-322`, `nds-h/nds_h_power.py`); SURVEY.md §1 calls
+out that the shared layer should be built once — this module is that
+single copy. Each suite's driver supplies a ``Suite`` descriptor (schema
+getter, stream parser, raw extension) and gets: warehouse registration
+with CreateTempView-analog timings, the timed query loop with per-query
+JSON summaries and the CSV time log, the ``--allow_failure`` contract
+(`nds/nds_power.py:391-393`), warmup handling, and EngineConfig-driven
+session construction (template < property file precedence,
+`nds/spark-submit-template:24-33` + `nds_power.py:324-330`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from nds_tpu.engine.session import Session
+from nds_tpu.utils.config import EngineConfig
+from nds_tpu.utils.report import BenchReport
+from nds_tpu.utils.timelog import TimeLog
+
+
+@dataclass
+class Suite:
+    """What a benchmark suite must provide to the shared drivers."""
+    name: str                      # "nds" | "nds_h"
+    get_schemas: object            # callable(**kw) -> {table: Schema}
+    parse_query_stream: object     # callable(path) -> OrderedDict
+    session_for: object            # callable(factory, **kw) -> Session
+    raw_ext: str = ".tbl"          # dbgen .tbl / dsdgen .dat
+    # query names whose warmup is skipped (stateful parts, e.g. q15 view
+    # lifecycle in NDS-H)
+    warmup_skip_prefixes: tuple = ()
+    schema_kwargs: dict = field(default_factory=dict)
+    # suite honors the --floats/engine.floats toggle (NDS decimal vs
+    # double schemas, `nds/nds_schema.py:43-47`)
+    floats_toggle: bool = False
+
+
+def schema_kwargs_for(suite: Suite, config: EngineConfig) -> dict:
+    kwargs = dict(suite.schema_kwargs)
+    if suite.floats_toggle:
+        kwargs["use_decimal"] = not config.get_bool("engine.floats")
+    return kwargs
+
+
+def suite_schemas(suite: Suite, config: EngineConfig) -> dict:
+    """Config-aware schemas — table LOADING must agree with the session
+    catalog on decimal-vs-float, or money columns load as scaled ints
+    under a float catalog."""
+    return suite.get_schemas(**schema_kwargs_for(suite, config))
+
+
+def make_session(suite: Suite, config: EngineConfig) -> Session:
+    """Session from an EngineConfig — the template/property-file layer
+    actually driving engine choice (closes the reference's
+    spark-submit-template contract)."""
+    backend = config.get("engine.backend", "cpu")
+    kwargs = schema_kwargs_for(suite, config)
+    if backend in ("tpu", "distributed"):
+        # compiles amortize across driver invocations (same cache
+        # bench.py uses); harmless for repeated in-process queries
+        from nds_tpu.utils.xla_cache import enable as enable_xla_cache
+        enable_xla_cache()
+    if backend == "tpu":
+        from nds_tpu.engine.device_exec import make_device_factory
+        factory = make_device_factory()
+    elif backend == "distributed":
+        from nds_tpu.parallel.dist_exec import make_distributed_factory
+        from nds_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(config.get_int("engine.mesh.shards", 1))
+        factory = make_distributed_factory(mesh=mesh)
+    elif backend == "cpu":
+        factory = None
+    else:
+        raise ValueError(f"unknown engine.backend {backend!r}")
+    return suite.session_for(factory, **kwargs)
+
+
+def load_warehouse(suite: Suite, session: Session, data_dir: str,
+                   fmt: str = "parquet",
+                   tables: list[str] | None = None,
+                   schemas: dict | None = None) -> dict:
+    """Register every table from a warehouse directory; returns
+    {table: seconds} setup timings (the CreateTempView analog,
+    `nds/nds_power.py:95-105`)."""
+    from nds_tpu.io import csv_io
+    from nds_tpu.io.snapshots import MANIFEST, SnapshotLog
+    if schemas is None:
+        schemas = suite.get_schemas(**suite.schema_kwargs)
+    log = (SnapshotLog(data_dir)
+           if os.path.exists(os.path.join(data_dir, MANIFEST)) else None)
+    timings = {}
+    for name, schema in schemas.items():
+        if tables is not None and name not in tables:
+            continue
+        t0 = time.perf_counter()
+        tdir = os.path.join(data_dir, name)
+        if fmt == "parquet":
+            if log is not None and os.path.isdir(tdir):
+                # versioned warehouse: the snapshot manifest names the
+                # live files (maintenance commits new versions)
+                paths = log.current([name]).get(name, [])
+            elif os.path.isdir(tdir):
+                # recursive: partitioned tables nest hive-style dirs
+                paths = sorted(
+                    os.path.join(root, f)
+                    for root, _dirs, files in os.walk(tdir)
+                    for f in files if f.endswith(".parquet"))
+            else:
+                paths = [os.path.join(data_dir, f"{name}.parquet")]
+            table = csv_io.read_parquet(paths, name, schema)
+        elif fmt == "raw":
+            if os.path.isdir(tdir):
+                paths = sorted(
+                    os.path.join(tdir, f) for f in os.listdir(tdir)
+                    if not f.startswith("."))
+            else:
+                paths = [os.path.join(data_dir, f"{name}{suite.raw_ext}")]
+            table = csv_io.read_tbl(paths, name, schema)
+        else:
+            raise ValueError(f"unknown input format {fmt!r}")
+        session.register_table(table)
+        timings[name] = time.perf_counter() - t0
+    return timings
+
+
+def run_one_query(session: Session, sql: str, qname: str = "",
+                  output_prefix: str | None = None):
+    result = session.sql(sql)
+    if result is not None and output_prefix:
+        from nds_tpu.io.result_io import write_result
+        write_result(result, os.path.join(output_prefix, qname))
+    return result
+
+
+def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
+                     time_log_path: str,
+                     config: EngineConfig | None = None,
+                     input_format: str = "parquet",
+                     json_summary_folder: str | None = None,
+                     output_prefix: str | None = None,
+                     warmup: int = 0,
+                     query_subset: list[str] | None = None) -> int:
+    """The power loop (`nds/nds_power.py:184-322`): every query runs
+    regardless of earlier failures (the reference never aborts
+    mid-stream; ``--allow_failure`` only downgrades the exit code,
+    `nds/nds_power.py:391-393` — handled by the driver mains). Returns
+    the number of failed queries."""
+    config = config or EngineConfig()
+    session = make_session(suite, config)
+    backend = config.get("engine.backend", "cpu")
+    app_id = f"{suite.name}-tpu-{backend}-{int(time.time())}"
+    tlog = TimeLog(app_id)
+    total_start = time.perf_counter()
+
+    setup = load_warehouse(suite, session, data_dir, input_format,
+                           schemas=suite_schemas(suite, config))
+    for tname, secs in setup.items():
+        tlog.add(f"CreateTempView {tname}", int(secs * 1000))
+
+    queries = suite.parse_query_stream(stream_path)
+    if query_subset:
+        queries = type(queries)(
+            (q, s) for q, s in queries.items() if q in query_subset)
+    if json_summary_folder:
+        os.makedirs(json_summary_folder, exist_ok=True)
+    failures = 0
+    power_start = time.perf_counter()
+    for qname, sql in queries.items():
+        if warmup and not qname.startswith(suite.warmup_skip_prefixes):
+            for _ in range(warmup):
+                try:
+                    run_one_query(session, sql)
+                except Exception:
+                    break
+        report = BenchReport(qname, config.as_dict())
+        summary = report.report_on(run_one_query, session, sql, qname,
+                                   output_prefix)
+        elapsed_ms = summary["queryTimes"][-1]
+        tlog.add(qname, elapsed_ms)
+        print(f"====== Run {qname} ======")
+        print(f"Time taken: {elapsed_ms} millis for {qname}")
+        if not report.is_success():
+            failures += 1
+        if json_summary_folder:
+            cwd = os.getcwd()
+            os.chdir(json_summary_folder)
+            try:
+                report.write_summary(prefix=f"power-{app_id}")
+            finally:
+                os.chdir(cwd)
+    power_ms = int((time.perf_counter() - power_start) * 1000)
+    tlog.add("Power Test Time", power_ms)
+    total_ms = int((time.perf_counter() - total_start) * 1000)
+    tlog.add("Total Time", total_ms)
+    tlog.write(time_log_path)
+    print(f"Power Test Time: {power_ms} millis")
+    return failures
+
+
+def subprocess_env() -> dict:
+    """Environment for phase subprocesses: nds_tpu importable regardless
+    of the orchestrator's cwd (preserving the ambient PYTHONPATH — the
+    TPU plugin's site dir may live there)."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    return env
+
+
+def add_config_args(parser) -> None:
+    """The --template/--property_file CLI surface shared by every driver
+    (reference: spark-submit-template sources the template,
+    `nds_power.py:324-330` merges the property file)."""
+    parser.add_argument("--template",
+                        help="engine template file (k=v with ${ENV:-default})")
+    parser.add_argument("--property_file",
+                        help="k=v property file overriding the template")
+
+
+def config_from_args(args, default_backend: str = "tpu") -> EngineConfig:
+    """CLI --backend > property file > template > the driver's default
+    (matching spark-submit-template < --property_file precedence with
+    spark-submit's own CLI last)."""
+    cli_backend = getattr(args, "backend", None)
+    overrides = {}
+    if cli_backend is not None:
+        overrides["engine.backend"] = cli_backend
+    cfg = EngineConfig(getattr(args, "template", None),
+                       getattr(args, "property_file", None), overrides)
+    if "engine.backend" not in cfg.explicit:
+        cfg.conf["engine.backend"] = default_backend
+    return cfg

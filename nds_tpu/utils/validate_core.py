@@ -1,0 +1,84 @@
+"""Suite-independent output-diff core (one copy of the reference's
+duplicated validators, `nds/nds_validate.py:48-215` /
+`nds-h/nds_h_validate.py`): row-count check then per-column compare with
+``math.isclose`` epsilon on float columns, canonical order-insensitive
+sort, positional column skips, and per-column overrides for documented
+nondeterminism carve-outs (q78's rounded-ratio tolerance,
+`nds/nds_validate.py:146-190`)."""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import pandas as pd
+
+from nds_tpu.io.result_io import read_result
+
+
+def canon_sort(df: pd.DataFrame) -> pd.DataFrame:
+    """Deterministic whole-row sort (floats rounded so epsilon-equal rows
+    sort identically on both sides, `nds/nds_validate.py:130-131`)."""
+    if not len(df):
+        return df
+    keys = {}
+    for i, c in enumerate(df.columns):
+        col = df.iloc[:, i]
+        if col.dtype.kind == "f":
+            keys[f"k{i}"] = col.round(4)
+        else:
+            keys[f"k{i}"] = col.astype(str)
+    order = pd.DataFrame(keys).sort_values(list(keys)).index
+    return df.loc[order].reset_index(drop=True)
+
+
+def col_equal(a: pd.Series, b: pd.Series, epsilon: float,
+              rel_tol: float | None = None) -> bool:
+    na, nb = a.isna().to_numpy(), b.isna().to_numpy()
+    if not (na == nb).all():
+        return False
+    a, b = a[~na], b[~nb]
+    if a.dtype.kind == "f" or b.dtype.kind == "f":
+        fa = pd.to_numeric(a, errors="coerce").to_numpy(dtype=float)
+        fb = pd.to_numeric(b, errors="coerce").to_numpy(dtype=float)
+        tol = rel_tol if rel_tol is not None else epsilon
+        return all(math.isclose(x, y, rel_tol=tol)
+                   for x, y in zip(fa, fb))
+    return list(a.astype(str)) == list(b.astype(str))
+
+
+def compare_results(dir1: str, dir2: str, query_name: str,
+                    ignore_ordering: bool = True,
+                    epsilon: float = 0.00001,
+                    skip_columns: dict | None = None,
+                    column_rel_tol: dict | None = None) -> bool:
+    """Diff one query's saved outputs. skip_columns maps query name ->
+    positional column indexes to drop; column_rel_tol maps (query name,
+    column index) -> relaxed tolerance."""
+    df1 = read_result(os.path.join(dir1, query_name))
+    df2 = read_result(os.path.join(dir2, query_name))
+    if len(df1) != len(df2):
+        print(f"[{query_name}] row count mismatch: "
+              f"{len(df1)} vs {len(df2)}")
+        return False
+    if df1.shape[1] != df2.shape[1]:
+        print(f"[{query_name}] column count mismatch: "
+              f"{df1.shape[1]} vs {df2.shape[1]}")
+        return False
+    drop = (skip_columns or {}).get(query_name, [])
+    if drop:
+        keep = [i for i in range(df1.shape[1]) if i not in drop]
+        df1 = df1.iloc[:, keep]
+        df2 = df2.iloc[:, keep]
+    if ignore_ordering:
+        df1 = canon_sort(df1)
+        df2 = canon_sort(df2)
+    for i in range(df1.shape[1]):
+        a = df1.iloc[:, i]
+        b = df2.iloc[:, i]
+        rel = (column_rel_tol or {}).get((query_name, i))
+        if not col_equal(a, b, epsilon, rel):
+            print(f"[{query_name}] column {i} ({df1.columns[i]}) differs")
+            return False
+    return True

@@ -1,0 +1,230 @@
+"""Data-maintenance tests: DML engine support (INSERT/DELETE), the 11
+LF_*/DF_* refresh functions end-to-end against a versioned warehouse,
+DATE1/DATE2 substitution, snapshot commit and rollback — the vertical
+slice of `nds/nds_maintenance.py` + `nds_rollback.py`."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nds_tpu.datagen import tpcds
+from nds_tpu.engine.session import Session
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.io.snapshots import SnapshotLog
+from nds_tpu.nds import gen_data, maintenance, transcode
+from nds_tpu.nds.schema import get_schemas
+
+SF = 0.01
+
+
+def _session(tables=("store_sales", "store_returns", "date_dim",
+                     "reason")):
+    schemas = get_schemas()
+    sess = Session.for_nds()
+    for t in tables:
+        sess.register_table(
+            from_arrays(t, schemas[t], tpcds.gen_table(t, SF)))
+    return sess
+
+
+class TestDml:
+    def test_insert_select(self):
+        sess = _session()
+        n0 = sess.tables["store_sales"].nrows
+        r = sess.sql("select count(*) as c from store_sales "
+                     "where ss_quantity > 95")
+        expected = int(r.cols[0][0])
+        out = sess.sql("insert into store_sales (select * from "
+                       "store_sales where ss_quantity > 95)")
+        assert out is None
+        assert sess.tables["store_sales"].nrows == n0 + expected
+
+    def test_insert_preserves_null_masks(self):
+        sess = _session()
+        col0 = sess.tables["store_sales"].column("ss_customer_sk")
+        nulls0 = int((~col0.null_mask).sum())
+        sess.sql("insert into store_sales "
+                 "(select * from store_sales)")
+        col1 = sess.tables["store_sales"].column("ss_customer_sk")
+        assert int((~col1.null_mask).sum()) == 2 * nulls0
+
+    def test_delete_scalar_subquery_range(self):
+        sess = _session()
+        n0 = sess.tables["store_sales"].nrows
+        r = sess.sql(
+            "select count(*) as c from store_sales where "
+            "ss_sold_date_sk >= 2450815 and ss_sold_date_sk <= 2450845")
+        in_window = int(r.cols[0][0])
+        assert in_window > 0
+        sess.sql(
+            "delete from store_sales where ss_sold_date_sk >= "
+            "(select min(d_date_sk) from date_dim where d_date between "
+            "'1998-01-01' and '1998-01-31') and ss_sold_date_sk <= "
+            "(select max(d_date_sk) from date_dim where d_date between "
+            "'1998-01-01' and '1998-01-31')")
+        assert sess.tables["store_sales"].nrows == n0 - in_window
+
+    def test_delete_null_dates_survive(self):
+        """SQL DELETE keeps rows where the predicate is NULL — the
+        nullable ss_sold_date_sk FK must never be deleted by a date
+        range (3-valued logic, unlike a complemented filter)."""
+        sess = _session()
+        col = sess.tables["store_sales"].column("ss_sold_date_sk")
+        n_null = int((~col.null_mask).sum())
+        assert n_null > 0
+        sess.sql("delete from store_sales where ss_sold_date_sk >= 0")
+        col2 = sess.tables["store_sales"].column("ss_sold_date_sk")
+        assert sess.tables["store_sales"].nrows == n_null
+        assert not col2.null_mask.any() if col2.null_mask is not None \
+            else True
+
+    def test_delete_in_subquery(self):
+        sess = _session()
+        r = sess.sql(
+            "select count(*) as c from store_returns where "
+            "sr_ticket_number in (select distinct ss_ticket_number from "
+            "store_sales, date_dim where ss_sold_date_sk=d_date_sk and "
+            "d_date between '1998-02-01' and '1998-03-01')")
+        expected = int(r.cols[0][0])
+        n0 = sess.tables["store_returns"].nrows
+        sess.sql(
+            "delete from store_returns where sr_ticket_number in "
+            "(select distinct ss_ticket_number from store_sales, "
+            "date_dim where ss_sold_date_sk=d_date_sk and d_date "
+            "between '1998-02-01' and '1998-03-01')")
+        assert sess.tables["store_returns"].nrows == n0 - expected
+
+    def test_dml_invalidates_plan_cache(self):
+        sess = _session()
+        q = "select count(*) as c from store_sales"
+        before = int(sess.sql(q).cols[0][0])
+        sess.sql("delete from store_sales where ss_quantity > 0")
+        after = int(sess.sql(q).cols[0][0])
+        assert after < before
+
+    def test_drop_view_requires_existence(self):
+        sess = _session()
+        sess.sql("drop view if exists nope")  # silent
+        with pytest.raises(ValueError):
+            sess.sql("drop view nope")
+
+    def test_delete_decimal_literal_coercion(self):
+        """WHERE money_col > 100 means $100, not 100 scaled cents."""
+        sess = _session()
+        r = sess.sql("select count(*) as c from store_sales "
+                     "where ss_sales_price > 50.00")
+        over_50_dollars = int(r.cols[0][0])
+        n0 = sess.tables["store_sales"].nrows
+        sess.sql("delete from store_sales where ss_sales_price > 50.00")
+        assert sess.tables["store_sales"].nrows == n0 - over_50_dollars
+
+    def test_delete_date_string_literal_coercion(self):
+        sess = _session(("date_dim",))
+        n0 = sess.tables["date_dim"].nrows
+        r = sess.sql("select count(*) as c from date_dim "
+                     "where d_date >= '2000-01-01'")
+        after = int(r.cols[0][0])
+        sess.sql("delete from date_dim where d_date >= '2000-01-01'")
+        assert sess.tables["date_dim"].nrows == n0 - after
+
+    def test_insert_rejects_trailing_statement(self):
+        sess = _session()
+        with pytest.raises(Exception, match="trailing"):
+            sess.sql("insert into store_sales (select * from "
+                     "store_sales); delete from store_sales")
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    root = tmp_path_factory.mktemp("maint")
+    raw = str(root / "raw")
+    wh = str(root / "wh")
+    refresh = str(root / "refresh1")
+    gen_data.generate_data_local(SF, 1, raw, workers=1)
+    transcode.transcode(raw, wh, str(root / "load.txt"))
+    gen_data.generate_refresh_data(SF, 1, refresh)
+    return {"wh": wh, "refresh": refresh, "root": str(root)}
+
+
+class TestMaintenanceRun:
+    def test_full_maintenance_and_rollback(self, warehouse, tmp_path):
+        from nds_tpu.nds.power import SUITE
+        from nds_tpu.utils import power_core
+        from nds_tpu.utils.config import EngineConfig
+
+        cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+
+        def fact_counts():
+            sess = power_core.make_session(SUITE, cfg)
+            power_core.load_warehouse(
+                SUITE, sess, warehouse["wh"],
+                tables=maintenance.MUTABLE_TABLES)
+            return {t: sess.tables[t].nrows
+                    for t in maintenance.MUTABLE_TABLES}
+
+        before = fact_counts()
+        failures = maintenance.run_maintenance(
+            warehouse["wh"], warehouse["refresh"],
+            str(tmp_path / "dm.csv"), config=cfg,
+            json_summary_folder=str(tmp_path / "json"))
+        assert failures == 0
+        after = fact_counts()
+        # every channel changed: inserts extend history past the base
+        # window, deletes remove a base window
+        assert after != before
+        # the delete windows are inside base history and the refresh
+        # sets are small, so deletes dominate
+        assert after["store_sales"] != before["store_sales"]
+        assert after["inventory"] < before["inventory"]
+        # inserted rows reference resolvable dimension SKs
+        sess = power_core.make_session(SUITE, cfg)
+        power_core.load_warehouse(SUITE, sess, warehouse["wh"],
+                                  tables=["store_sales"])
+        tn = sess.tables["store_sales"].column("ss_ticket_number").values
+        assert (tn >= 1_000_000_000).any()
+        # time log carries the Tdm row the orchestrator reads
+        rows = open(str(tmp_path / "dm.csv")).read()
+        assert "Data Maintenance Time" in rows
+        # rollback restores the baseline
+        from nds_tpu.nds.rollback import rollback
+        rollback(warehouse["wh"], 0.0)
+        assert fact_counts() == before
+
+    def test_snapshot_log_versions(self, tmp_path):
+        wh = str(tmp_path / "wh")
+        os.makedirs(os.path.join(wh, "t1"))
+        # fake baseline parquet
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        pq.write_table(pa.table({"a": [1, 2]}),
+                       os.path.join(wh, "t1", "part-0.parquet"))
+        log = SnapshotLog(wh)
+        v1dir = log.version_dir("t1", 1)
+        pq.write_table(pa.table({"a": [1, 2, 3]}),
+                       os.path.join(v1dir, "part-0.parquet"))
+        log.commit({"t1": [os.path.relpath(
+            os.path.join(v1dir, "part-0.parquet"), wh)]})
+        cur = SnapshotLog(wh).current(["t1"])
+        assert "_v1" in cur["t1"][0]
+        SnapshotLog(wh).rollback_to_timestamp(0.0)
+        cur = SnapshotLog(wh).current(["t1"])
+        assert "_v1" not in cur["t1"][0]
+
+    def test_date_substitution(self):
+        sql = "where d_date between 'DATE1' and 'DATE2'"
+        out = maintenance.replace_date(sql, "1998-01-01", "1998-01-31")
+        assert "'1998-01-01'" in out and "DATE1" not in out
+
+    def test_all_eleven_functions_ship(self):
+        qs = maintenance.get_maintenance_queries(
+            maintenance.INSERT_FUNCS + maintenance.DELETE_FUNCS
+            + maintenance.INVENTORY_DELETE_FUNCS)
+        assert len(qs) == 11
+        for name, sql in qs.items():
+            stmts = maintenance.statements(sql)
+            assert stmts, name
+            if name.startswith("LF_"):
+                assert any("insert into" in s.lower() for s in stmts)
+            else:
+                assert any("delete from" in s.lower() for s in stmts)

@@ -1,0 +1,267 @@
+"""NDS phase-driver tests: gen_data -> transcode -> streams -> power ->
+validate, end to end at tiny scale (the CI analog of the reference's
+manual pipeline, `nds/README.md:136-508`), plus refresh datagen, the
+NULL round-trip through raw text and parquet, and the config layer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nds_tpu.datagen import tpcds, tpcds_refresh
+from nds_tpu.io import csv_io
+from nds_tpu.nds import gen_data, streams, transcode, validate
+from nds_tpu.nds.schema import (
+    get_maintenance_schemas, get_schemas, table_rows,
+)
+from nds_tpu.utils import power_core
+from nds_tpu.utils.config import EngineConfig
+
+SF = 0.01
+SUBSET = ["query96", "query7", "query93"]
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    """Run datagen + transcode once; yield dir paths."""
+    root = tmp_path_factory.mktemp("nds_pipe")
+    raw = str(root / "raw")
+    wh = str(root / "wh")
+    report = str(root / "load_report.txt")
+    gen_data.generate_data_local(SF, 2, raw, workers=2)
+    transcode.transcode(raw, wh, report)
+    sdir = str(root / "streams")
+    streams.generate_query_streams(sdir, 1)
+    return {"raw": raw, "wh": wh, "report": report,
+            "stream": os.path.join(sdir, "query_0.sql"),
+            "root": str(root)}
+
+
+class TestGenData:
+    def test_chunk_files_layout(self, pipeline):
+        # chunked fact -> per-table dir with _step_parallel names
+        files = os.listdir(os.path.join(pipeline["raw"], "store_sales"))
+        assert sorted(files) == ["store_sales_1_2.dat",
+                                 "store_sales_2_2.dat"]
+        # fixed dim -> single chunk
+        assert os.listdir(os.path.join(pipeline["raw"], "date_dim")) == [
+            "date_dim.dat"]
+
+    def test_raw_roundtrip_with_nulls(self, pipeline):
+        """dsdgen NULL convention (empty field) survives write+read."""
+        schema = get_schemas()["store_sales"]
+        paths = [os.path.join(pipeline["raw"], "store_sales", f)
+                 for f in sorted(os.listdir(
+                     os.path.join(pipeline["raw"], "store_sales")))]
+        t = csv_io.read_tbl(paths, "store_sales", schema)
+        gen = tpcds.gen_table("store_sales", SF)
+        mask = gen["ss_customer_sk#null"]
+        assert not mask.all()
+        col = t.column("ss_customer_sk")
+        assert col.null_mask is not None
+        assert int((~col.null_mask).sum()) == int((~mask).sum())
+
+    def test_parquet_roundtrip_with_nulls(self, pipeline, tmp_path):
+        schema = get_schemas()["store_sales"]
+        t = csv_io.read_tbl(
+            [os.path.join(pipeline["raw"], "store_sales",
+                          "store_sales_1_2.dat")], "store_sales", schema)
+        p = str(tmp_path / "ss.parquet")
+        csv_io.write_parquet(t, p)
+        back = csv_io.read_parquet([p], "store_sales", schema)
+        for cname in ("ss_customer_sk", "ss_sold_date_sk"):
+            a, b = t.column(cname), back.column(cname)
+            assert (a.null_mask is None) == (b.null_mask is None)
+            if a.null_mask is not None:
+                assert np.array_equal(a.null_mask, b.null_mask)
+                assert np.array_equal(a.values[a.null_mask],
+                                      b.values[b.null_mask])
+
+
+class TestRefreshData:
+    def test_all_maintenance_tables_generate(self):
+        schemas = get_maintenance_schemas()
+        for t, schema in schemas.items():
+            arrays = tpcds_refresh.gen_refresh_table(t, SF, 1)
+            assert set(schema.names) <= set(arrays), t
+            n = len(arrays[schema.names[0]])
+            assert n >= 1, t
+
+    def test_lineitems_reference_orders(self):
+        o = tpcds_refresh.gen_refresh_table("s_purchase", SF, 1)
+        li = tpcds_refresh.gen_refresh_table("s_purchase_lineitem", SF, 1)
+        assert np.isin(li["plin_purchase_id"],
+                       o["purc_purchase_id"]).all()
+
+    def test_item_ids_join_current_scd_records(self):
+        li = tpcds_refresh.gen_refresh_table("s_purchase_lineitem", SF, 1)
+        item = tpcds.gen_table("item", SF)
+        # current record = rec_end_date NULL (mask False = null)
+        current = item["i_item_id"][~item["i_rec_end_date#null"]]
+        assert np.isin(li["plin_item_id"], current).all()
+
+    def test_updates_differ_and_are_deterministic(self):
+        a1 = tpcds_refresh.gen_refresh_table("s_purchase", SF, 1)
+        a2 = tpcds_refresh.gen_refresh_table("s_purchase", SF, 2)
+        b1 = tpcds_refresh.gen_refresh_table("s_purchase", SF, 1)
+        assert not np.array_equal(a1["purc_purchase_id"],
+                                  a2["purc_purchase_id"])
+        assert np.array_equal(a1["purc_customer_id"],
+                              b1["purc_customer_id"])
+
+    def test_delete_window_inside_base_dates(self):
+        d = tpcds_refresh.gen_refresh_table("delete", SF, 1)
+        lo = tpcds.sk_to_epoch(tpcds.SALES_DATE_LO)
+        hi = tpcds.sk_to_epoch(tpcds.SALES_DATE_HI)
+        assert lo <= d["date1"][0] <= d["date2"][0] <= hi
+
+    def test_gen_data_update_cli(self, tmp_path):
+        out = str(tmp_path / "refresh1")
+        gen_data.generate_refresh_data(SF, 1, out)
+        assert os.path.isfile(
+            os.path.join(out, "s_purchase", "s_purchase.dat"))
+        schema = get_maintenance_schemas()["s_purchase"]
+        t = csv_io.read_tbl(
+            [os.path.join(out, "s_purchase", "s_purchase.dat")],
+            "s_purchase", schema)
+        assert t.nrows >= 8
+
+
+class TestTranscode:
+    def test_partitioned_layout(self, pipeline):
+        ssdir = os.path.join(pipeline["wh"], "store_sales")
+        parts = os.listdir(ssdir)
+        assert any(p.startswith("ss_sold_date_sk=") for p in parts)
+
+    def test_rngseed_and_load_time(self, pipeline):
+        assert transcode.get_rngseed(pipeline["report"]) > 0
+        assert transcode.get_load_time(pipeline["report"]) > 0
+
+    def test_update_mode(self, pipeline, tmp_path):
+        refresh_raw = str(tmp_path / "refresh_raw")
+        gen_data.generate_refresh_data(SF, 1, refresh_raw)
+        wh2 = str(tmp_path / "wh2")
+        rep = str(tmp_path / "rep.txt")
+        transcode.transcode(refresh_raw, wh2, rep, update=True)
+        assert os.path.isdir(os.path.join(wh2, "s_purchase"))
+
+
+class TestPowerRun:
+    def test_cpu_power_subset_and_validate(self, pipeline, tmp_path):
+        out1 = str(tmp_path / "o1")
+        out2 = str(tmp_path / "o2")
+        jsons = str(tmp_path / "json")
+        from nds_tpu.nds.power import SUITE
+        cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+        for out in (out1, out2):
+            failures = power_core.run_query_stream(
+                SUITE, pipeline["wh"], pipeline["stream"],
+                str(tmp_path / "time.csv"), config=cfg,
+                json_summary_folder=jsons, output_prefix=out,
+                query_subset=SUBSET)
+            assert failures == 0
+        unmatched = validate.iterate_queries(out1, out2,
+                                             pipeline["stream"])
+        assert unmatched == []
+        # JSON summary contract: engineConf reflects the config layer
+        jfiles = sorted(os.listdir(jsons))
+        assert jfiles
+        with open(os.path.join(jsons, jfiles[0])) as f:
+            summary = json.load(f)
+        assert summary["env"]["engineConf"]["engine.backend"] == "cpu"
+        assert summary["queryStatus"] == ["Completed"]
+
+    def test_failure_never_aborts_the_stream(self, pipeline, tmp_path):
+        """The reference runs every query regardless of failures; only
+        the exit code reflects them (`nds/nds_power.py:255-283,391-393`).
+        --allow_failure is exit-code-only, handled by the driver mains."""
+        from nds_tpu.nds.power import SUITE
+        bad_stream = str(tmp_path / "bad_stream.sql")
+        good = streams.render_query(96)
+        with open(bad_stream, "w") as f:
+            f.write("-- start query 1 in stream 0 using template "
+                    "query98.tpl\nselect broken syntax from nowhere\n"
+                    "-- end query 1 in stream 0 using template "
+                    "query98.tpl\n\n"
+                    "-- start query 2 in stream 0 using template "
+                    "query96.tpl\n" + good + "\n"
+                    "-- end query 2 in stream 0 using template "
+                    "query96.tpl\n")
+        cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+        jsons = str(tmp_path / "json")
+        tlog = str(tmp_path / "t.csv")
+        failures = power_core.run_query_stream(
+            SUITE, pipeline["wh"], bad_stream, tlog, config=cfg,
+            json_summary_folder=jsons)
+        assert failures == 1
+        assert "query96" in open(tlog).read()  # ran past the failure
+        # the failed query's summary records the Failed status + exception
+        failed = [f for f in os.listdir(jsons) if "query98" in f]
+        with open(os.path.join(jsons, failed[0])) as f:
+            summary = json.load(f)
+        assert summary["queryStatus"] == ["Failed"]
+        assert summary["exceptions"]
+
+
+class TestConfigLayer:
+    def test_template_and_property_precedence(self, tmp_path):
+        tpl = tmp_path / "t.template"
+        tpl.write_text("engine.backend=cpu\nengine.floats=false\n")
+        prop = tmp_path / "p.properties"
+        prop.write_text("engine.floats=true\n")
+        cfg = EngineConfig(str(tpl), str(prop))
+        assert cfg.get("engine.backend") == "cpu"
+        assert cfg.get_bool("engine.floats") is True
+
+    def test_env_substitution(self, tmp_path, monkeypatch):
+        tpl = tmp_path / "t.template"
+        tpl.write_text("engine.backend=${MY_BACKEND:-cpu}\n")
+        cfg = EngineConfig(str(tpl))
+        assert cfg.get("engine.backend") == "cpu"
+        monkeypatch.setenv("MY_BACKEND", "tpu")
+        cfg = EngineConfig(str(tpl))
+        assert cfg.get("engine.backend") == "tpu"
+
+    def test_shipped_templates_parse(self):
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for f in os.listdir(os.path.join(here, "configs")):
+            if f.endswith((".template", ".properties")):
+                EngineConfig(os.path.join(here, "configs", f))
+
+    def test_make_session_floats_mode(self):
+        from nds_tpu.nds.power import SUITE
+        cfg = EngineConfig(overrides={"engine.backend": "cpu",
+                                      "engine.floats": "true"})
+        sess = power_core.make_session(SUITE, cfg)
+        f = sess.catalog.schemas["store_sales"].field("ss_list_price")
+        assert f.dtype.name.startswith("float")
+        # table LOADING must agree with the catalog on decimal-vs-float
+        loaded = power_core.suite_schemas(SUITE, cfg)
+        assert loaded["store_sales"].field(
+            "ss_list_price").dtype.name.startswith("float")
+
+    def test_template_backend_not_trampled_by_default(self, tmp_path):
+        """A template's engine.backend wins when --backend is absent;
+        an explicit --backend still overrides it."""
+        import types
+        tpl = tmp_path / "t.template"
+        tpl.write_text("engine.backend=cpu\n")
+        args = types.SimpleNamespace(template=str(tpl),
+                                     property_file=None, backend=None)
+        cfg = power_core.config_from_args(args)
+        assert cfg.get("engine.backend") == "cpu"
+        args.backend = "tpu"
+        cfg = power_core.config_from_args(args)
+        assert cfg.get("engine.backend") == "tpu"
+        # no layer sets it -> the driver default applies
+        args = types.SimpleNamespace(template=None, property_file=None,
+                                     backend=None)
+        assert power_core.config_from_args(args).get(
+            "engine.backend") == "tpu"
+
+
+def test_source_table_count():
+    # 24 generated tables + dbgen_version handled as metadata
+    assert len(get_schemas()) == 24
+    assert table_rows("store_sales", 1.0) == 2_880_404
