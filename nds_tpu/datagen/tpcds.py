@@ -413,7 +413,8 @@ def _gen_item(idx, sf, seed, total):
         "i_item_sk": sk.astype(np.int32),
         "i_item_id": _ids("AAAAAAAA", (sk + 1) // 2),  # ids repeat (SCD)
         "i_rec_start_date": np.full(len(idx), 10227, dtype=np.int64),
-        "i_rec_end_date": np.where(sk % 2 == 0, 11322, 12000 + 99999),
+        "i_rec_end_date": np.where(sk % 2 == 0, 11322, 0),
+        "i_rec_end_date#null": sk % 2 == 0,
         "i_item_desc": np.array(
             [f"Item description {int(v)} promising results"
              for v in sk], dtype=object),
@@ -508,7 +509,8 @@ def _gen_store(idx, sf, seed, total):
         "s_store_sk": sk.astype(np.int32),
         "s_store_id": _ids("AAAAAAAA", (sk + 1) // 2),
         "s_rec_start_date": np.full(len(idx), 10227, dtype=np.int64),
-        "s_rec_end_date": np.where(sk % 2 == 0, 11322, 110000),
+        "s_rec_end_date": np.where(sk % 2 == 0, 11322, 0),
+        "s_rec_end_date#null": sk % 2 == 0,
         "s_closed_date_sk": _null_out(
             _uniform(h(1), SALES_DATE_LO, SALES_DATE_HI), h(2), 70
         ).astype(np.int32),
@@ -565,7 +567,8 @@ def _gen_call_center(idx, sf, seed, total):
         "cc_call_center_sk": sk.astype(np.int32),
         "cc_call_center_id": _ids("AAAAAAAA", (sk + 1) // 2),
         "cc_rec_start_date": np.full(len(idx), 10227, dtype=np.int64),
-        "cc_rec_end_date": np.where(sk % 2 == 0, 11322, 110000),
+        "cc_rec_end_date": np.where(sk % 2 == 0, 11322, 0),
+        "cc_rec_end_date#null": sk % 2 == 0,
         "cc_closed_date_sk": np.full(len(idx), -1, dtype=np.int32),
         "cc_open_date_sk": _uniform(
             h(1), SALES_DATE_LO - 3000, SALES_DATE_LO).astype(np.int32),
@@ -601,7 +604,8 @@ def _gen_web_site(idx, sf, seed, total):
         "web_site_sk": sk.astype(np.int32),
         "web_site_id": _ids("AAAAAAAA", (sk + 1) // 2),
         "web_rec_start_date": np.full(len(idx), 10227, dtype=np.int64),
-        "web_rec_end_date": np.where(sk % 2 == 0, 11322, 110000),
+        "web_rec_end_date": np.where(sk % 2 == 0, 11322, 0),
+        "web_rec_end_date#null": sk % 2 == 0,
         "web_name": np.array([f"site_{int(v) % 10}" for v in sk],
                              dtype=object),
         "web_open_date_sk": _uniform(
@@ -633,7 +637,8 @@ def _gen_web_page(idx, sf, seed, total):
         "wp_web_page_sk": sk.astype(np.int32),
         "wp_web_page_id": _ids("AAAAAAAA", (sk + 1) // 2),
         "wp_rec_start_date": np.full(len(idx), 10227, dtype=np.int64),
-        "wp_rec_end_date": np.where(sk % 2 == 0, 11322, 110000),
+        "wp_rec_end_date": np.where(sk % 2 == 0, 11322, 0),
+        "wp_rec_end_date#null": sk % 2 == 0,
         "wp_creation_date_sk": _uniform(
             h(1), SALES_DATE_LO - 1000, SALES_DATE_LO).astype(np.int32),
         "wp_access_date_sk": _uniform(
@@ -777,7 +782,10 @@ def _fact_common(idx, sf, seed, table):
     date_sk = _uniform(th(1), SALES_DATE_LO, SALES_DATE_HI)
     time_sk = _uniform(th(2), 0, 86399)
     cust = _uniform(th(3), 1, max(table_rows("customer", sf), 1))
-    item = _uniform(h(4), 1, max(table_rows("item", sf), 1))
+    # items are DISTINCT within a ticket (dsdgen invariant backing the
+    # (item, ticket) primary key): per-ticket random base + line offset
+    n_item = max(table_rows("item", sf), 1)
+    item = (_uniform(th(12), 0, n_item - 1) + line - 1) % n_item + 1
     qty = _uniform(h(5), 1, 100)
     return h, th, ticket, line, date_sk, time_sk, cust, item, qty
 

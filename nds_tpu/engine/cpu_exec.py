@@ -209,12 +209,29 @@ class CpuExecutor:
             out.put((node.binding, name), arr, valid)
         return out
 
-    def _key_frame(self, ctx: Context, keys: list[ir.IR]) -> pd.DataFrame:
+    def _key_frame(self, ctx: Context, keys: list[ir.IR],
+                   side: str = "") -> pd.DataFrame:
+        """Join-key frame. NULL keys must never match anything (SQL
+        equality semantics; pandas merge would happily pair NaN with
+        NaN), so invalid rows get a per-side, per-row unique sentinel."""
         data = {}
+        # never-matching sentinel blocks per side, far below any real
+        # key domain (keys are sks/dates/codes, all > -2^40)
+        base = (np.iinfo(np.int64).min // 4) * (2 if side == "L" else 3)
         for i, k in enumerate(keys):
             arr, valid = self.eval(k, ctx)
-            if isinstance(arr.dtype, object.__class__) or arr.dtype == object:
-                arr = arr.astype(str)
+            is_obj = (isinstance(arr.dtype, object.__class__)
+                      or arr.dtype == object)
+            if is_obj:
+                arr = arr.astype(str).astype(object)
+            if valid is not None and not valid.all():
+                bad = np.nonzero(~valid)[0]
+                if not is_obj and np.issubdtype(arr.dtype, np.integer):
+                    arr = arr.astype(np.int64)
+                    arr[bad] = base + bad
+                else:
+                    arr = arr.astype(object)
+                    arr[bad] = [f"__null{side}{j}" for j in bad]
             data[f"k{i}"] = arr
         return pd.DataFrame(data)
 
@@ -225,13 +242,24 @@ class CpuExecutor:
             ri = np.tile(np.arange(rctx.nrows), lctx.nrows)
             out = lctx.take(li).merge(rctx.take(ri))
             return out
-        lk = self._key_frame(lctx, node.left_keys)
-        rk = self._key_frame(rctx, node.right_keys)
+        lk = self._key_frame(lctx, node.left_keys, "L")
+        rk = self._key_frame(rctx, node.right_keys, "R")
         lk["_li"] = np.arange(lctx.nrows)
         rk["_ri"] = np.arange(rctx.nrows)
-        how = "left" if node.kind == "left" else "inner"
+        how = {"left": "left", "full": "outer"}.get(node.kind, "inner")
         m = lk.merge(rk, on=[f"k{i}" for i in range(len(node.left_keys))],
                      how=how)
+        if node.kind == "full":
+            if node.residual is not None:
+                raise ExecError("FULL OUTER residual unsupported")
+            lmatched = m["_li"].notna().to_numpy()
+            rmatched = m["_ri"].notna().to_numpy()
+            li = np.where(lmatched, m["_li"].fillna(0).to_numpy(),
+                          0).astype(np.int64)
+            ri = np.where(rmatched, m["_ri"].fillna(0).to_numpy(),
+                          0).astype(np.int64)
+            return lctx.take(li, matched=lmatched).merge(
+                rctx.take(ri, matched=rmatched))
         li = m["_li"].to_numpy()
         if node.kind == "left":
             matched = m["_ri"].notna().to_numpy()
@@ -268,8 +296,8 @@ class CpuExecutor:
     def _run_semijoin(self, node: P.SemiJoin) -> Context:
         lctx, rctx = self.run(node.left), self.run(node.right)
         if node.left_keys:
-            lk = self._key_frame(lctx, node.left_keys)
-            rk = self._key_frame(rctx, node.right_keys)
+            lk = self._key_frame(lctx, node.left_keys, "L")
+            rk = self._key_frame(rctx, node.right_keys, "R")
             lk["_li"] = np.arange(lctx.nrows)
             rk["_ri"] = np.arange(rctx.nrows)
             m = lk.merge(rk, on=[f"k{i}" for i in range(len(node.left_keys))],
@@ -332,7 +360,8 @@ class CpuExecutor:
             out.put((b, kname), arr[first],
                     None if v is None else v[first])
         for name, spec in node.aggs:
-            out.put((b, name), self._agg_grouped(spec, ctx, codes, ngroups))
+            vals, gvalid = self._agg_grouped(spec, ctx, codes, ngroups)
+            out.put((b, name), vals, gvalid)
         return out
 
     def _agg_input(self, spec: P.AggSpec, ctx: Context):
@@ -368,7 +397,11 @@ class CpuExecutor:
         raise ExecError(spec.func)
 
     def _agg_grouped(self, spec: P.AggSpec, ctx: Context,
-                     codes: np.ndarray, ngroups: int) -> np.ndarray:
+                     codes: np.ndarray, ngroups: int):
+        """-> (values, validity-or-None). A group whose every input is
+        NULL aggregates to NULL for sum/min/max/avg (and stddev needs
+        two valid rows) — only count stays 0-valued (SQL semantics the
+        device engine already implements)."""
         arr, valid = self._agg_input(spec, ctx)
         if spec.func == "count":
             if spec.distinct:
@@ -379,44 +412,52 @@ class CpuExecutor:
                 s = df.groupby("g")["v"].nunique()
                 out = np.zeros(ngroups, dtype=np.int64)
                 out[s.index.to_numpy()] = s.to_numpy()
-                return out
+                return out, None
             if arr is None:
-                return np.bincount(codes, minlength=ngroups).astype(np.int64)
+                return (np.bincount(codes, minlength=ngroups)
+                        .astype(np.int64), None)
             m = valid if valid is not None else np.ones(len(arr), bool)
-            return np.bincount(codes[m], minlength=ngroups).astype(np.int64)
+            return (np.bincount(codes[m], minlength=ngroups)
+                    .astype(np.int64), None)
         m = valid if valid is not None else None
         vals = arr if m is None else arr[m]
         gcodes = codes if m is None else codes[m]
+        nvalid = np.bincount(gcodes, minlength=ngroups)
+        gvalid = (None if ngroups and nvalid.min() > 0
+                  else nvalid > 0)
         if spec.func == "sum":
             if isinstance(spec.dtype, FloatType):
-                return np.bincount(gcodes, weights=vals.astype(np.float64),
-                                   minlength=ngroups)
+                return (np.bincount(gcodes,
+                                    weights=vals.astype(np.float64),
+                                    minlength=ngroups), gvalid)
             # integer/decimal sums accumulate in int64 — exact (the decimal
             # policy this oracle exists to enforce; bincount would round
             # through float64 past 2^53)
             out = np.zeros(ngroups, dtype=np.int64)
             np.add.at(out, gcodes, vals.astype(np.int64))
-            return out
+            return out, gvalid
         if spec.func == "avg":
             f = _to_float(vals, spec.arg.dtype)
             s = np.bincount(gcodes, weights=f, minlength=ngroups)
             c = np.bincount(gcodes, minlength=ngroups)
             with np.errstate(invalid="ignore"):
-                return s / np.maximum(c, 1)
+                return s / np.maximum(c, 1), gvalid
         if spec.func in ("min", "max"):
             df = pd.DataFrame({"g": gcodes, "v": vals})
             s = df.groupby("g")["v"].min() if spec.func == "min" \
                 else df.groupby("g")["v"].max()
             out = np.zeros(ngroups, dtype=vals.dtype)
             out[s.index.to_numpy()] = s.to_numpy()
-            return out
+            return out, gvalid
         if spec.func in ("stddev_samp", "stddev"):
             f = _to_float(vals, spec.arg.dtype)
             s = pd.DataFrame({"g": gcodes, "v": f}).groupby("g")["v"].std(
                 ddof=1)
             out = np.full(ngroups, np.nan)
             out[s.index.to_numpy()] = s.to_numpy()
-            return out
+            # stddev_samp needs >= 2 valid rows
+            two = np.bincount(gcodes, minlength=ngroups) >= 2
+            return np.nan_to_num(out), two if not two.all() else None
         raise ExecError(spec.func)
 
     def _run_window(self, node: P.Window) -> Context:
@@ -548,7 +589,11 @@ class CpuExecutor:
                 g2 = pd.DataFrame({"g": pc, "v": masked}).groupby("g")
                 if running:
                     res = (g2["v"].cummin() if spec.func == "min"
-                           else g2["v"].cummax()).to_numpy()
+                           else g2["v"].cummax())
+                    # pandas cum* leaves NaN AT null positions instead
+                    # of carrying the running extremum forward — ffill
+                    # within the partition (SQL: max over rows so far)
+                    res = res.groupby(pc).ffill().to_numpy()
                 else:
                     res = g2["v"].transform(spec.func).to_numpy()
                 res = np.nan_to_num(res)
@@ -758,6 +803,16 @@ class CpuExecutor:
             else:
                 raise ExecError(f"extract {e.part}")
             return out.astype(np.int32), v
+        if isinstance(e, ir.StrMapIR):
+            a, v = self.eval(e.operand, ctx)
+            sa = a.astype(str)
+            out = (np.char.upper(sa) if e.op == "upper"
+                   else np.char.lower(sa))
+            return out.astype(object), v
+        if isinstance(e, ir.ConcatIR):
+            a, v = self.eval(e.operand, ctx)
+            return (np.array([e.prefix + s + e.suffix
+                              for s in a.astype(str)], dtype=object), v)
         if isinstance(e, ir.SubstrIR):
             a, v = self.eval(e.operand, ctx)
             sa = a.astype(str)
@@ -888,7 +943,9 @@ class ResultTable:
         return len(self.cols[0]) if self.cols else 0
 
     def to_pandas(self) -> pd.DataFrame:
-        data = {}
+        # positional build: duplicate output names are legal SQL
+        # (q64 selects cs1.syear and cs2.syear)
+        series = []
         for name, arr, dt, valid in zip(self.names, self.cols, self.dtypes,
                                         self.valids):
             if isinstance(dt, DecimalType):
@@ -901,8 +958,7 @@ class ResultTable:
             if valid is not None:
                 a = pd.array(a)
                 a[~valid] = None
-            data[name] = a
-        df = pd.DataFrame(data)
-        # duplicate names possible; keep positional
+            series.append(pd.Series(a))
+        df = pd.concat(series, axis=1, ignore_index=True)
         df.columns = self.names
         return df

@@ -527,6 +527,44 @@ class _Trace:
         hit = (jnp.take(ks, pos) == pkey) & pok
         return jnp.take(order, pos), hit
 
+    def _full_join(self, node: P.Join, lctx, rctx, lkey, lok, rkey,
+                   rok) -> DCtx:
+        """FULL OUTER over unique keys on BOTH sides (q51/q97 join
+        grouped CTEs on their group keys): capacity = |L| + |R|. Slots
+        [0, |L|) hold every left row with the right side gathered (null
+        where unmatched); slots [|L|, |L|+|R|) hold only the right rows
+        with no left match, left side null-extended."""
+        if not node.right_unique:
+            raise DeviceExecError(
+                "FULL OUTER JOIN requires unique join keys")
+        ks, order = self._build_lookup(rkey, rok)
+        ridx, hit = self._probe(ks, order, lkey, lok)
+        ks2, order2 = self._build_lookup(lkey, lok)
+        _lidx, rhit = self._probe(ks2, order2, rkey, rok)
+        unmatched_r = rctx.row & ~rhit
+
+        falsev = jnp.zeros(rctx.n, dtype=bool)
+        out = DCtx(lctx.n + rctx.n,
+                   jnp.concatenate([lctx.row, unmatched_r]))
+        gathered = rctx.gather(ridx, clear_valid=hit)
+        for k, dv in lctx.cols.items():
+            # left columns: present in block A, null in block B
+            pad = jnp.zeros((rctx.n,) + dv.arr.shape[1:], dv.arr.dtype)
+            arr = jnp.concatenate([dv.arr, pad])
+            lv = dv.valid if dv.valid is not None else jnp.ones(
+                lctx.n, dtype=bool)
+            out.cols[k] = dv.with_arrays(
+                arr, jnp.concatenate([lv, falsev]))
+        for k, dv in rctx.cols.items():
+            g = gathered.cols[k]
+            arr = jnp.concatenate([g.arr, dv.arr])
+            gv = g.valid if g.valid is not None else hit
+            dvv = dv.valid if dv.valid is not None else jnp.ones(
+                rctx.n, dtype=bool)
+            out.cols[k] = dv.with_arrays(
+                arr, jnp.concatenate([gv, dvv]))
+        return out
+
     def _run_join(self, node: P.Join) -> DCtx:
         lctx, rctx = self.run(node.left), self.run(node.right)
         if not node.left_keys:
@@ -534,6 +572,9 @@ class _Trace:
         lvals = [self.eval(k, lctx) for k in node.left_keys]
         rvals = [self.eval(k, rctx) for k in node.right_keys]
         lkey, lok, rkey, rok = self._join_key_arrays(lvals, rvals, lctx, rctx)
+        if node.kind == "full":
+            return self._full_join(node, lctx, rctx, lkey, lok, rkey,
+                                   rok)
         if node.right_unique:
             # gather join: probe from the left, build on the unique right
             ks, order = self._build_lookup(rkey, rok)
@@ -1374,6 +1415,10 @@ class _Trace:
             if e.part == "day":
                 return DVal(d, dv.valid, None, 1, 31)
             raise DeviceExecError(f"extract {e.part}")
+        if isinstance(e, ir.StrMapIR):
+            return self._eval_strmap(e, ctx)
+        if isinstance(e, ir.ConcatIR):
+            return self._eval_concat(e, ctx)
         if isinstance(e, ir.SubstrIR):
             return self._eval_substr(e, ctx)
         if isinstance(e, ir.CastIR):
@@ -1591,6 +1636,34 @@ class _Trace:
             m = m | (dv.arr == v)
         return DVal(~m if e.negated else m, dv.valid)
 
+    def _rewrite_dict(self, dv: DVal, fn) -> DVal:
+        """Apply a per-entry string transform to a dictionary-encoded
+        value: codes stay on device; the host-side dictionary is
+        rewritten, DEDUPED (entries may collide, e.g. upper('abc') ==
+        upper('ABC') — grouping hashes codes, so equal strings must
+        share a code), re-sorted, and codes remapped."""
+        if dv.sdict is None:
+            raise DeviceExecError("string transform over non-string")
+        newvals = np.array([fn(s) for s in dv.sdict.astype(str)],
+                           dtype=object)
+        uniq, inverse = np.unique(newvals.astype(str),
+                                  return_inverse=True)
+        table = jnp.asarray(inverse.astype(np.int32))
+        return DVal(jnp.take(table, dv.arr), dv.valid,
+                    uniq.astype(object), 0, max(len(uniq) - 1, 0))
+
+    def _eval_strmap(self, e: ir.StrMapIR, ctx: DCtx) -> DVal:
+        dv = self.eval(e.operand, ctx)
+        f = str.upper if e.op == "upper" else str.lower
+        return self._rewrite_dict(dv, f)
+
+    def _eval_concat(self, e: ir.ConcatIR, ctx: DCtx) -> DVal:
+        """Literal ⊕ column concat as a dictionary rewrite (q5's
+        'store' || s_store_id ids)."""
+        dv = self.eval(e.operand, ctx)
+        return self._rewrite_dict(
+            dv, lambda s: e.prefix + s + e.suffix)
+
     def _eval_substr(self, e: ir.SubstrIR, ctx: DCtx) -> DVal:
         dv = self.eval(e.operand, ctx)
         if dv.sdict is None:
@@ -1684,4 +1757,8 @@ def make_device_factory():
             holder["ex"] = ex
         return ex
 
+    # DML invalidation hook (Session.invalidate): mutated tables need a
+    # fresh executor — buffers, bounds and compiled programs all key on
+    # table contents/shapes
+    factory.invalidate = holder.clear
     return factory

@@ -67,6 +67,80 @@ QUALIFICATION: dict[int, dict] = {
          "date": "1999-02-22"},
     7: {"gender": "M", "marital": "S", "education": "College",
         "year": 2000},
+    18: {"gender": "F", "education": "Unknown", "year": 1998,
+         "m1": 1, "m2": 6, "m3": 8, "m4": 9, "m5": 12, "m6": 2,
+         "s1": "MS", "s2": "IN", "s3": "ND", "s4": "OK", "s5": "NM",
+         "s6": "VA", "s7": "MS"},
+    21: {"date": "1998-02-01"},
+    22: {"dms": 1176},
+    27: {"gender": "M", "marital": "S", "education": "College",
+         "year": 2000, "s1": "FL", "s2": "IL", "s3": "KY", "s4": "LA",
+         "s5": "PA", "s6": "SD"},
+    30: {"year": 2002, "state": "GA"},
+    33: {"category": "Electronics", "year": 1998, "month": 5,
+         "gmt": -5},
+    35: {"year": 2002},
+    38: {"dms": 1212},
+    40: {"date": "2000-03-11"},
+    41: {"manufact": 738},
+    50: {"year": 2001, "month": 8},
+    76: {},
+    85: {"year": 2000},
+    87: {"dms": 1212},
+    4: {"year": 1999},
+    8: {"qoy": 2, "year": 1998},
+    14: {"year": 1999},
+    23: {"year": 1999, "month": 5},
+    24: {"market": 5, "c1": "beige", "c2": "azure"},
+    39: {"year": 1998, "month": 1},
+    64: {"year": 1999, "price": 15,
+         "c1": "azure", "c2": "beige", "c3": "black", "c4": "blue",
+         "c5": "brown", "c6": "coral"},
+    66: {"year": 1999, "time": 30000, "smc1": "UPS", "smc2": "FEDEX"},
+    67: {"dms": 1200},
+    72: {"bp": ">10000", "ms": "M", "year": 1999},
+    75: {"category": "Home", "year": 2000},
+    78: {"year": 1999},
+    51: {"dms": 1200},
+    97: {"dms": 1200},
+    34: {"year": 1999, "bp1": ">10000", "bp2": "Unknown",
+         "county1": "Barrow County", "county2": "Bronx County",
+         "county3": "Maverick County", "county4": "Mobile County",
+         "county5": "Orange County", "county6": "Barrow County",
+         "county7": "Bronx County", "county8": "Orange County"},
+    45: {"qoy": 1, "year": 2000},
+    46: {"dep": 5, "veh": 3, "year": 1999, "city1": "Midway",
+         "city2": "Bethel"},
+    49: {"ramt": 10, "year": 2000, "month": 12},
+    54: {"category": "Music", "class": "musicclass5", "month": 4,
+         "year": 1999},
+    56: {"c1": "azure", "c2": "beige", "c3": "black", "year": 2000,
+         "month": 2, "gmt": -5},
+    58: {"date": "2000-03-24"},
+    60: {"category": "Children", "year": 1999, "month": 9, "gmt": -5},
+    81: {"year": 1999, "state": "TX"},
+    83: {"date1": "1998-03-20", "date2": "1999-06-14",
+         "date3": "2000-11-17"},
+    95: {"date": "1999-02-01", "state": "TX", "company": "able"},
+    2: {"year": 1998},
+    5: {"date": "2000-08-19"},
+    11: {"year": 1999},
+    31: {"year": 2000},
+    59: {"dms": 1200},
+    71: {"manager": 1, "month": 12, "year": 1999},
+    74: {"year": 1999},
+    77: {"date": "2000-08-19"},
+    80: {"date": "2000-08-19"},
+    36: {"year": 2000, "s1": "FL", "s2": "IL", "s3": "KY", "s4": "LA",
+         "s5": "PA", "s6": "SD"},
+    44: {"store": 4},
+    47: {"year": 2000},
+    53: {"dms": 1190},
+    57: {"year": 2000},
+    63: {"dms": 1190},
+    70: {"dms": 1212},
+    86: {"dms": 1212},
+    89: {"year": 1999},
     9: {"t1": 3000, "t2": 3000, "t3": 3000, "t4": 3000, "t5": 3000},
     13: {"year": 2001, "ms1": "M", "es1": "Advanced Degree",
          "ms2": "S", "es2": "College", "ms3": "W", "es3": "2 yr Degree",

@@ -550,4 +550,7 @@ def make_distributed_factory(mesh=None, n_devices=None,
             holder["ex"] = ex
         return ex
 
+    # DML invalidation hook (Session.invalidate), as in
+    # device_exec.make_device_factory
+    factory.invalidate = holder.clear
     return factory

@@ -218,6 +218,23 @@ class CreateView:
 @dataclass
 class DropView:
     name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert:
+    """INSERT INTO table (select ...) — the LF_* refresh functions'
+    second statement (`nds/data_maintenance/LF_SS.sql` last line)."""
+    table: str
+    query: "Select"
+
+
+@dataclass
+class Delete:
+    """DELETE FROM table WHERE pred — the DF_* refresh functions
+    (`nds/data_maintenance/DF_SS.sql`)."""
+    table: str
+    where: Optional[Expr]
 
 
 @dataclass

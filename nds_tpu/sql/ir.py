@@ -129,6 +129,27 @@ class SubstrIR(IR):
 
 
 @dataclass
+class StrMapIR(IR):
+    """upper()/lower(): per-dictionary-entry string transform (device:
+    codes untouched, dictionary rewritten + re-sorted)."""
+    op: str              # upper | lower
+    operand: IR
+    dtype: DType = None
+
+
+@dataclass
+class ConcatIR(IR):
+    """String concatenation with a LITERAL prefix/suffix (q5's
+    'store' || s_store_id ids). Restricted to literal ⊕ column so the
+    device engine can implement it as a dictionary transform (codes
+    untouched, only the host-side dictionary rewritten)."""
+    prefix: str
+    operand: IR          # string-typed column expression
+    suffix: str
+    dtype: DType = None
+
+
+@dataclass
 class CastIR(IR):
     operand: IR
     dtype: DType = None

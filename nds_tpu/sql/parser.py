@@ -88,15 +88,54 @@ class Parser:
                         break
                 self.expect_punct(")")
             self.expect_kw("as")
-            q = self.parse_select()
+            q = self._parse_query()
             self.take_punct(";")
             return ast.CreateView(name, columns, q)
         if self.at_kw("drop"):
             self.next()
             self.expect_kw("view")
+            if_exists = False
+            if self.take_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
             name = self.next().value.lower()
             self.take_punct(";")
-            return ast.DropView(name)
+            return ast.DropView(name, if_exists)
+        if self.at_kw("insert"):
+            self.next()
+            self.expect_kw("into")
+            name = self.next().value.lower()
+            wrapped = self.take_punct("(")
+            q = self._parse_query()
+            if wrapped:
+                self.expect_punct(")")
+            self.take_punct(";")
+            t = self.peek()
+            if t.kind != "eof":
+                raise ParseError("trailing tokens after INSERT", t)
+            return ast.Insert(name, q)
+        if self.at_kw("delete"):
+            self.next()
+            self.expect_kw("from")
+            name = self.next().value.lower()
+            where = None
+            if self.take_kw("where"):
+                where = self.parse_expr()
+            self.take_punct(";")
+            t = self.peek()
+            if t.kind != "eof":
+                raise ParseError("trailing tokens after DELETE", t)
+            return ast.Delete(name, where)
+        sel = self._parse_query()
+        self.take_punct(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise ParseError("trailing tokens after statement", t)
+        return sel
+
+    def _parse_query(self) -> ast.Select:
+        """[WITH ctes] select — the query body shared by top-level
+        statements, CREATE VIEW ... AS, and INSERT INTO ... (query)."""
         ctes: dict[str, ast.Select] = {}
         if self.take_kw("with"):
             while True:
@@ -109,10 +148,6 @@ class Parser:
                     break
         sel = self.parse_select()
         sel.ctes.update(ctes)
-        self.take_punct(";")
-        t = self.peek()
-        if t.kind != "eof":
-            raise ParseError("trailing tokens after statement", t)
         return sel
 
     def parse_select(self) -> ast.Select:
@@ -391,6 +426,11 @@ class Parser:
         left = self._parse_multiplicative()
         while True:
             t = self.peek()
+            if t.kind == "op" and t.value == "||":
+                self.next()
+                left = ast.FuncCall(
+                    "concat", [left, self._parse_multiplicative()])
+                continue
             if t.kind == "op" and t.value in ("+", "-"):
                 self.next()
                 left = ast.BinOp(t.value, left, self._parse_multiplicative())

@@ -184,7 +184,25 @@ class Planner:
                 node = self._rename_outputs(node, stmt.columns)
             return ("create_view", stmt.name, node)
         if isinstance(stmt, ast.DropView):
-            return ("drop_view", stmt.name, None)
+            return ("drop_view", stmt.name,
+                    "if_exists" if stmt.if_exists else None)
+        if isinstance(stmt, ast.Insert):
+            if not self.catalog.has_table(stmt.table):
+                raise PlanError(f"unknown insert target {stmt.table!r}")
+            root = self.plan_select(stmt.query, None, {})
+            target = self.catalog.schemas[stmt.table]
+            if len(root.output) != len(target.fields):
+                raise PlanError(
+                    f"INSERT into {stmt.table}: select produces "
+                    f"{len(root.output)} columns, table has "
+                    f"{len(target.fields)}")
+            names = [n for n, _ in root.output]
+            return ("insert", stmt.table,
+                    P.PlannedQuery(root, self.scalar_subplans, names))
+        if isinstance(stmt, ast.Delete):
+            if not self.catalog.has_table(stmt.table):
+                raise PlanError(f"unknown delete target {stmt.table!r}")
+            return ("delete", stmt.table, stmt.where)
         root = self.plan_select(stmt, None, {})
         names = [n for n, _ in root.output]
         return P.PlannedQuery(root, self.scalar_subplans, names)
@@ -306,14 +324,24 @@ class Planner:
                     self._classify(_flatten_and(jc.on), scope, edges,
                                    residuals, semis, ordered_rels,
                                    local_views)
-            elif jc.kind == "left":
+            elif jc.kind in ("left", "full"):
                 pairs, resid = self._split_on(jc.on, scope, rel)
-                left_joins.append((rel, pairs, resid))
+                if jc.kind == "full" and resid is not None:
+                    raise PlanError(
+                        "FULL OUTER JOIN supports only equi-conditions")
+                left_joins.append((jc.kind, rel, pairs, resid))
                 ordered_rels.remove(rel)  # not part of the inner-join graph
             else:
                 raise PlanError(f"unsupported join kind {jc.kind}")
 
-        left_bindings = {rel.binding for rel, _p, _r in left_joins}
+        left_bindings = {rel.binding for _k, rel, _p, _r in left_joins}
+        has_full = any(k == "full" for k, _r, _p, _res in left_joins)
+        if has_full:
+            # a FULL join preserves BOTH sides: no WHERE conjunct may be
+            # pushed below it (filtering the preserved side pre-join
+            # changes which rows null-extend) — everything goes late
+            left_bindings = left_bindings | {
+                r.binding for r in ordered_rels}
         if sel.where is not None:
             conjuncts = _hoist_common_disjuncts(_flatten_and(sel.where))
             self._classify(conjuncts, scope, edges, residuals, semis,
@@ -329,7 +357,10 @@ class Planner:
             edge_bindings.add(ra.binding if ra is not None else None)
             edge_bindings.add(rb.binding if rb is not None else None)
         for rel in list(ordered_rels):
-            if rel.binding in edge_bindings:
+            if has_full or rel.binding in edge_bindings:
+                # under a FULL join every conjunct is late by design;
+                # inner rels stay in the graph and late conjuncts become
+                # post-join filters
                 continue
             if any(rel.binding in self._bindings_of(e) for e in late):
                 ordered_rels.remove(rel)
@@ -337,12 +368,12 @@ class Planner:
 
         node = self._join_graph(ordered_rels, edges)
 
-        for rel, pairs, resid in left_joins:
+        for kind, rel, pairs, resid in left_joins:
             rnames = {p[1].name for p in pairs
                       if isinstance(p[1], ir.ColRef)}
             right_unique = (bool(rel.unique_on)
                             and set(rel.unique_on) <= rnames)
-            node = P.Join("left", node, rel.node,
+            node = P.Join(kind, node, rel.node,
                           [p[0] for p in pairs], [p[1] for p in pairs],
                           resid, right_unique=right_unique,
                           output=node.output + rel.node.output,
@@ -1075,7 +1106,9 @@ class Planner:
                 if x.op in ("and", "or"):
                     return ir.BoolOp(x.op, [rec(x.left), rec(x.right)])
                 if x.op in ("=", "<>", "<", "<=", ">", ">="):
-                    return ir.Cmp(x.op, rec(x.left), rec(x.right))
+                    lhs, rhs = _coerce_date_cmp(rec(x.left),
+                                                rec(x.right))
+                    return ir.Cmp(x.op, lhs, rhs)
                 # date ± interval folding
                 if isinstance(x.right, ast.Interval):
                     base = rec(x.left)
@@ -1145,6 +1178,28 @@ class Planner:
                     whens = [(ir.IsNullIR(a, negated=True), a)
                              for a in args[:-1]]
                     return ir.CaseIR(whens, args[-1], dt)
+                if x.name in ("upper", "lower"):
+                    a = rec(x.args[0])
+                    if isinstance(a, ir.Lit) and isinstance(a.value, str):
+                        v = (a.value.upper() if x.name == "upper"
+                             else a.value.lower())
+                        return ir.Lit(v, StringType())
+                    return ir.StrMapIR(x.name, a, StringType())
+                if x.name == "concat":
+                    parts = [rec(a) for a in x.args]
+                    lits = [p.value if isinstance(p, ir.Lit) else None
+                            for p in parts]
+                    cols = [i for i, v in enumerate(lits) if v is None]
+                    if not cols:  # all literals: fold
+                        return ir.Lit("".join(str(v) for v in lits),
+                                      StringType())
+                    if len(cols) > 1:
+                        raise PlanError(
+                            "concat/|| supports one non-literal operand")
+                    i = cols[0]
+                    pre = "".join(str(v) for v in lits[:i])
+                    suf = "".join(str(v) for v in lits[i + 1:])
+                    return ir.ConcatIR(pre, parts[i], suf, StringType())
                 if x.name == "nullif":
                     a, b = rec(x.args[0]), rec(x.args[1])
                     return ir.CaseIR([(ir.Cmp("=", a, b),
@@ -1177,9 +1232,10 @@ class Planner:
                 return ir.CaseIR(whens, else_, dt)
             if isinstance(x, ast.Between):
                 e_ir = rec(x.expr)
-                lo, hi = rec(x.low), rec(x.high)
-                both = ir.BoolOp("and", [ir.Cmp(">=", e_ir, lo),
-                                         ir.Cmp("<=", e_ir, hi)])
+                e_lo, lo = _coerce_date_cmp(e_ir, rec(x.low))
+                e_hi, hi = _coerce_date_cmp(e_ir, rec(x.high))
+                both = ir.BoolOp("and", [ir.Cmp(">=", e_lo, lo),
+                                         ir.Cmp("<=", e_hi, hi)])
                 return ir.Not(both) if x.negated else both
             if isinstance(x, ast.InList):
                 e_ir = rec(x.expr)
@@ -1215,6 +1271,11 @@ class Planner:
                      "string": StringType()}.get(x.type_name)
                 if t is None:
                     raise PlanError(f"unsupported cast to {x.type_name}")
+                if (t is DATE and isinstance(inner, ir.Lit)
+                        and isinstance(inner.value, str)):
+                    # fold cast('1998-01-01' as date) to a DATE literal
+                    # (q21/q40 style date-window arithmetic)
+                    return ir.Lit(_date_to_days(inner.value), DATE)
                 return ir.CastIR(inner, t)
             if isinstance(x, ast.ScalarSubquery):
                 # uncorrelated scalar in a general expression position
@@ -1249,6 +1310,23 @@ class Planner:
         raise PlanError(f"unknown literal kind {x.kind}")
 
 
+def _coerce_date_cmp(l: ir.IR, r: ir.IR) -> tuple:
+    """SQL's implicit string->date cast in comparisons: a string literal
+    compared against a DATE expression becomes a DATE literal (the
+    reference engine gets this from Spark; the DF_* maintenance SQL and
+    ad-hoc 'd_date between ...' predicates rely on it)."""
+    from nds_tpu.engine.types import DateType
+    if (isinstance(l.dtype, DateType) and isinstance(r, ir.Lit)
+            and isinstance(r.dtype, StringType)
+            and isinstance(r.value, str)):
+        return l, ir.Lit(_date_to_days(r.value), DATE)
+    if (isinstance(r.dtype, DateType) and isinstance(l, ir.Lit)
+            and isinstance(l.dtype, StringType)
+            and isinstance(l.value, str)):
+        return ir.Lit(_date_to_days(l.value), DATE), r
+    return l, r
+
+
 def _unique_key_of(node: P.Node) -> tuple:
     """Output column names a derived table is unique on, traced through
     Project/Filter/Sort/Limit wrappers down to an Aggregate's group keys
@@ -1260,14 +1338,23 @@ def _unique_key_of(node: P.Node) -> tuple:
         return tuple(n for n, _ in node.output)
     if isinstance(node, (P.Filter, P.Sort, P.Limit)):
         return _unique_key_of(node.child)
+    if isinstance(node, P.Window):
+        # Window extends columns without changing the row set (q51's
+        # cumulative sums over grouped CTEs stay unique on group keys)
+        return _unique_key_of(node.child)
     if isinstance(node, P.Project):
         inner = _unique_key_of(node.child)
         if not inner:
             return ()
-        child_binding = getattr(node.child, "binding", "")
+        # a Window child is namespace-EXTENDING: the Project reads key
+        # columns under the Window's child binding, window columns under
+        # the Window's own binding — accept both
+        bindings = {getattr(node.child, "binding", "")}
+        if isinstance(node.child, P.Window):
+            bindings.add(getattr(node.child.child, "binding", ""))
         mapping = {}
         for name, e in node.exprs:
-            if isinstance(e, ir.ColRef) and e.binding == child_binding:
+            if isinstance(e, ir.ColRef) and e.binding in bindings:
                 mapping.setdefault(e.name, name)
         out = []
         for k in inner:

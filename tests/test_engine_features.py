@@ -270,3 +270,51 @@ def test_many_to_many_inner_join(sessions, pdf):
     # (the old unique-build path would keep one match per probe row)
     assert len(exp) == len(m)
     assert exp["id_a"].duplicated().any(), "join must expand matches"
+
+
+# ---------------------------------------------- full outer join / strings
+
+def test_full_outer_join_where_applies_post_join(sessions, pdf):
+    """WHERE over a FULL OUTER JOIN filters null-extended rows too —
+    no pushdown below the preserving join (r2 review repro)."""
+    sql = ("with a as (select s_store k1, sum(s_qty) v1 from sales "
+           "where s_cat = 'alpha' group by s_store), "
+           "b as (select s_store k2, sum(s_qty) v2 from sales "
+           "where s_cat = 'beta' group by s_store) "
+           "select k1, v1, k2, v2 from a full outer join b "
+           "on (a.k1 = b.k2) where v1 > 0 order by k1")
+    exp = both(sessions, sql)
+    # every surviving row has a non-null v1 (null-extended b-only rows
+    # must be filtered out)
+    assert exp["v1"].notna().all()
+
+
+def test_full_outer_join_preserves_both_sides(sessions):
+    sql = ("with a as (select s_store k1 from sales where s_store <= 3 "
+           "group by s_store), "
+           "b as (select s_store k2 from sales where s_store >= 3 "
+           "group by s_store) "
+           "select k1, k2 from a full outer join b on (a.k1 = b.k2) "
+           "order by k1, k2")
+    exp = both(sessions, sql)
+    assert len(exp) == 5  # stores 1..5: 1,2 a-only; 3 both; 4,5 b-only
+    assert exp["k1"].isna().sum() == 2
+    assert exp["k2"].isna().sum() == 2
+
+
+def test_upper_merges_collided_dictionary_codes(sessions):
+    """upper() must dedupe dictionary entries that become equal, or
+    GROUP BY over codes splits equal strings (r2 review repro)."""
+    sql = ("select upper(s_cat) u, count(*) c from sales "
+           "group by upper(s_cat) order by u")
+    exp = both(sessions, sql)
+    assert list(exp["u"]) == sorted(exp["u"])
+    assert len(exp) == 4  # ALPHA/BETA/DELTA/GAMMA, no split groups
+
+
+def test_concat_literal_prefix(sessions):
+    sql = ("select 'cat_' || s_cat || '!' tag, count(*) c from sales "
+           "group by 'cat_' || s_cat || '!' order by tag")
+    exp = both(sessions, sql)
+    assert all(t.startswith("cat_") and t.endswith("!")
+               for t in exp["tag"])
