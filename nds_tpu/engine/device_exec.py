@@ -256,6 +256,12 @@ class DeviceExecutor:
                 self.last_timings["materialize_ms"] = (t3 - t2) * 1000
                 return out
             # M:N join capacity exceeded: recompile with doubled slack
+            # (recovered task-level failure -> listener chain, the
+            # CompletedWithTaskFailures analog of `Manager.notifyAll`)
+            from nds_tpu.utils.report import TaskFailureCollector
+            TaskFailureCollector.notify(
+                f"join expansion overflow: retry with slack "
+                f"{entry['slack'] * 2}")
             entry.pop("compiled", None)
             entry["slack"] *= 2
         raise DeviceExecError("join expansion overflow after retries")

@@ -42,9 +42,17 @@ def exchange(arrays: list, key, ok, n_dev: int, slack: float = 2.0,
     Returns (out_arrays, out_ok, overflow_count) where out_* have
     capacity n_dev * bucket ( = local_n * slack rounded up).
     """
-    n = key.shape[0]
-    bucket = max(1, int(-(-n * slack // n_dev)))
     dest = (_mix64(key) % jnp.uint64(n_dev)).astype(jnp.int32)
+    return exchange_by_dest(arrays, dest, ok, n_dev, slack, axis)
+
+
+def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
+                     slack: float = 2.0, axis: str = DATA_AXIS):
+    """Exchange core routed by an explicit per-row destination index in
+    [0, n_dev) along ``axis`` (the hierarchical DCN/ICI exchange routes
+    each stage with a different destination derivation)."""
+    n = dest.shape[0]
+    bucket = max(1, int(-(-n * slack // n_dev)))
     # dead rows get a sentinel dest PAST every real bucket so they never
     # consume rank slots (a heavily filtered shard must not overflow its
     # own bucket with corpses)
@@ -81,3 +89,35 @@ def exchange(arrays: list, key, ok, n_dev: int, slack: float = 2.0,
         outs.append(lax.all_to_all(
             sent.reshape(n_dev, bucket), axis, 0, 0).reshape(-1))
     return outs, out_ok, n_overflow
+
+
+def exchange_hierarchical(arrays: list, key, ok, n_hosts: int,
+                          n_lanes: int, slack: float = 2.0,
+                          host_axis: str = "h",
+                          lane_axis: str = DATA_AXIS):
+    """Two-stage shuffle for multi-host meshes (SURVEY.md §7 hard part
+    4: the ICI-instead-of-UCX deliverable at DCN scale): rows first move
+    to their destination HOST over the ``host_axis`` (DCN — one
+    all_to_all of host-sized buckets, minimizing cross-slice bytes),
+    then to their destination LANE over ``lane_axis`` (ICI within the
+    slice). The destination device for a key is stable:
+    g = hash(key) % (hosts * lanes); host = g // lanes; lane = g %
+    lanes — so downstream grouped operators see the same colocation
+    contract as the flat 1-D exchange.
+
+    Returns (out_arrays, out_ok, overflow_count) with the overflow
+    counts of both stages summed (the executor's retry-with-bigger-slack
+    loop treats them uniformly).
+    """
+    g = (_mix64(key) % jnp.uint64(n_hosts * n_lanes)).astype(jnp.int32)
+    dest_h = g // n_lanes
+    # stage 1 (DCN): deliver rows + their keys to the right host
+    outs1, ok1, over1 = exchange_by_dest(
+        list(arrays) + [key], dest_h, ok, n_hosts, slack, host_axis)
+    key1 = outs1[-1]
+    # stage 2 (ICI): recompute the lane from the carried key
+    g1 = (_mix64(key1) % jnp.uint64(n_hosts * n_lanes)).astype(jnp.int32)
+    dest_d = g1 % n_lanes
+    outs2, ok2, over2 = exchange_by_dest(
+        outs1[:-1], dest_d, ok1, n_lanes, slack, lane_axis)
+    return outs2, ok2, over1 + over2

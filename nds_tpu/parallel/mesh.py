@@ -15,6 +15,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA_AXIS = "d"
+HOST_AXIS = "h"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -26,6 +27,23 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
                     f"need {n_devices} devices, have {len(devices)}")
             devices = devices[:n_devices]
     return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def make_multihost_mesh(n_hosts: int, n_lanes: int,
+                        devices=None) -> Mesh:
+    """2-D (host, lane) mesh for DCN-scale runs: the ``h`` axis crosses
+    slices (DCN), the ``d`` axis stays within a slice (ICI). The
+    hierarchical exchange (`exchange.exchange_hierarchical`) routes its
+    DCN stage over ``h`` and its ICI stage over ``d``, so cross-slice
+    traffic is one host-bucketed all_to_all instead of a flat
+    (hosts*lanes)-way shuffle."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_hosts * n_lanes
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_hosts, n_lanes)
+    return Mesh(grid, (HOST_AXIS, DATA_AXIS))
 
 
 def pad_to_multiple(n: int, m: int) -> int:

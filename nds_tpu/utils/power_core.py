@@ -181,6 +181,14 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         report = BenchReport(qname, config.as_dict())
         summary = report.report_on(run_one_query, session, sql, qname,
                                    output_prefix)
+        # engine-side perf accounting: compile vs execute vs
+        # device->host materialization (device backends expose
+        # last_timings; the CPU oracle has none)
+        executor = session._executor_factory(session.tables)
+        timings = getattr(executor, "last_timings", None)
+        if timings:
+            summary["engineTimings"] = {k: round(v, 3)
+                                        for k, v in timings.items()}
         elapsed_ms = summary["queryTimes"][-1]
         tlog.add(qname, elapsed_ms)
         print(f"====== Run {qname} ======")

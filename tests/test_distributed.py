@@ -182,3 +182,50 @@ def test_left_join_nullable_key_distributed():
     assert_frames_close(got, exp, "null-key left join")
     # the NULL-FK rows are exactly the null-extended ones
     assert int(got["d_val"].isna().sum()) == int((~fk_valid).sum())
+
+
+def test_hierarchical_exchange_dcn_ici():
+    """Two-stage DCN/ICI shuffle on a 2x4 virtual (host, lane) mesh:
+    no rows lost, overflow counted, and every key colocated on exactly
+    one (host, lane) device — the same contract as the flat exchange."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from nds_tpu.parallel.dist_exec import shard_map
+    from nds_tpu.parallel.exchange import exchange_hierarchical
+    from nds_tpu.parallel.mesh import HOST_AXIS, make_multihost_mesh
+
+    H, D = 2, 4
+    mesh = make_multihost_mesh(H, D)
+    n = 2048
+    per = n // (H * D)
+    rng = np.random.default_rng(11)
+    keys = rng.integers(1, 500, n).astype(np.int64)
+    vals = np.arange(n, dtype=np.int64)
+    ok = rng.random(n) >= 0.05
+
+    both_axes = P((HOST_AXIS, DATA_AXIS))
+
+    def fn(k, v, o):
+        k, v, o = k.reshape(-1), v.reshape(-1), o.reshape(-1)
+        outs, out_ok, over = exchange_hierarchical(
+            [v, k], k, o, H, D, slack=3.0)
+        m = outs[0].shape[0]
+        return (outs[0].reshape(1, m), outs[1].reshape(1, m),
+                out_ok.reshape(1, m), jnp.reshape(over, (1, 1)))
+
+    f = shard_map(fn, mesh=mesh,
+                  in_specs=(both_axes,) * 3,
+                  out_specs=(both_axes,) * 4)
+    k2 = jnp.asarray(keys).reshape(H * D, per)
+    v2 = jnp.asarray(vals).reshape(H * D, per)
+    o2 = jnp.asarray(ok).reshape(H * D, per)
+    vo, ko, oko, over = jax.jit(f)(k2, v2, o2)
+    vo, ko, oko = (np.asarray(x) for x in (vo, ko, oko))
+    assert int(np.asarray(over).sum()) == 0
+    got = sorted(vo[oko].tolist())
+    assert got == sorted(vals[ok].tolist()), "rows lost or duplicated"
+    # colocation: every key lives on exactly one (host, lane) device
+    for k in np.unique(ko[oko]):
+        devs = {i for i in range(H * D) if (ko[i][oko[i]] == k).any()}
+        assert len(devs) == 1, f"key {k} split across devices {devs}"
