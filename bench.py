@@ -31,7 +31,12 @@ import signal
 import sys
 import time
 
-SF = float(os.environ.get("BENCH_SF", "0.1"))
+# SF0.3 balances signal vs budget: large enough that device compute
+# dominates the per-query tunnel RTT floor (~0.3s), small enough that
+# the CPU-oracle denominator still finishes within the driver budget;
+# data (.bench_data/) and XLA executables (.xla_cache/) persist across
+# runs, so the driver's timed run skips datagen and compiles
+SF = float(os.environ.get("BENCH_SF", "0.3"))
 HERE = os.path.dirname(os.path.abspath(__file__))
 DATA_DIR = os.environ.get(
     "BENCH_DATA", os.path.join(HERE, ".bench_data", f"sf{SF:g}"))

@@ -29,18 +29,33 @@ from nds_tpu.datagen import tpcds
 from nds_tpu.io.csv_io import write_tbl
 from nds_tpu.nds.schema import get_maintenance_schemas, get_schemas
 
-SOURCE_TABLES = sorted(get_schemas())
+# the reference's source_table_names includes the dsdgen metadata table
+# dbgen_version (`nds/nds_gen_data.py:51`) which has no query schema —
+# generated for layout parity, skipped by transcode/power like the
+# reference does (absent from `nds/nds_schema.py:49-568`)
+SOURCE_TABLES = sorted(get_schemas()) + ["dbgen_version"]
 # fixed-cardinality dimensions generated as a single chunk
 # (reference dsdgen emits these without a _N_M suffix)
 SINGLE_CHUNK_TABLES = {
     "date_dim", "time_dim", "reason", "income_band", "ship_mode",
     "call_center", "warehouse", "web_site", "web_page", "store",
     "household_demographics", "customer_demographics", "promotion",
+    "dbgen_version",
 }
 
 
 def _gen_chunk(table: str, sf: float, parallel: int, step: int,
                out_dir: str, use_decimal: bool = True) -> str:
+    if table == "dbgen_version":
+        path = os.path.join(out_dir, table, f"{table}.dat")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import time
+        with open(path, "w") as f:
+            f.write(f"nds_tpu-builtin-1.0|"
+                    f"{time.strftime('%Y-%m-%d')}|"
+                    f"{time.strftime('%H:%M:%S')}|"
+                    f"-scale {sf:g} -parallel {parallel}|\n")
+        return path
     arrays = tpcds.gen_table(table, sf, parallel, step)
     schemas = get_schemas(use_decimal)
     if table in SINGLE_CHUNK_TABLES or parallel == 1:

@@ -265,3 +265,50 @@ def test_source_table_count():
     # 24 generated tables + dbgen_version handled as metadata
     assert len(get_schemas()) == 24
     assert table_rows("store_sales", 1.0) == 2_880_404
+
+
+class TestToolwrap:
+    """External-tool wrapper mechanics (the TPC binaries stay external;
+    these test the parts we own: patching and file layout)."""
+
+    def test_apply_patches_idempotent(self, tmp_path):
+        from nds_tpu.datagen import toolwrap
+        src = tmp_path / "tools"
+        src.mkdir()
+        (src / "a.txt").write_text("line one\nline two\n")
+        patches = tmp_path / "patches"
+        patches.mkdir()
+        (patches / "fix.patch").write_text(
+            "--- a/a.txt\n+++ b/a.txt\n@@ -1,2 +1,2 @@\n line one\n"
+            "-line two\n+line 2\n")
+        applied = toolwrap.apply_patches(str(src), str(patches))
+        assert applied == ["fix.patch"]
+        assert "line 2" in (src / "a.txt").read_text()
+        # second application is a no-op, not a failure
+        applied = toolwrap.apply_patches(str(src), str(patches))
+        assert (src / "a.txt").read_text().count("line 2") == 1
+
+    def test_move_into_table_dirs(self, tmp_path):
+        from nds_tpu.datagen.toolwrap import _move_into_table_dirs
+        d = tmp_path / "data"
+        d.mkdir()
+        for f in ("store_sales_1_4.dat", "store_sales_2_4.dat",
+                  "date_dim.dat", "lineitem.tbl.3", "web_site_1_4.dat"):
+            (d / f).write_text("x|\n")
+        _move_into_table_dirs(str(d))
+        assert sorted(os.listdir(d / "store_sales")) == [
+            "store_sales_1_4.dat", "store_sales_2_4.dat"]
+        assert os.listdir(d / "date_dim") == ["date_dim.dat"]
+        assert os.listdir(d / "lineitem") == ["lineitem.tbl.3"]
+        assert os.listdir(d / "web_site") == ["web_site_1_4.dat"]
+
+
+def test_dbgen_version_layout(tmp_path):
+    """dbgen_version (the 25th source table) is emitted for layout
+    parity with `nds/nds_gen_data.py:51` but has no query schema."""
+    out = str(tmp_path / "raw")
+    gen_data.generate_data_local(SF, 2, out, workers=1)
+    p = os.path.join(out, "dbgen_version", "dbgen_version.dat")
+    assert os.path.isfile(p)
+    assert open(p).read().count("|") == 4
+    assert "dbgen_version" not in get_schemas()
