@@ -92,6 +92,8 @@ def main(argv=None) -> None:
                    help="save each query's result under this directory")
     p.add_argument("--warmup", type=int, default=0,
                    help="untimed runs per query before the timed one")
+    p.add_argument("--profile_dir",
+                   help="write jax profiler traces for the stream here")
     p.add_argument("--allow_failure", action="store_true",
                    help="exit 0 even when queries failed "
                         "(`nds/nds_power.py:391-393`)")
@@ -102,7 +104,8 @@ def main(argv=None) -> None:
         SUITE, args.data_dir, args.query_stream, args.time_log,
         config=config, input_format=args.input_format,
         json_summary_folder=args.json_summary_folder,
-        output_prefix=args.output_prefix, warmup=args.warmup)
+        output_prefix=args.output_prefix, warmup=args.warmup,
+        profile_dir=args.profile_dir)
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 
 

@@ -145,7 +145,8 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                      json_summary_folder: str | None = None,
                      output_prefix: str | None = None,
                      warmup: int = 0,
-                     query_subset: list[str] | None = None) -> int:
+                     query_subset: list[str] | None = None,
+                     profile_dir: str | None = None) -> int:
     """The power loop (`nds/nds_power.py:184-322`): every query runs
     regardless of earlier failures (the reference never aborts
     mid-stream; ``--allow_failure`` only downgrades the exit code,
@@ -169,6 +170,15 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
             (q, s) for q, s in queries.items() if q in query_subset)
     if json_summary_folder:
         os.makedirs(json_summary_folder, exist_ok=True)
+    profiler_cm = None
+    if profile_dir:
+        # device-level traces for the whole stream (XLA op timeline per
+        # query via named TraceAnnotations) — the jax-profiler analog of
+        # the reference's setJobGroup Spark-UI hook
+        import jax
+        os.makedirs(profile_dir, exist_ok=True)
+        jax.profiler.start_trace(profile_dir)
+        profiler_cm = True
     failures = 0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
@@ -179,8 +189,14 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                 except Exception:
                     break
         report = BenchReport(qname, config.as_dict())
-        summary = report.report_on(run_one_query, session, sql, qname,
-                                   output_prefix)
+        if profiler_cm:
+            import jax
+            with jax.profiler.TraceAnnotation(qname):
+                summary = report.report_on(run_one_query, session, sql,
+                                           qname, output_prefix)
+        else:
+            summary = report.report_on(run_one_query, session, sql,
+                                       qname, output_prefix)
         # engine-side perf accounting: compile vs execute vs
         # device->host materialization (device backends expose
         # last_timings; the CPU oracle has none)
@@ -202,6 +218,9 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                 report.write_summary(prefix=f"power-{app_id}")
             finally:
                 os.chdir(cwd)
+    if profiler_cm:
+        import jax
+        jax.profiler.stop_trace()
     power_ms = int((time.perf_counter() - power_start) * 1000)
     tlog.add("Power Test Time", power_ms)
     total_ms = int((time.perf_counter() - total_start) * 1000)
