@@ -401,7 +401,7 @@ class _Trace:
     def _run_scan(self, node: P.Scan) -> DCtx:
         t = self.ex.tables[node.table]
         n = max(t.nrows, 1)
-        row = jnp.arange(n) < t.nrows
+        row = jnp.arange(n, dtype=jnp.int32) < t.nrows
         ctx = DCtx(n, row)
         for name, _dt in node.output:
             col = t.columns[name]
@@ -520,11 +520,15 @@ class _Trace:
 
     @staticmethod
     def _build_lookup(key, ok):
-        """Sort build keys (invalid rows to the sentinel end)."""
+        """Sort build keys (invalid rows to the sentinel end). Explicit
+        int32 iota operand: jnp.argsort would carry an int64 index
+        operand under x64, pushing the whole sort onto the TPU's
+        emulated 64-bit path."""
         sentinel = jnp.iinfo(key.dtype).max
         k = jnp.where(ok, key, sentinel)
-        order = jnp.argsort(k)
-        return jnp.take(k, order), order
+        iota = jnp.arange(k.shape[0], dtype=jnp.int32)
+        ks, order = lax.sort([k, iota], num_keys=1, is_stable=True)
+        return ks, order
 
     @staticmethod
     def _probe(ks, order, pkey, pok):
@@ -623,7 +627,7 @@ class _Trace:
             offs = jnp.cumsum(cnt)
             total = offs[-1]
             K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
-            slots = jnp.arange(K)
+            slots = jnp.arange(K, dtype=jnp.int32)
             ridx = jnp.clip(jnp.searchsorted(offs, slots, side="right"),
                             0, rctx.n - 1)
             prev = jnp.where(ridx > 0, jnp.take(offs, ridx - 1), 0)
@@ -685,8 +689,8 @@ class _Trace:
         if lctx.n * rctx.n > 1 << 24:
             raise DeviceExecError(
                 f"cross join too large: {lctx.n} x {rctx.n}")
-        li = jnp.repeat(jnp.arange(lctx.n), rctx.n)
-        ri = jnp.tile(jnp.arange(rctx.n), lctx.n)
+        li = jnp.repeat(jnp.arange(lctx.n, dtype=jnp.int32), rctx.n)
+        ri = jnp.tile(jnp.arange(rctx.n, dtype=jnp.int32), lctx.n)
         out = lctx.gather(li).merge(rctx.gather(ri))
         out.row = jnp.take(lctx.row, li) & jnp.take(rctx.row, ri)
         if node.residual is not None:
@@ -765,10 +769,10 @@ class _Trace:
         perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
         G = self._group_capacity(ctx.n, keyvals)
         gid = jnp.minimum(gid, G - 1)
-        out_row = jnp.arange(G) < ngroups
+        out_row = jnp.arange(G, dtype=jnp.int32) < ngroups
         out = DCtx(G, out_row)
         # representative (first) sorted position per group
-        iota = jnp.arange(ctx.n)
+        iota = jnp.arange(ctx.n, dtype=jnp.int32)
         starts = jax.ops.segment_min(
             jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
             indices_are_sorted=True)
@@ -842,11 +846,11 @@ class _Trace:
                                jnp.zeros((), dtype=arr.dtype))
             ops.append(filled)
             key_ops.append(len(ops) - 1)
-        ops.append(jnp.arange(n))
+        ops.append(jnp.arange(n, dtype=jnp.int32))
         sorted_ops = lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
         perm = sorted_ops[-1]
         present_s = jnp.take(ctx.row, perm)
-        iota = jnp.arange(n)
+        iota = jnp.arange(n, dtype=jnp.int32)
         diff = jnp.zeros(n, dtype=bool).at[0].set(True)
         for i in key_ops:
             o = sorted_ops[i]
@@ -982,7 +986,11 @@ class _Trace:
         (gid, value) among valid rows."""
         dv = self.eval(spec.arg, ctx)
         n = ctx.n
-        val = dv.arr.astype(jnp.int64)
+        # narrowed when bounds fit: keeps the 5-operand sort below on
+        # the native i32 TPU sort path
+        val = _narrow_key(dv)
+        if val.dtype not in (jnp.int32, jnp.int64):
+            val = dv.arr.astype(jnp.int64)
         w0 = _ok(dv, ctx.row)
         # group id per ORIGINAL row: scatter sorted gid back through perm
         gid_orig = jnp.zeros(n, dtype=gid.dtype).at[perm].set(gid)
@@ -991,7 +999,7 @@ class _Trace:
         ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32),
                gid_orig,
                jnp.where(w0, 0, 1).astype(jnp.int32),
-               jnp.where(w0, val, 0), jnp.arange(n)]
+               jnp.where(w0, val, 0), jnp.arange(n, dtype=jnp.int32)]
         sorted_ops = lax.sort(ops, num_keys=4, is_stable=True)
         perm2 = sorted_ops[-1]
         g2 = sorted_ops[1]
@@ -1019,7 +1027,7 @@ class _Trace:
 
     def _window_col(self, spec: P.WindowSpec, ctx: DCtx) -> DVal:
         n = ctx.n
-        iota = jnp.arange(n)
+        iota = jnp.arange(n, dtype=jnp.int32)
         ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32)]
         part_ops = []
         for p in spec.partition:
@@ -1164,7 +1172,7 @@ class _Trace:
         row. 'cum' (ROWS) keeps the per-row running value."""
         if running and spec.frame is None:
             n = res.shape[0]
-            iota = jnp.arange(n)
+            iota = jnp.arange(n, dtype=jnp.int32)
             change = part_start
             for i in order_ops:
                 o = sorted_ops[i]
@@ -1203,7 +1211,7 @@ class _Trace:
             if dv.valid is not None:
                 key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
             ops.append(key)
-        ops.append(jnp.arange(n))
+        ops.append(jnp.arange(n, dtype=jnp.int32))
         sorted_ops = lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
         perm = sorted_ops[-1]
         out = ctx.gather(perm)
@@ -1214,7 +1222,7 @@ class _Trace:
         """Stable-sort present rows to the front (needed before Limit when
         the child didn't already order them)."""
         ops = [jnp.where(ctx.row, 0, 1).astype(jnp.int32),
-               jnp.arange(ctx.n)]
+               jnp.arange(ctx.n, dtype=jnp.int32)]
         sorted_ops = lax.sort(ops, num_keys=1, is_stable=True)
         perm = sorted_ops[-1]
         out = ctx.gather(perm)
@@ -1239,12 +1247,12 @@ class _Trace:
         keyvals = [ctx.cols[(b, name)] for name, _ in node.output]
         perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
         G = ctx.n
-        iota = jnp.arange(ctx.n)
+        iota = jnp.arange(ctx.n, dtype=jnp.int32)
         starts = jax.ops.segment_min(
             jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
             indices_are_sorted=True)
         starts = jnp.clip(starts, 0, ctx.n - 1)
-        out = DCtx(G, jnp.arange(G) < ngroups)
+        out = DCtx(G, jnp.arange(G, dtype=jnp.int32) < ngroups)
         for (name, _dt), kv in zip(node.output, keyvals):
             arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
             valid_g = None
@@ -1291,12 +1299,12 @@ class _Trace:
                 perm, gid, first_s, present_s, ngroups = self._group_ids(
                     out, keyvals)
                 G = out.n
-                iota = jnp.arange(G)
+                iota = jnp.arange(G, dtype=jnp.int32)
                 starts = jax.ops.segment_min(
                     jnp.where(first_s, iota, G - 1), gid, num_segments=G,
                     indices_are_sorted=True)
                 starts = jnp.clip(starts, 0, G - 1)
-                dctx = DCtx(G, jnp.arange(G) < ngroups)
+                dctx = DCtx(G, jnp.arange(G, dtype=jnp.int32) < ngroups)
                 for (name, _dt), kv in zip(node.left.output, keyvals):
                     arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
                     valid_g = None

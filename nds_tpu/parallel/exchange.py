@@ -61,8 +61,8 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     order = jnp.argsort(dest)
     dest_s = jnp.take(dest, order)
     ok_s = jnp.take(ok, order)
-    iota = jnp.arange(n)
-    first_of_dest = jnp.searchsorted(dest_s, jnp.arange(n_dev))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    first_of_dest = jnp.searchsorted(dest_s, jnp.arange(n_dev, dtype=jnp.int32))
     rank = iota - jnp.take(first_of_dest,
                            jnp.clip(dest_s, 0, n_dev - 1))
     overflow = ok_s & (rank >= bucket)
