@@ -228,3 +228,34 @@ class TestMaintenanceRun:
                 assert any("insert into" in s.lower() for s in stmts)
             else:
                 assert any("delete from" in s.lower() for s in stmts)
+
+
+def test_maintenance_functions_on_device_engine():
+    """The LF_*/DF_* refresh SQL also runs through the TPU device
+    engine (INSERT's SELECT executes on-device; DML mutation stays
+    host-side and invalidates the executor)."""
+    from nds_tpu.datagen import tpcds_refresh
+    from nds_tpu.engine.device_exec import make_device_factory
+    from nds_tpu.nds.schema import get_maintenance_schemas
+
+    schemas = get_schemas()
+    msch = get_maintenance_schemas()
+    sess = Session.for_nds(make_device_factory(),
+                           include_maintenance=True)
+    for t in ("store_sales", "store_returns", "date_dim", "item",
+              "customer", "store", "promotion", "time_dim", "reason"):
+        sess.register_table(
+            from_arrays(t, schemas[t], tpcds.gen_table(t, SF)))
+    for t in ("s_purchase", "s_purchase_lineitem", "delete",
+              "inventory_delete"):
+        sess.register_table(from_arrays(
+            t, msch[t], tpcds_refresh.gen_refresh_table(t, SF, 1)))
+    n0 = sess.tables["store_sales"].nrows
+    d1, d2, _i1, _i2 = maintenance.get_delete_date(sess)
+    qs = maintenance.get_maintenance_queries(["LF_SS", "DF_SS"])
+    maintenance.run_dm_query(sess, qs["LF_SS"])
+    n1 = sess.tables["store_sales"].nrows
+    assert n1 > n0, "device-engine LF_SS must insert rows"
+    maintenance.run_dm_query(
+        sess, maintenance.replace_date(qs["DF_SS"], d1, d2))
+    assert sess.tables["store_sales"].nrows < n1
