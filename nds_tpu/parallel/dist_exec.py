@@ -33,8 +33,10 @@ from jax.sharding import PartitionSpec as P_
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
 from nds_tpu.io.host_table import HostTable
-from nds_tpu.parallel.exchange import exchange
-from nds_tpu.parallel.mesh import DATA_AXIS, make_mesh, pad_to_multiple
+from nds_tpu.parallel.exchange import exchange, exchange_hierarchical
+from nds_tpu.parallel.mesh import (
+    DATA_AXIS, HOST_AXIS, make_mesh, pad_to_multiple,
+)
 from nds_tpu.sql import plan as P
 from nds_tpu.utils.report import TaskFailureCollector
 
@@ -71,6 +73,20 @@ class DistributedExecutor(dx.DeviceExecutor):
         super().__init__(tables)
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.n_dev = int(np.prod(self.mesh.devices.shape))
+        # 2-D (host, lane) mesh: collectives span BOTH axes; the
+        # exchange runs its hierarchical DCN-then-ICI form
+        self.mesh_2d = self.mesh.devices.ndim == 2
+        if self.mesh_2d:
+            if tuple(self.mesh.axis_names) != (HOST_AXIS, DATA_AXIS):
+                raise ValueError(
+                    f"2-D mesh axes must be ({HOST_AXIS!r}, "
+                    f"{DATA_AXIS!r}), got {self.mesh.axis_names} — "
+                    f"build it with make_multihost_mesh")
+            self.n_hosts, self.n_lanes = self.mesh.devices.shape
+            self.axes = (HOST_AXIS, DATA_AXIS)
+        else:
+            self.n_hosts, self.n_lanes = 1, self.n_dev
+            self.axes = DATA_AXIS
         self._explicit_shard = shard_tables
         self.shard_threshold = shard_threshold
         self.slack = slack
@@ -120,7 +136,7 @@ class DistributedExecutor(dx.DeviceExecutor):
             sharded_keys, repl_keys = self._split_keys(planned)
             wrapped = shard_map(
                 make(slack), mesh=self.mesh,
-                in_specs=({k: P_(DATA_AXIS) for k in sharded_keys},
+                in_specs=({k: P_(self.axes) for k in sharded_keys},
                           {k: P_() for k in repl_keys}),
                 out_specs=P_())
             return jax.jit(wrapped), sharded_keys, repl_keys
@@ -163,6 +179,7 @@ class _DistTrace(dx._Trace):
     def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float):
         super().__init__(ex, bufs, slack)
         self.n_dev = ex.n_dev
+        self.axes = ex.axes
 
     def total_overflow(self):
         if not self._overflows:
@@ -171,7 +188,7 @@ class _DistTrace(dx._Trace):
         for o in self._overflows[1:]:
             tot = tot + o.astype(jnp.int64)
         # every device sees every exchange; max across devices is enough
-        return lax.pmax(tot, DATA_AXIS)
+        return lax.pmax(tot, self.axes)
 
     # ------------------------------------------------------------- helpers
 
@@ -179,11 +196,11 @@ class _DistTrace(dx._Trace):
         if not getattr(ctx, "sharded", False):
             return ctx
         n = ctx.n * self.n_dev
-        out = DCtx(n, lax.all_gather(ctx.row, DATA_AXIS, tiled=True))
+        out = DCtx(n, lax.all_gather(ctx.row, self.axes, tiled=True))
         for k, dv in ctx.cols.items():
-            arr = lax.all_gather(dv.arr, DATA_AXIS, tiled=True)
+            arr = lax.all_gather(dv.arr, self.axes, tiled=True)
             valid = (None if dv.valid is None
-                     else lax.all_gather(dv.valid, DATA_AXIS, tiled=True))
+                     else lax.all_gather(dv.valid, self.axes, tiled=True))
             out.cols[k] = dv.with_arrays(arr, valid)
         out.sharded = False
         return out
@@ -197,8 +214,14 @@ class _DistTrace(dx._Trace):
         vmask = [v is not None for v in valids]
         payload = arrays + [v for v in valids if v is not None] + [key]
         ok = ctx.row & kok
-        outs, out_ok, n_over = exchange(payload, key, ok, self.n_dev,
-                                        self.slack)
+        if self.ex.mesh_2d:
+            outs, out_ok, n_over = exchange_hierarchical(
+                payload, key, ok, self.ex.n_hosts, self.ex.n_lanes,
+                self.slack, HOST_AXIS, DATA_AXIS,
+                key_index=len(payload) - 1)
+        else:
+            outs, out_ok, n_over = exchange(payload, key, ok,
+                                            self.n_dev, self.slack)
         self._overflows.append(n_over)
         out_arrays = outs[:len(names)]
         vout = outs[len(names):-1]
@@ -252,8 +275,11 @@ class _DistTrace(dx._Trace):
         t = self.ex.tables[node.table]
         cap = pad_to_multiple(max(t.nrows, self.n_dev), self.n_dev)
         local = cap // self.n_dev
-        gidx = (lax.axis_index(DATA_AXIS).astype(jnp.int64) * local
-                + jnp.arange(local))
+        dev_i = lax.axis_index(DATA_AXIS)
+        if self.ex.mesh_2d:
+            dev_i = (lax.axis_index(HOST_AXIS) * self.ex.n_lanes
+                     + dev_i)
+        gidx = dev_i.astype(jnp.int64) * local + jnp.arange(local)
         ctx = DCtx(local, gidx < t.nrows)
         ctx.sharded = True
         for name, _dt in node.output:
@@ -430,10 +456,10 @@ class _DistTrace(dx._Trace):
         from nds_tpu.engine.types import FloatType
         dv = self._agg_arg(spec, ctx)
         if spec.func == "count" and dv is None:
-            cnt = lax.psum(jnp.sum(ctx.row), DATA_AXIS)
+            cnt = lax.psum(jnp.sum(ctx.row), self.axes)
             return cnt.reshape(1).astype(jnp.int64), jnp.ones(1, bool), None
         w = _ok(dv, ctx.row)
-        cnt = lax.psum(jnp.sum(w), DATA_AXIS)
+        cnt = lax.psum(jnp.sum(w), self.axes)
         valid = (cnt > 0).reshape(1)
         if spec.func == "count":
             return cnt.reshape(1).astype(jnp.int64), jnp.ones(1, bool), None
@@ -442,10 +468,10 @@ class _DistTrace(dx._Trace):
                 s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.float64), 0.0))
             else:
                 s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.int64), 0))
-            return lax.psum(s, DATA_AXIS).reshape(1), valid, None
+            return lax.psum(s, self.axes).reshape(1), valid, None
         if spec.func == "avg":
             f = _to_float(dv.arr, spec.arg.dtype)
-            s = lax.psum(jnp.sum(jnp.where(w, f, 0.0)), DATA_AXIS)
+            s = lax.psum(jnp.sum(jnp.where(w, f, 0.0)), self.axes)
             return (s / jnp.maximum(cnt, 1)).reshape(1), valid, None
         if spec.func in ("min", "max"):
             isf = jnp.issubdtype(dv.arr.dtype, jnp.floating)
@@ -456,8 +482,8 @@ class _DistTrace(dx._Trace):
                 fill = I64_MAX if spec.func == "min" else I64_MIN
                 masked = jnp.where(w, dv.arr.astype(jnp.int64), fill)
             red = jnp.min(masked) if spec.func == "min" else jnp.max(masked)
-            red = (lax.pmin(red, DATA_AXIS) if spec.func == "min"
-                   else lax.pmax(red, DATA_AXIS))
+            red = (lax.pmin(red, self.axes) if spec.func == "min"
+                   else lax.pmax(red, self.axes))
             return red.reshape(1), valid, dv.sdict
         raise DeviceExecError(spec.func)
 

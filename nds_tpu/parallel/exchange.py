@@ -47,12 +47,16 @@ def exchange(arrays: list, key, ok, n_dev: int, slack: float = 2.0,
 
 
 def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
-                     slack: float = 2.0, axis: str = DATA_AXIS):
+                     slack: float = 2.0, axis: str = DATA_AXIS,
+                     bucket: int | None = None):
     """Exchange core routed by an explicit per-row destination index in
     [0, n_dev) along ``axis`` (the hierarchical DCN/ICI exchange routes
-    each stage with a different destination derivation)."""
+    each stage with a different destination derivation). ``bucket``
+    overrides the per-peer capacity — hierarchical stage 2 sizes it
+    from the LOGICAL row count, not the stage-1 padded length."""
     n = dest.shape[0]
-    bucket = max(1, int(-(-n * slack // n_dev)))
+    if bucket is None:
+        bucket = max(1, int(-(-n * slack // n_dev)))
     # dead rows get a sentinel dest PAST every real bucket so they never
     # consume rank slots (a heavily filtered shard must not overflow its
     # own bucket with corpses)
@@ -94,7 +98,8 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
 def exchange_hierarchical(arrays: list, key, ok, n_hosts: int,
                           n_lanes: int, slack: float = 2.0,
                           host_axis: str = "h",
-                          lane_axis: str = DATA_AXIS):
+                          lane_axis: str = DATA_AXIS,
+                          key_index: int | None = None):
     """Two-stage shuffle for multi-host meshes (SURVEY.md §7 hard part
     4: the ICI-instead-of-UCX deliverable at DCN scale): rows first move
     to their destination HOST over the ``host_axis`` (DCN — one
@@ -109,15 +114,28 @@ def exchange_hierarchical(arrays: list, key, ok, n_hosts: int,
     counts of both stages summed (the executor's retry-with-bigger-slack
     loop treats them uniformly).
     """
+    n = key.shape[0]
     g = (_mix64(key) % jnp.uint64(n_hosts * n_lanes)).astype(jnp.int32)
     dest_h = g // n_lanes
-    # stage 1 (DCN): deliver rows + their keys to the right host
+    # stage 1 (DCN): deliver rows to the right host. When the caller's
+    # payload already carries the key (key_index), reuse it for stage 2
+    # instead of shipping a second copy over the cross-slice link
+    payload = list(arrays)
+    appended = key_index is None
+    if appended:
+        payload = payload + [key]
+        key_index = len(payload) - 1
     outs1, ok1, over1 = exchange_by_dest(
-        list(arrays) + [key], dest_h, ok, n_hosts, slack, host_axis)
-    key1 = outs1[-1]
-    # stage 2 (ICI): recompute the lane from the carried key
+        payload, dest_h, ok, n_hosts, slack, host_axis)
+    key1 = outs1[key_index]
+    # stage 2 (ICI): recompute the lane from the carried key. Bucket is
+    # sized from the LOGICAL rows (expected ~n per device after a
+    # uniform hash), not the stage-1 padded capacity — otherwise every
+    # downstream operator pays n * slack^2
     g1 = (_mix64(key1) % jnp.uint64(n_hosts * n_lanes)).astype(jnp.int32)
     dest_d = g1 % n_lanes
+    bucket2 = max(1, int(-(-n * slack // n_lanes)))
     outs2, ok2, over2 = exchange_by_dest(
-        outs1[:-1], dest_d, ok1, n_lanes, slack, lane_axis)
+        outs1[:-1] if appended else outs1, dest_d, ok1, n_lanes, slack,
+        lane_axis, bucket=bucket2)
     return outs2, ok2, over1 + over2
