@@ -76,8 +76,13 @@ class Relation:
     binding: str
     node: P.Node
     columns: dict            # name -> DType
-    size: float = 1.0
+    size: float = 1.0        # selectivity-discounted (join ordering)
     unique_on: tuple = ()    # column names this relation is unique on
+    phys_size: float = None  # undiscounted row capacity (probe choice)
+
+    def __post_init__(self):
+        if self.phys_size is None:
+            self.phys_size = self.size
 
 
 class Scope:
@@ -747,8 +752,12 @@ class Planner:
                 self._bindings_of(ib)))
             norm.append((ba, ia, bb, ib))
         remaining = {r.binding: r for r in rels}
-        # start from the largest relation (the fact side stays the probe side)
-        start = max(rels, key=lambda r: r.size)
+        # start from the PHYSICALLY largest relation: capacities are
+        # static, so a filtered fact still occupies its full buffer —
+        # it must be the probe side (discounted size would hand the
+        # probe role to an unfiltered mid-size table and force an
+        # expanding build over the fact, q12's 2x-capacity M:N trap)
+        start = max(rels, key=lambda r: r.phys_size)
         current = start.node
         joined = {start.binding}
         del remaining[start.binding]
