@@ -246,10 +246,14 @@ class DeviceExecutor:
             bufs = self._collect_buffers(planned)
             t1 = _time.perf_counter()
             row, outs, overflow = entry["compiled"](bufs)
-            jax.block_until_ready(row)
+            # ONE device->host round trip for execution + result: a
+            # separate block_until_ready + int(overflow) + device_get
+            # costs 2-3 tunnel RTTs per query on remote-attached TPUs
+            row_h, outs_h, overflow_h = jax.device_get(
+                (row, outs, overflow))
             t2 = _time.perf_counter()
-            if int(overflow) == 0:
-                out = self._materialize(planned, row, outs,
+            if int(overflow_h) == 0:
+                out = self._materialize(planned, row_h, outs_h,
                                         entry["side"])
                 t3 = _time.perf_counter()
                 self.last_timings["execute_ms"] = (t2 - t1) * 1000
@@ -323,9 +327,9 @@ class DeviceExecutor:
     # ---------------------------------------------------------- materialize
 
     def _materialize(self, planned: P.PlannedQuery, row, outs, side):
-        # ONE batched device->host transfer for the whole result pytree:
-        # per-array np.asarray would pay a host round-trip per column,
-        # which dominates per-query time on remote-attached TPUs
+        # inputs are already host-side (execute() batches the transfer);
+        # device_get is a no-op passthrough for numpy but kept so direct
+        # callers with device arrays still work
         row, outs = jax.device_get((row, outs))
         row = np.asarray(row)
         idx = np.nonzero(row)[0]
@@ -739,16 +743,31 @@ class _Trace:
         ks = jnp.sort(jnp.where(rok, rkey, sent))
         c_all = (jnp.searchsorted(ks, lkey, side="right")
                  - jnp.searchsorted(ks, lkey, side="left"))
-        # count of right rows per (key, col)
+        # count of right rows per (key, col). The composite usually
+        # exceeds 31 bits, so sorting the PACKED key would hit the
+        # emulated s64 sort; instead sort (key, col) as a native 2-key
+        # i32 lax.sort and pack AFTER sorting (elementwise, cheap) —
+        # searchsorted still gets its 1-D total order
         la, ra, lo, hi = self._align_pair(lcol, rcol)
         w = max((hi - lo).bit_length(), 1)
-        lkey2 = ((lkey.astype(jnp.int64) << w)
-                 | jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo))
-        rkey2 = ((rkey.astype(jnp.int64) << w)
-                 | jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo))
         lok2 = _ok(lcol, lok)
         rok2 = _ok(rcol, rok)
-        ks2 = jnp.sort(jnp.where(rok2, rkey2, I64_MAX))
+        lcol_n = jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo)
+        rcol_n = jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo)
+        lkey2 = (lkey.astype(jnp.int64) << w) | lcol_n
+        k_sent = jnp.iinfo(rkey.dtype).max
+        rkey_s = jnp.where(rok2, rkey, k_sent)
+        if (rkey.dtype == jnp.int32 and hi - lo < 2**31 - 1):
+            rcol_s = jnp.where(rok2, rcol_n.astype(jnp.int32),
+                               jnp.int32(2**31 - 1))
+            sk, sc = lax.sort([rkey_s, rcol_s], num_keys=2,
+                              is_stable=False)
+            ks2 = jnp.where(
+                sk == k_sent, I64_MAX,
+                (sk.astype(jnp.int64) << w) | sc.astype(jnp.int64))
+        else:
+            rkey2 = (rkey_s.astype(jnp.int64) << w) | rcol_n
+            ks2 = jnp.sort(jnp.where(rok2, rkey2, I64_MAX))
         c_same = (jnp.searchsorted(ks2, lkey2, side="right")
                   - jnp.searchsorted(ks2, lkey2, side="left"))
         return lok & lok2 & ((c_all - c_same) > 0)

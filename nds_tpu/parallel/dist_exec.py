@@ -166,8 +166,11 @@ class DistributedExecutor(dx.DeviceExecutor):
             shard_bufs = {k: bufs[k] for k in state["sk"]}
             repl_bufs = {k: bufs[k] for k in state["rk"]}
             row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
-            if int(overflow) == 0:
-                return self._materialize(planned, row, outs, side)
+            # one batched device->host round trip (see DeviceExecutor)
+            row_h, outs_h, overflow_h = jax.device_get(
+                (row, outs, overflow))
+            if int(overflow_h) == 0:
+                return self._materialize(planned, row_h, outs_h, side)
             TaskFailureCollector.notify(
                 f"exchange overflow ({int(overflow)} rows) at slack="
                 f"{slack}; retrying with slack={slack * 2}")
