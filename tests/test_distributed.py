@@ -229,3 +229,27 @@ def test_hierarchical_exchange_dcn_ici():
     for k in np.unique(ko[oko]):
         devs = {i for i in range(H * D) if (ko[i][oko[i]] == k).any()}
         assert len(devs) == 1, f"key {k} split across devices {devs}"
+
+
+MULTIHOST_QUERIES = [1, 3, 5, 13, 16, 18]
+
+
+@pytest.fixture(scope="module")
+def multihost_session(raw):
+    """Executor over a 2x4 (host, lane) mesh: collectives span both
+    axes, the exchange runs its hierarchical DCN-then-ICI form."""
+    from nds_tpu.parallel.mesh import make_multihost_mesh
+    schemas = get_schemas()
+    sess = Session.for_nds_h(make_distributed_factory(
+        mesh=make_multihost_mesh(2, 4), shard_threshold=THRESHOLD))
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    return sess
+
+
+@pytest.mark.parametrize("qn", MULTIHOST_QUERIES)
+def test_multihost_mesh_matches_oracle(qn, cpu_session,
+                                       multihost_session):
+    exp = run_query(cpu_session, qn).to_pandas()
+    got = run_query(multihost_session, qn).to_pandas()
+    assert_frames_close(got, exp, f"2d-{qn}")
