@@ -1,15 +1,19 @@
-"""Round benchmark: NDS-H power run, TPU engine vs CPU oracle.
+"""Round benchmark: NDS-H (22 queries) + NDS (99 queries) power runs,
+TPU engine vs CPU oracle.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (last
-line of stdout).
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} as the
+LAST line of stdout (the driver's contract). That line is the combined
+two-leg power total; per-leg metrics (`nds_h_sf*_power_total`,
+`nds_sf*_power_total`) are carried in its "legs" object and are also
+printed as standalone partial lines while each leg runs, so a timeout
+mid-run still leaves the best-known metric on stdout.
 
 Methodology follows the reference power run (bracketed wall-clock around
-execute+collect per query, `nds/PysparkBenchReport.py:87-105`): each of
-the 22 qualification queries compiles once (untimed, AOT — the
-reference's warmed-JVM analog), then runs timed on the JAX device engine
-(real TPU chip when available), then on the CPU oracle as the baseline —
-the reference publishes no numbers (BASELINE.md), so CPU wall-clock is
-the denominator.
+execute+collect per query, `nds/PysparkBenchReport.py:87-105`): each
+query compiles once untimed (AOT — the reference's warmed-JVM analog),
+then runs timed on the JAX device engine (real TPU chip when available),
+then on the CPU oracle as the baseline — the reference publishes no
+numbers (BASELINE.md), so CPU wall-clock is the denominator.
 
 Budget-robust by design (a timeout must still yield a metric):
 - generated data persists under .bench_data/ and reloads on re-runs;
@@ -31,38 +35,63 @@ import signal
 import sys
 import time
 
-# SF0.3 balances signal vs budget: large enough that device compute
-# dominates the per-query tunnel RTT floor (~0.3s), small enough that
-# the CPU-oracle denominator still finishes within the driver budget;
-# data (.bench_data/) and XLA executables (.xla_cache/) persist across
-# runs, so the driver's timed run skips datagen and compiles
-SF = float(os.environ.get("BENCH_SF", "0.3"))
+# Scale factors balance signal vs budget: large enough that device
+# compute dominates the per-query tunnel RTT floor, small enough that
+# the CPU-oracle denominator finishes within the driver budget; data
+# (.bench_data/) and XLA executables (.xla_cache/) persist across runs,
+# so the driver's timed run skips datagen and compiles
+SF_H = float(os.environ.get("BENCH_SF", "0.3"))
+SF_DS = float(os.environ.get("BENCH_NDS_SF", "0.1"))
 HERE = os.path.dirname(os.path.abspath(__file__))
-DATA_DIR = os.environ.get(
-    "BENCH_DATA", os.path.join(HERE, ".bench_data", f"sf{SF:g}"))
+DATA_ROOT = os.environ.get("BENCH_DATA", os.path.join(HERE, ".bench_data"))
+# which legs run (comma list); the NDS-H leg runs first so a budget
+# kill still records the historical headline metric
+LEGS = os.environ.get("BENCH_LEGS", "nds_h,nds").split(",")
 
-# banked per-query results: qn -> {"device_s": float, "cpu_s": float}
-BANK: dict[int, dict] = {}
+# banked per-query results: (leg, qname) -> {"device_s": .., "cpu_s": ..}
+BANK: dict[tuple, dict] = {}
+LEG_TOTALS: dict[str, int] = {}  # leg -> queries_total
 _done = False
 
 
-def _partial_line() -> str:
-    """The running metric over completed queries. Printed after EVERY
-    query (last line of stdout wins), so a hard kill mid-compile — where
-    the SIGTERM handler can be deferred inside XLA C++ — still leaves a
-    parseable metric on stdout."""
-    paired = {qn: r for qn, r in BANK.items()
-              if "device_s" in r and "cpu_s" in r}
-    dev_total = sum(r["device_s"] for r in paired.values())
-    cpu_total = sum(r["cpu_s"] for r in paired.values())
-    return json.dumps({
-        "metric": f"nds_h_sf{SF:g}_power_total",
-        "value": round(dev_total, 4),
+def _leg_line(leg: str, metric: str) -> dict:
+    paired = {k: r for k, r in BANK.items()
+              if k[0] == leg and "device_s" in r and "cpu_s" in r}
+    dev = sum(r["device_s"] for r in paired.values())
+    cpu = sum(r["cpu_s"] for r in paired.values())
+    return {
+        "metric": metric,
+        "value": round(dev, 4),
         "unit": "s",
-        "vs_baseline": (round(cpu_total / dev_total, 4)
-                        if dev_total else 0.0),
+        "vs_baseline": round(cpu / dev, 4) if dev else 0.0,
         "queries_completed": len(paired),
-        "queries_total": 22,
+        "queries_total": LEG_TOTALS.get(leg, 0),
+    }
+
+
+def _metric_name(leg: str) -> str:
+    return (f"nds_h_sf{SF_H:g}_power_total" if leg == "nds_h"
+            else f"nds_sf{SF_DS:g}_power_total")
+
+
+def _combined_line() -> str:
+    legs = {}
+    dev = cpu = completed = total = 0
+    for leg in LEGS:
+        line = _leg_line(leg, _metric_name(leg))
+        legs[_metric_name(leg)] = line
+        dev += line["value"]
+        cpu += line["value"] * line["vs_baseline"]
+        completed += line["queries_completed"]
+        total += line["queries_total"]
+    return json.dumps({
+        "metric": "nds+nds_h_power_total",
+        "value": round(dev, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu / dev, 4) if dev else 0.0,
+        "queries_completed": completed,
+        "queries_total": total,
+        "legs": legs,
     })
 
 
@@ -71,7 +100,7 @@ def _emit_final() -> None:
     if _done:
         return
     _done = True
-    print(_partial_line(), flush=True)
+    print(_combined_line(), flush=True)
 
 
 def _on_term(signum, frame):
@@ -81,31 +110,100 @@ def _on_term(signum, frame):
     sys.exit(0)
 
 
-def _load_or_gen_data():
-    from nds_tpu.datagen import tpch
+def _load_or_gen(leg: str):
     from nds_tpu.io import table_cache
     from nds_tpu.io.host_table import from_arrays
-    from nds_tpu.nds_h.schema import get_schemas
+    if leg == "nds_h":
+        from nds_tpu.datagen import tpch as gen
+        from nds_tpu.nds_h.schema import get_schemas
+        sf = SF_H
+    else:
+        from nds_tpu.datagen import tpcds as gen
+        from nds_tpu.nds.schema import get_schemas
+        sf = SF_DS
     schemas = get_schemas()
-    cached = table_cache.load_tables(DATA_DIR, schemas)
+    data_dir = os.path.join(DATA_ROOT, f"{leg}_sf{sf:g}")
+    # legacy layout from earlier rounds (nds_h only, no leg prefix)
+    legacy = os.path.join(DATA_ROOT, f"sf{sf:g}")
+    if leg == "nds_h" and not os.path.isdir(data_dir) \
+            and os.path.isdir(legacy):
+        data_dir = legacy
+    cached = table_cache.load_tables(data_dir, schemas)
     if cached is not None:
-        print(f"[bench] loaded SF{SF:g} data from {DATA_DIR}",
+        print(f"[bench] {leg}: loaded SF{sf:g} data from {data_dir}",
               file=sys.stderr, flush=True)
         return cached
-    print(f"[bench] generating SF{SF:g} data...", file=sys.stderr,
+    print(f"[bench] {leg}: generating SF{sf:g} data...", file=sys.stderr,
           flush=True)
-    tables = {t: from_arrays(t, schemas[t], tpch.gen_table(t, SF))
+    tables = {t: from_arrays(t, schemas[t], gen.gen_table(t, sf))
               for t in schemas}
-    table_cache.save_tables(DATA_DIR, tables)
+    table_cache.save_tables(data_dir, tables)
     return tables
 
 
-def _run_query(session, qn: int, sql: str) -> float:
-    from nds_tpu.nds_h.streams import statements
+def _statements(leg: str, qn: int, sql: str) -> list[str]:
+    if leg == "nds_h":
+        from nds_tpu.nds_h.streams import statements
+        return list(statements(qn, sql))
+    return [s.strip() for s in sql.split(";") if s.strip()]
+
+
+def _run_query(session, stmts: list[str]) -> float:
     t0 = time.perf_counter()
-    for s in statements(qn, sql):
+    for s in stmts:
         session.sql(s)
     return time.perf_counter() - t0
+
+
+def _run_leg(leg: str) -> None:
+    from nds_tpu.engine.device_exec import make_device_factory
+    from nds_tpu.engine.session import Session
+
+    if leg == "nds_h":
+        from nds_tpu.nds_h import streams
+        qids = list(range(1, 23))
+        mk = Session.for_nds_h
+    else:
+        from nds_tpu.nds import streams
+        qids = streams.available_templates()
+        mk = Session.for_nds
+
+    tables = _load_or_gen(leg)
+    dev = mk(make_device_factory())
+    cpu = mk()
+    for t in tables.values():
+        dev.register_table(t)
+        cpu.register_table(t)
+
+    for qn in qids:
+        # one broken query must not cost the rest of the run (the
+        # reference's --allow_failure mode, `nds/nds_power.py:391-393`)
+        try:
+            sql = streams.render_query(qn)
+            stmts = _statements(leg, qn, sql)
+            # untimed warmup: AOT compile + one execution per statement
+            for s in stmts:
+                dev.sql(s)
+            dev_s = _run_query(dev, stmts)
+            BANK.setdefault((leg, qn), {})["device_s"] = dev_s
+            # engine-side perf accounting (compile/execute/materialize)
+            dev_ex = dev._executor_factory(dev.tables)
+            tm = dict(dev_ex.last_timings)
+            cpu_s = _run_query(cpu, stmts)
+            BANK[(leg, qn)]["cpu_s"] = cpu_s
+        except Exception as exc:  # noqa: BLE001
+            BANK.pop((leg, qn), None)
+            print(f"[bench] {leg} q{qn}: FAILED {type(exc).__name__}: "
+                  f"{exc}", file=sys.stderr, flush=True)
+            continue
+        print(f"[bench] {leg} q{qn}: tpu {dev_s*1000:.0f} ms "
+              f"(exec {tm.get('execute_ms', 0):.0f} "
+              f"mat {tm.get('materialize_ms', 0):.0f}) | "
+              f"cpu {cpu_s*1000:.0f} ms", file=sys.stderr, flush=True)
+        # the full combined partial (not a leg-scoped line): a hard kill
+        # can defer the SIGTERM handler inside XLA C++, so the last
+        # printed line must already carry every completed leg
+        print(_combined_line(), flush=True)
 
 
 def main() -> None:
@@ -116,41 +214,22 @@ def main() -> None:
     cache_dir = enable_xla_cache()
     print(f"[bench] xla cache: {cache_dir}", file=sys.stderr, flush=True)
 
-    from nds_tpu.engine.device_exec import make_device_factory
-    from nds_tpu.engine.session import Session
-    from nds_tpu.nds_h import streams
-
-    tables = _load_or_gen_data()
-
     import jax
     print(f"[bench] backend: {jax.default_backend()} {jax.devices()}",
           file=sys.stderr, flush=True)
 
-    dev = Session.for_nds_h(make_device_factory())
-    cpu = Session.for_nds_h()
-    for t in tables.values():
-        dev.register_table(t)
-        cpu.register_table(t)
+    # totals for EVERY leg up front: a kill before a leg starts must
+    # still count its queries in queries_total (else a 22/22 nds_h-only
+    # partial reads as a complete 121-query run)
+    for leg in LEGS:
+        if leg == "nds_h":
+            LEG_TOTALS[leg] = 22
+        else:
+            from nds_tpu.nds import streams as nds_streams
+            LEG_TOTALS[leg] = len(nds_streams.available_templates())
 
-    dev_ex = None
-    for qn in range(1, 23):
-        sql = streams.render_query(qn)
-        # untimed warmup: AOT compile + one execution per statement
-        for s in streams.statements(qn, sql):
-            dev.sql(s)
-        dev_s = _run_query(dev, qn, sql)
-        BANK.setdefault(qn, {})["device_s"] = dev_s
-        # engine-side perf accounting (compile vs execute vs materialize)
-        if dev_ex is None:
-            dev_ex = dev._executor_factory(dev.tables)
-        tm = dict(dev_ex.last_timings)
-        cpu_s = _run_query(cpu, qn, sql)
-        BANK[qn]["cpu_s"] = cpu_s
-        print(f"[bench] q{qn}: tpu {dev_s*1000:.0f} ms "
-              f"(exec {tm.get('execute_ms', 0):.0f} "
-              f"mat {tm.get('materialize_ms', 0):.0f}) | "
-              f"cpu {cpu_s*1000:.0f} ms", file=sys.stderr, flush=True)
-        print(_partial_line(), flush=True)
+    for leg in LEGS:
+        _run_leg(leg)
 
     _emit_final()
 

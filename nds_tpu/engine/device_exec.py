@@ -150,6 +150,15 @@ def _and_valid(a, b):
     return a & b
 
 
+def _ss(ks, q, side="left"):
+    """searchsorted via the sort method. XLA lowers the default binary
+    search as ~log2(n) serial gather passes over the full array — ~200ms
+    (i32) to ~800ms (i64) per call at 1.8M rows on TPU, measured. One
+    native sort of the concatenation is ~10ms, so every probe-scale
+    searchsorted in the engine goes through here."""
+    return jnp.searchsorted(ks, q, side=side, method="sort")
+
+
 def _seg_scan(op, vals, flags):
     """Segmented inclusive scan: restart `op` accumulation at every True
     flag. Classic (value, reset-flag) associative combiner — O(n log n)
@@ -537,7 +546,7 @@ class _Trace:
     @staticmethod
     def _probe(ks, order, pkey, pok):
         n = ks.shape[0]
-        pos = jnp.clip(jnp.searchsorted(ks, pkey), 0, n - 1)
+        pos = jnp.clip(_ss(ks, pkey), 0, n - 1)
         hit = (jnp.take(ks, pos) == pkey) & pok
         return jnp.take(order, pos), hit
 
@@ -625,8 +634,8 @@ class _Trace:
             # slack — the static-shape answer to data-dependent join
             # cardinality (SURVEY §7 hard part 2)
             ks, order = self._build_lookup(lkey, lok)
-            lo = jnp.searchsorted(ks, rkey, side="left")
-            hi = jnp.searchsorted(ks, rkey, side="right")
+            lo = _ss(ks, rkey, side="left")
+            hi = _ss(ks, rkey, side="right")
             cnt = jnp.where(rok, hi - lo, 0).astype(jnp.int64)
             offs = jnp.cumsum(cnt)
             total = offs[-1]
@@ -723,8 +732,11 @@ class _Trace:
     def _exists_with_residual(self, node, lctx, rctx, lkey, lok, rkey, rok):
         """EXISTS with a cross-side residual of the q21 shape
         `r.col <> l.col`: exists a right row with the key and a DIFFERENT
-        col value  <=>  count(key) > count(key, col == l.col). Both counts
-        come from sorted-key range queries — no row expansion."""
+        (non-NULL) col value  <=>  the per-key [min, max] of col over
+        right rows is not exactly [l.col, l.col]. One 2-key native sort
+        of (key, col) makes col sorted within each key run, so min/max
+        are gathers at the run's ends — no row expansion, no packed-int64
+        keys, no emulated 64-bit sorts or searches."""
         e = node.residual
         if not (isinstance(e, ir.Cmp) and e.op == "<>"):
             raise DeviceExecError(
@@ -738,39 +750,29 @@ class _Trace:
             raise DeviceExecError("residual does not split by side")
         lcol = self.eval(l_ir, lctx)
         rcol = self.eval(r_ir, rctx)
-        # count of right rows per key
-        sent = jnp.iinfo(rkey.dtype).max
-        ks = jnp.sort(jnp.where(rok, rkey, sent))
-        c_all = (jnp.searchsorted(ks, lkey, side="right")
-                 - jnp.searchsorted(ks, lkey, side="left"))
-        # count of right rows per (key, col). The composite usually
-        # exceeds 31 bits, so sorting the PACKED key would hit the
-        # emulated s64 sort; instead sort (key, col) as a native 2-key
-        # i32 lax.sort and pack AFTER sorting (elementwise, cheap) —
-        # searchsorted still gets its 1-D total order
         la, ra, lo, hi = self._align_pair(lcol, rcol)
-        w = max((hi - lo).bit_length(), 1)
         lok2 = _ok(lcol, lok)
+        # rows whose col is NULL can never satisfy `<>` — exclude them
+        # from the build entirely (the count-difference formulation this
+        # replaces over-counted such rows)
         rok2 = _ok(rcol, rok)
-        lcol_n = jnp.clip(la.astype(jnp.int64) - lo, 0, hi - lo)
-        rcol_n = jnp.clip(ra.astype(jnp.int64) - lo, 0, hi - lo)
-        lkey2 = (lkey.astype(jnp.int64) << w) | lcol_n
         k_sent = jnp.iinfo(rkey.dtype).max
         rkey_s = jnp.where(rok2, rkey, k_sent)
-        if (rkey.dtype == jnp.int32 and hi - lo < 2**31 - 1):
-            rcol_s = jnp.where(rok2, rcol_n.astype(jnp.int32),
-                               jnp.int32(2**31 - 1))
-            sk, sc = lax.sort([rkey_s, rcol_s], num_keys=2,
-                              is_stable=False)
-            ks2 = jnp.where(
-                sk == k_sent, I64_MAX,
-                (sk.astype(jnp.int64) << w) | sc.astype(jnp.int64))
-        else:
-            rkey2 = (rkey_s.astype(jnp.int64) << w) | rcol_n
-            ks2 = jnp.sort(jnp.where(rok2, rkey2, I64_MAX))
-        c_same = (jnp.searchsorted(ks2, lkey2, side="right")
-                  - jnp.searchsorted(ks2, lkey2, side="left"))
-        return lok & lok2 & ((c_all - c_same) > 0)
+        rcol_n = ra
+        lcol_n = la
+        if (rkey.dtype == jnp.int32 and -2**31 < lo
+                and hi < 2**31 - 1):
+            rcol_n = ra.astype(jnp.int32)
+            lcol_n = la.astype(jnp.int32)
+        sk, sc = lax.sort([rkey_s, rcol_n], num_keys=2, is_stable=False)
+        pos_l = _ss(sk, lkey, side="left")
+        pos_r = _ss(sk, lkey, side="right")
+        n = sk.shape[0]
+        cmin = jnp.take(sc, jnp.clip(pos_l, 0, n - 1))
+        cmax = jnp.take(sc, jnp.clip(pos_r - 1, 0, n - 1))
+        has_key = pos_r > pos_l
+        differs = (cmin != lcol_n) | (cmax != lcol_n)
+        return lok & lok2 & has_key & differs
 
     # --------------------------------------------------------- aggregation
 
@@ -790,12 +792,10 @@ class _Trace:
         gid = jnp.minimum(gid, G - 1)
         out_row = jnp.arange(G, dtype=jnp.int32) < ngroups
         out = DCtx(G, out_row)
-        # representative (first) sorted position per group
-        iota = jnp.arange(ctx.n, dtype=jnp.int32)
-        starts = jax.ops.segment_min(
-            jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
-            indices_are_sorted=True)
-        starts = jnp.clip(starts, 0, ctx.n - 1)
+        # first sorted position per group (n for empty groups): gid is
+        # sorted, so this is a sorted search, not a segment_min scatter
+        starts2 = _ss(gid, jnp.arange(G, dtype=gid.dtype))
+        starts = jnp.clip(starts2, 0, ctx.n - 1)
         for (kname, _kexpr), kv in zip(node.group_keys, keyvals):
             arr_s = jnp.take(kv.arr, perm)
             arr_g = jnp.take(arr_s, starts)
@@ -805,10 +805,36 @@ class _Trace:
             out.cols[(b, kname)] = kv.with_arrays(arr_g, valid_g)
         for name, spec in node.aggs:
             arr, valid, sdict = self._agg_grouped(
-                spec, ctx, perm, gid, present_s, G)
+                spec, ctx, perm, gid, present_s, G, starts2)
             lo, hi = self._agg_bounds(spec, ctx)
             out.cols[(b, name)] = DVal(arr, valid, sdict, lo, hi)
         return out
+
+    @staticmethod
+    def _seg_sum(data, starts2, G):
+        """Per-segment sum over the SORTED row space via inclusive-cumsum
+        differences. segment_sum lowers to scatter-add (~160ms for i64 at
+        1.8M rows on TPU, measured); cumsum runs at memory speed.
+        starts2[g] = first sorted row of group g, n for empty groups;
+        rows outside any real group must carry data == 0.
+
+        Integer sums stay exact. Float sums pick up cancellation error
+        bounded by ulp(global prefix): at SF100 scale (6e8 rows of ~1e9
+        squared values) that is ~512 absolute against per-group sums of
+        ~1e10+ — orders below the benchmark's float validation epsilon
+        (`utils/validate_core.py`), and float aggregation order is
+        already unspecified (the reference gates it behind
+        `spark.rapids.sql.variableFloatAgg.enabled`)."""
+        n = data.shape[0]
+        csum = jnp.cumsum(data)
+        nxt = jnp.concatenate(
+            [starts2[1:], jnp.full((1,), n, starts2.dtype)])
+        end = jnp.clip(nxt - 1, 0, n - 1)
+        hi = jnp.take(csum, end)
+        lo = jnp.where(starts2 > 0,
+                       jnp.take(csum, jnp.clip(starts2 - 1, 0, n - 1)),
+                       jnp.zeros((), csum.dtype))
+        return hi - lo
 
     def _agg_bounds(self, spec: P.AggSpec, ctx: DCtx):
         """Host-known value bounds of an aggregate output (lets downstream
@@ -939,22 +965,22 @@ class _Trace:
         raise DeviceExecError(spec.func)
 
     def _agg_grouped(self, spec: P.AggSpec, ctx: DCtx, perm, gid,
-                     present_s, G):
+                     present_s, G, starts2):
         dv = self._agg_arg(spec, ctx)
         if spec.func == "count" and spec.distinct:
             return self._count_distinct_grouped(
                 spec, ctx, perm, gid, present_s, G)
         if dv is None:  # count(*)
-            cnt = jax.ops.segment_sum(
-                present_s.astype(jnp.int64), gid, num_segments=G,
-                indices_are_sorted=True)
+            cnt = self._seg_sum(present_s.astype(jnp.int32), starts2,
+                                G).astype(jnp.int64)
             return cnt, None, None
         arr_s = jnp.take(dv.arr, perm)
         w = present_s
         if dv.valid is not None:
             w = w & jnp.take(dv.valid, perm)
-        cnt = jax.ops.segment_sum(w.astype(jnp.int64), gid, num_segments=G,
-                                  indices_are_sorted=True)
+        # counts fit int32 (<= capacity); widen only the G-sized result
+        cnt = self._seg_sum(w.astype(jnp.int32), starts2,
+                            G).astype(jnp.int64)
         if spec.func == "count":
             return cnt, None, None
         valid = cnt > 0
@@ -963,12 +989,10 @@ class _Trace:
                 data = jnp.where(w, arr_s.astype(jnp.float64), 0.0)
             else:
                 data = jnp.where(w, arr_s.astype(jnp.int64), 0)
-            return jax.ops.segment_sum(data, gid, num_segments=G,
-                                       indices_are_sorted=True), valid, None
+            return self._seg_sum(data, starts2, G), valid, None
         if spec.func == "avg":
             f = _to_float(arr_s, spec.arg.dtype)
-            s = jax.ops.segment_sum(jnp.where(w, f, 0.0), gid,
-                                    num_segments=G, indices_are_sorted=True)
+            s = self._seg_sum(jnp.where(w, f, 0.0), starts2, G)
             return s / jnp.maximum(cnt, 1).astype(jnp.float64), valid, None
         if spec.func in ("min", "max"):
             isf = jnp.issubdtype(arr_s.dtype, jnp.floating)
@@ -976,23 +1000,28 @@ class _Trace:
                 fill = jnp.inf if spec.func == "min" else -jnp.inf
                 data = jnp.where(w, arr_s, fill)
             else:
-                fill = I64_MAX if spec.func == "min" else I64_MIN
-                data = jnp.where(w, arr_s.astype(jnp.int64), fill)
+                # stay int32 when host bounds allow: segment_min/max
+                # scatter i64 is emulated on TPU
+                arr_i = arr_s.astype(jnp.int64)
+                if (dv.lo is not None and dv.hi is not None
+                        and -2**31 < dv.lo and dv.hi < 2**31 - 1):
+                    arr_i = arr_s.astype(jnp.int32)
+                fill = (jnp.iinfo(arr_i.dtype).max if spec.func == "min"
+                        else jnp.iinfo(arr_i.dtype).min)
+                data = jnp.where(w, arr_i, fill)
             seg = (jax.ops.segment_min if spec.func == "min"
                    else jax.ops.segment_max)
             red = seg(data, gid, num_segments=G, indices_are_sorted=True)
             if not isf and not isinstance(spec.dtype,
                                           (FloatType, DecimalType)):
                 red = red.astype(arr_s.dtype)
+            elif not isf:
+                red = red.astype(jnp.int64)
             return red, valid, dv.sdict
         if spec.func in ("stddev_samp", "stddev"):
             f = _to_float(arr_s, spec.arg.dtype)
-            s1 = jax.ops.segment_sum(jnp.where(w, f, 0.0), gid,
-                                     num_segments=G,
-                                     indices_are_sorted=True)
-            s2 = jax.ops.segment_sum(jnp.where(w, f * f, 0.0), gid,
-                                     num_segments=G,
-                                     indices_are_sorted=True)
+            s1 = self._seg_sum(jnp.where(w, f, 0.0), starts2, G)
+            s2 = self._seg_sum(jnp.where(w, f * f, 0.0), starts2, G)
             c = cnt.astype(jnp.float64)
             var = (s2 - s1 * s1 / jnp.maximum(c, 1)) / jnp.maximum(
                 c - 1, 1)
@@ -1027,7 +1056,9 @@ class _Trace:
         newpair = jnp.concatenate(
             [jnp.ones(1, bool), (g2[1:] != g2[:-1]) | (v2[1:] != v2[:-1])])
         flag = w2 & newpair
-        cnt = jax.ops.segment_sum(flag.astype(jnp.int64), g2, num_segments=G)
+        starts2 = _ss(g2, jnp.arange(G, dtype=g2.dtype))
+        cnt = self._seg_sum(flag.astype(jnp.int32), starts2,
+                            G).astype(jnp.int64)
         return cnt, None, None
 
     # ------------------------------------------------------------- windows
@@ -1134,33 +1165,46 @@ class _Trace:
         else:
             vals = vals.astype(jnp.int64)
         G = n
+        # per-row partition total, scatter-free: inclusive cumsum
+        # differenced at the partition's bounding rows (start_pos is the
+        # running partition start; the next start comes from a reversed
+        # cummin). segment_sum over n segments is a scatter — emulated
+        # and slow for 64-bit operands on TPU.
+        nstart = jnp.where(part_start, iota, n)
+        nxt = jnp.concatenate(
+            [lax.cummin(nstart, reverse=True)[1:],
+             jnp.full((1,), n, jnp.int32)])
+        pend = jnp.clip(nxt - 1, 0, n - 1)
+
+        def part_total(data):
+            csum = jnp.cumsum(data)
+            hi = jnp.take(csum, pend)
+            lo = jnp.where(start_pos > 0,
+                           jnp.take(csum, jnp.clip(start_pos - 1, 0, n - 1)),
+                           jnp.zeros((), csum.dtype))
+            return hi - lo
+
         if spec.func == "count":
-            src = w.astype(jnp.int64)
+            src = w.astype(jnp.int32)
             if running:
                 res = _seg_scan(lambda a, b: a + b, src, part_start)
             else:
-                tot = jax.ops.segment_sum(src, pid, num_segments=G,
-                                          indices_are_sorted=True)
-                res = jnp.take(tot, pid)
+                res = part_total(src)
             return self._window_range_fix(
-                spec, scatter, res, None, part_start, order_ops,
-                sorted_ops, pid, running)
-        cnt_src = w.astype(jnp.int64)
+                spec, scatter, res.astype(jnp.int64), None, part_start,
+                order_ops, sorted_ops, pid, running)
+        cnt_src = w.astype(jnp.int32)
         if running:
             cnt = _seg_scan(lambda a, b: a + b, cnt_src, part_start)
         else:
-            tot = jax.ops.segment_sum(cnt_src, pid, num_segments=G,
-                                      indices_are_sorted=True)
-            cnt = jnp.take(tot, pid)
+            cnt = part_total(cnt_src)
         valid = cnt > 0
         if spec.func in ("sum", "avg"):
             data = jnp.where(w, vals, jnp.zeros((), vals.dtype))
             if running:
                 res = _seg_scan(lambda a, b: a + b, data, part_start)
             else:
-                tot = jax.ops.segment_sum(data, pid, num_segments=G,
-                                          indices_are_sorted=True)
-                res = jnp.take(tot, pid)
+                res = part_total(data)
             if spec.func == "avg":
                 res = res.astype(jnp.float64) / jnp.maximum(cnt, 1)
         elif spec.func in ("min", "max"):
@@ -1266,11 +1310,8 @@ class _Trace:
         keyvals = [ctx.cols[(b, name)] for name, _ in node.output]
         perm, gid, first_s, present_s, ngroups = self._group_ids(ctx, keyvals)
         G = ctx.n
-        iota = jnp.arange(ctx.n, dtype=jnp.int32)
-        starts = jax.ops.segment_min(
-            jnp.where(first_s, iota, ctx.n - 1), gid, num_segments=G,
-            indices_are_sorted=True)
-        starts = jnp.clip(starts, 0, ctx.n - 1)
+        starts = jnp.clip(_ss(gid, jnp.arange(G, dtype=gid.dtype)),
+                          0, ctx.n - 1)
         out = DCtx(G, jnp.arange(G, dtype=jnp.int32) < ngroups)
         for (name, _dt), kv in zip(node.output, keyvals):
             arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
@@ -1318,11 +1359,8 @@ class _Trace:
                 perm, gid, first_s, present_s, ngroups = self._group_ids(
                     out, keyvals)
                 G = out.n
-                iota = jnp.arange(G, dtype=jnp.int32)
-                starts = jax.ops.segment_min(
-                    jnp.where(first_s, iota, G - 1), gid, num_segments=G,
-                    indices_are_sorted=True)
-                starts = jnp.clip(starts, 0, G - 1)
+                starts = jnp.clip(_ss(gid, jnp.arange(G, dtype=gid.dtype)),
+                                  0, G - 1)
                 dctx = DCtx(G, jnp.arange(G, dtype=jnp.int32) < ngroups)
                 for (name, _dt), kv in zip(node.left.output, keyvals):
                     arr_g = jnp.take(jnp.take(kv.arr, perm), starts)
@@ -1364,7 +1402,7 @@ class _Trace:
             lkey = (lkey << w) | ln
             rkey = (rkey << w) | rn
         ks = jnp.sort(jnp.where(rctx.row, rkey, I64_MAX))
-        pos = jnp.clip(jnp.searchsorted(ks, lkey), 0, rctx.n - 1)
+        pos = jnp.clip(_ss(ks, lkey), 0, rctx.n - 1)
         hit = jnp.take(ks, pos) == lkey
         keep = hit if node.kind == "intersect" else ~hit
         out = DCtx(lctx.n, lctx.row & keep)
