@@ -1,0 +1,72 @@
+"""Micro-bench engine primitive patterns at q21 scale on the chip.
+
+block_until_ready is a no-op over the axon tunnel; every timed iteration
+ends with a device_get of a scalar reduction to force completion. The
+'noop' row measures the RTT floor to subtract.
+"""
+import sys
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1_800_000
+rng = np.random.default_rng(0)
+keys32 = jnp.asarray(rng.integers(0, 450_000, N, dtype=np.int32))
+keys64 = keys32.astype(jnp.int64)
+probe32 = jnp.asarray(rng.integers(0, 450_000, N, dtype=np.int32))
+probe64 = probe32.astype(jnp.int64)
+iota32 = jnp.arange(N, dtype=jnp.int32)
+idx = probe32 % N
+
+
+def bench(name, fn, *args):
+    # reduce result(s) to one scalar inside the jit so the device_get
+    # transfer is tiny; the get forces execution over the tunnel
+    def wrapped(*a):
+        r = fn(*a)
+        leaves = jax.tree_util.tree_leaves(r)
+        acc = jnp.zeros((), jnp.int64)
+        for x in leaves:
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                acc = acc + jnp.sum(x).astype(jnp.int64)
+            else:
+                acc = acc + jnp.sum(x.astype(jnp.int64))
+        return acc
+    f = jax.jit(wrapped)
+    jax.device_get(f(*args))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(f(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:46s} {min(ts)*1000:8.2f} ms", flush=True)
+
+
+bench("noop (RTT floor)", lambda k: k[:8], keys32)
+bench("sort i32", lambda k: jnp.sort(k), keys32)
+bench("sort i64", lambda k: jnp.sort(k), keys64)
+bench("sort [i32,i32] 1key stable", lambda k, i: lax.sort([k, i], num_keys=1, is_stable=True), keys32, iota32)
+bench("sort [i32,i32] 2key", lambda k, i: lax.sort([k, i], num_keys=2), keys32, probe32)
+bench("sort [i32]x5 4key stable", lambda k, i: lax.sort([k, i, k, i, iota32], num_keys=4, is_stable=True), keys32, probe32)
+ks32 = jnp.sort(keys32)
+ks64 = jnp.sort(keys64)
+bench("searchsorted i32 scan(default)", lambda s, p: jnp.searchsorted(s, p), ks32, probe32)
+bench("searchsorted i64 scan(default)", lambda s, p: jnp.searchsorted(s, p), ks64, probe64)
+bench("searchsorted i32 sort-method", lambda s, p: jnp.searchsorted(s, p, method="sort"), ks32, probe32)
+bench("searchsorted i64 sort-method", lambda s, p: jnp.searchsorted(s, p, method="sort"), ks64, probe64)
+bench("gather i32 (take)", lambda a, i: jnp.take(a, i), keys32, idx)
+bench("gather i64 (take)", lambda a, i: jnp.take(a, i), keys64, idx)
+bench("gather i32 x8 cols", lambda a, i: [jnp.take(a + j, i) for j in range(8)], keys32, idx)
+bench("cumsum i32->i64", lambda a: jnp.cumsum(a.astype(jnp.int64)), keys32)
+bench("cumsum i32->i32", lambda a: jnp.cumsum(a), keys32)
+bench("associative_scan add i64", lambda a: lax.associative_scan(jnp.add, a.astype(jnp.int64)), keys32)
+bench("scatter .at[].set i32", lambda a, i: jnp.zeros(N, jnp.int32).at[i].set(a), keys32, idx)
+bench("scatter .at[].max i32", lambda a, i: jnp.zeros(N, jnp.int32).at[i].max(a), keys32, idx)
+bench("segment_sum i64 sorted", lambda a, g: jax.ops.segment_sum(a.astype(jnp.int64), g, num_segments=N, indices_are_sorted=True), keys32, jnp.sort(idx))
+bench("segment_sum i32 sorted", lambda a, g: jax.ops.segment_sum(a, g, num_segments=N, indices_are_sorted=True), keys32, jnp.sort(idx))
+bench("elementwise x5", lambda a, b: jnp.where(a > b, a * 2 + b, a - b) + jnp.where(b > 0, a, b), keys32, probe32)
+bench("mul i64", lambda a, b: a.astype(jnp.int64) * b.astype(jnp.int64), keys32, probe32)
+bench("mul f64", lambda a, b: a.astype(jnp.float64) * b.astype(jnp.float64), keys32, probe32)

@@ -210,16 +210,9 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
-    from nds_tpu.utils.xla_cache import enable as enable_xla_cache
-    cache_dir = enable_xla_cache()
-    print(f"[bench] xla cache: {cache_dir}", file=sys.stderr, flush=True)
-
-    import jax
-    print(f"[bench] backend: {jax.default_backend()} {jax.devices()}",
-          file=sys.stderr, flush=True)
-
-    # totals for EVERY leg up front: a kill before a leg starts must
-    # still count its queries in queries_total (else a 22/22 nds_h-only
+    # totals for EVERY leg up front — and before the (multi-second,
+    # kill-prone) TPU init below: a kill at any point must still count
+    # every leg's queries in queries_total (else a 22/22 nds_h-only
     # partial reads as a complete 121-query run)
     for leg in LEGS:
         if leg == "nds_h":
@@ -227,6 +220,14 @@ def main() -> None:
         else:
             from nds_tpu.nds import streams as nds_streams
             LEG_TOTALS[leg] = len(nds_streams.available_templates())
+
+    from nds_tpu.utils.xla_cache import enable as enable_xla_cache
+    cache_dir = enable_xla_cache()
+    print(f"[bench] xla cache: {cache_dir}", file=sys.stderr, flush=True)
+
+    import jax
+    print(f"[bench] backend: {jax.default_backend()} {jax.devices()}",
+          file=sys.stderr, flush=True)
 
     for leg in LEGS:
         _run_leg(leg)

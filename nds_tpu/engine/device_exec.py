@@ -641,7 +641,8 @@ class _Trace:
             total = offs[-1]
             K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
             slots = jnp.arange(K, dtype=jnp.int32)
-            ridx = jnp.clip(jnp.searchsorted(offs, slots, side="right"),
+            ridx = jnp.clip(_ss(offs, slots.astype(offs.dtype),
+                                side="right"),
                             0, rctx.n - 1)
             prev = jnp.where(ridx > 0, jnp.take(offs, ridx - 1), 0)
             within = slots - prev

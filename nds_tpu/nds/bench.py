@@ -111,8 +111,11 @@ def run_full_bench(cfg: dict) -> dict:
 
     if not skip.get("stream_gen", False):
         from nds_tpu.nds.streams import generate_query_streams
+        # rngseed from the load report redraws every stream's parameter
+        # bindings (dsqgen -rngseed, `nds/nds_bench.py:415`): throughput
+        # streams must be distinct workloads, not N copies
         generate_query_streams(stream_dir, num_streams,
-                               rng_seed=rngseed)
+                               rng_seed=rngseed, qualification=False)
 
     power_log = os.path.join(report_dir, "power_time.csv")
     if not skip.get("power_test", False):
@@ -125,14 +128,25 @@ def run_full_bench(cfg: dict) -> dict:
     ttts, tdms = [], []
     for round_no in (1, 2):
         if not skip.get("throughput_test", False):
-            from nds_tpu.nds.throughput import run_streams
+            from nds_tpu.nds.throughput import (
+                run_streams, run_streams_inprocess,
+            )
             streams_n = get_stream_range(num_streams, round_no)
             tstreams = [os.path.join(stream_dir, f"query_{i}.sql")
                         for i in streams_n]
-            ttt, codes = run_streams(
-                wh_dir, tstreams,
-                os.path.join(report_dir, f"throughput{round_no}"),
-                backend=backend)
+            tdir = os.path.join(report_dir, f"throughput{round_no}")
+            # one TPU chip cannot be opened by N subprocesses; the
+            # in-process mode time-shares it (cpu/distributed keep the
+            # reference's process fan-out). Overridable via YAML.
+            mode = cfg.get("throughput_mode",
+                           "inprocess" if backend == "tpu"
+                           else "subprocess")
+            if mode == "inprocess":
+                ttt, codes = run_streams_inprocess(
+                    wh_dir, tstreams, tdir, backend=backend)
+            else:
+                ttt, codes = run_streams(
+                    wh_dir, tstreams, tdir, backend=backend)
             if any(codes):
                 raise SystemExit(
                     f"throughput {round_no} streams failed: {codes}")
@@ -142,7 +156,7 @@ def run_full_bench(cfg: dict) -> dict:
                                   f"maintenance{round_no}_time.csv")
             _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
                   wh_dir, f"{refresh_base}{round_no}", dm_log,
-                  "--backend", "cpu"])
+                  "--backend", backend])
             tdms.append(get_maintenance_time(dm_log))
     metrics["throughput_times_s"] = ttts
     metrics["maintenance_times_s"] = tdms

@@ -178,6 +178,293 @@ QUALIFICATION: dict[int, dict] = {
 }
 
 
+# value domains shared with the builtin generator (datagen/tpcds.py §3
+# lists); drawing from these keeps every rebinding inside the data's
+# actual domain the way dsqgen's distributions do
+_STATES = ["AL", "CA", "CO", "FL", "GA", "IL", "IN", "KS", "KY", "LA",
+           "MI", "MN", "MO", "MS", "NC", "NE", "NY", "OH", "OK", "PA",
+           "SD", "TN", "TX", "VA", "WA", "WI"]
+_COUNTIES = [f"{w} County" for w in
+             ["Williamson", "Walker", "Ziebach", "Franklin", "Bronx",
+              "Orange", "Fairfield", "Jackson", "Barrow", "Daviess",
+              "Luce", "Richland", "Furnas", "Maverick", "Huron",
+              "Kittitas", "Mobile", "Coal", "Lunenburg", "Ferry"]]
+_CITIES = ["Midway", "Fairview", "Oak Grove", "Five Points", "Oakland",
+           "Riverside", "Salem", "Georgetown", "Greenfield", "Liberty",
+           "Bethel", "Pleasant Hill", "Lebanon", "Springdale", "Shiloh",
+           "Mount Olive", "Glendale", "Marion", "Greenville", "Union"]
+_CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+               "Men", "Music", "Shoes", "Sports", "Women"]
+_EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+              "4 yr Degree", "Advanced Degree", "Unknown"]
+_MARITAL = ["S", "M", "D", "W", "U"]
+_BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000",
+                  ">10000", "Unknown"]
+_COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+           "black", "blue", "blush", "brown", "burlywood", "chartreuse",
+           "chiffon", "chocolate", "coral", "cornflower", "cream",
+           "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
+           "floral", "forest", "frosted", "gainsboro", "ghost",
+           "goldenrod", "green", "grey", "honeydew", "hot", "indian",
+           "ivory", "khaki", "lace", "lavender", "lawn", "lemon",
+           "light", "lime", "linen", "magenta", "maroon", "medium",
+           "metallic", "midnight", "mint", "misty", "moccasin", "navajo",
+           "navy", "olive", "orange", "orchid", "pale", "papaya",
+           "peach", "peru", "pink", "plum", "powder", "puff", "purple",
+           "red", "rose", "rosy", "royal", "saddle", "salmon", "sandy",
+           "seashell", "sienna", "sky", "slate", "smoke", "snow",
+           "spring", "steel", "tan", "thistle", "tomato", "turquoise",
+           "violet", "wheat", "white", "yellow"]
+_SM_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS",
+                "ZHOU", "ZOUROS", "MSC", "LATVIAN", "DIAMOND",
+                "ALLIANCE", "ORIENTAL", "BARIAN", "BOXBUNDLES",
+                "HARMSTORF", "PRIVATECARRIER", "GERMA", "RUPEKSA",
+                "GREAT EASTERN"]
+_REASONS = ["Package was damaged", "Stopped working",
+            "Did not get it on time", "Not the product that was ordred",
+            "Parts missing", "Does not work with a product that I have",
+            "Gift exchange", "Did not like the color",
+            "Did not like the model", "Did not like the make",
+            "Did not fit"]
+_WEB_COMPANIES = ["pri", "able", "ought", "ation", "bar", "ese"]
+_GMT = [-5, -6, -7, -8]
+# sales rows land in 1998-2002 (datagen SALES_DATE_LO/HI); d_month_seq
+# = (year-1900)*12 + moy - 1, so the 1998-2002 window is seq 1176-1235
+_YEARS = (1998, 2002)
+_DMS = (1176, 1224)  # leaves +11 months of headroom for dms..dms+11
+
+
+def _distinct(rng, pool, k):
+    return rng.sample(list(pool), k)
+
+
+def _date(rng, y_lo=1998, y_hi=2002, m_lo=1, m_hi=12, day=None):
+    y = rng.randint(y_lo, y_hi)
+    m = rng.randint(m_lo, m_hi)
+    d = day if day is not None else rng.randint(1, 28)
+    return f"{y:04d}-{m:02d}-{d:02d}"
+
+
+def random_params(template_number: int, rng, stream: int) -> dict:
+    """Per-stream substitution parameters (reference: dsqgen -rngseed
+    redraws bindings per stream, `nds/nds_gen_query_stream.py:42-89`, so
+    concurrent throughput streams are DISTINCT workloads, not N copies).
+    Distributions follow the spec's parameter domains restricted to the
+    builtin generator's §3 value lists; templates keep the same keys as
+    QUALIFICATION, so a draw is a drop-in replacement."""
+    q = template_number
+    year = lambda lo=1998, hi=2002: rng.randint(lo, hi)
+    dms = lambda: rng.randint(*_DMS)
+    manufact = lambda: rng.randint(1, 1000)
+    gmt = lambda: rng.choice(_GMT)
+    if q == 1:
+        return {"year": year(1998, 2000), "state": rng.choice(_STATES)}
+    if q == 3:
+        return {"manufact": manufact(), "month": rng.randint(11, 12)}
+    if q == 6:
+        return {"year": year(), "month": rng.randint(1, 8)}
+    if q == 10:
+        c = _distinct(rng, _COUNTIES, 5)
+        return {**{f"county{i}": c[i - 1] for i in range(1, 6)},
+                "year": year(1999, 2002), "month": rng.randint(1, 4)}
+    if q in (12, 20, 98):
+        c = _distinct(rng, _CATEGORIES, 3)
+        return {"cat1": c[0], "cat2": c[1], "cat3": c[2],
+                "date": _date(rng, 1998, 2002, 1, 7)}
+    if q == 16:
+        return {"date": _date(rng, 1999, 2002, 1, 12, day=1),
+                "state": rng.choice(_STATES),
+                "county": rng.choice(_COUNTIES)}
+    if q in (17, 25):
+        return {"year": year(1998, 2001)}
+    if q == 28:
+        out = {}
+        for i in range(1, 7):
+            out[f"lp{i}"] = rng.randint(0, 190)
+            out[f"ca{i}"] = rng.randint(0, 2000)
+            out[f"wc{i}"] = rng.randint(0, 80)
+        return out
+    if q == 29:
+        return {"year": year(1998, 2000)}
+    if q in (32, 92):
+        return {"manufact": manufact(), "date": _date(rng)}
+    if q in (37, 82):
+        ms = _distinct(rng, range(1, 1001), 4)
+        return {"price": rng.randint(10, 90), "date": _date(rng),
+                **{f"m{i}": ms[i - 1] for i in range(1, 5)}}
+    if q in (94, 95):
+        return {"date": _date(rng, 1999, 2002, 1, 10, day=1),
+                "state": rng.choice(_STATES),
+                "company": rng.choice(_WEB_COMPANIES)}
+    if q in (7, 26):
+        return {"gender": rng.choice("MF"),
+                "marital": rng.choice(_MARITAL),
+                "education": rng.choice(_EDUCATION), "year": year()}
+    if q == 18:
+        ms = _distinct(rng, range(1, 13), 6)
+        ss = [rng.choice(_STATES) for _ in range(7)]
+        return {"gender": rng.choice("MF"),
+                "education": rng.choice(_EDUCATION), "year": year(),
+                **{f"m{i}": ms[i - 1] for i in range(1, 7)},
+                **{f"s{i}": ss[i - 1] for i in range(1, 8)}}
+    if q == 21:
+        return {"date": _date(rng, 1998, 2002, 1, 12, day=1)}
+    if q in (22, 38, 51, 53, 59, 62, 63, 65, 67, 70, 86, 87, 97, 99):
+        return {"dms": dms()}
+    if q == 24:
+        c = _distinct(rng, _COLORS, 2)
+        return {"market": rng.randint(1, 10), "c1": c[0], "c2": c[1]}
+    if q == 27:
+        ss = _distinct(rng, _STATES, 6)
+        return {"gender": rng.choice("MF"),
+                "marital": rng.choice(_MARITAL),
+                "education": rng.choice(_EDUCATION), "year": year(),
+                **{f"s{i}": ss[i - 1] for i in range(1, 7)}}
+    if q in (30, 81):
+        return {"year": year(1999, 2002), "state": rng.choice(_STATES)}
+    if q in (33, 56, 60):
+        out = {"year": year(), "month": rng.randint(1, 12),
+               "gmt": gmt()}
+        if q == 56:
+            c = _distinct(rng, _COLORS, 3)
+            out.update({"c1": c[0], "c2": c[1], "c3": c[2]})
+        else:
+            out["category"] = rng.choice(_CATEGORIES)
+        return out
+    if q in (35, 69):
+        out = {"year": year(1999, 2002), "month": rng.randint(1, 4)}
+        if q == 69:
+            ss = _distinct(rng, _STATES, 3)
+            out.update({f"s{i}": ss[i - 1] for i in range(1, 4)})
+        return out
+    if q == 40:
+        return {"date": _date(rng)}
+    if q == 41:
+        return {"manufact": manufact()}
+    if q == 50:
+        return {"year": year(1999, 2002), "month": rng.randint(8, 10)}
+    if q == 85:
+        return {"year": year()}
+    if q in (4, 11, 74):
+        return {"year": year(1998, 2001)}
+    if q == 8:
+        return {"qoy": rng.randint(1, 2), "year": year()}
+    if q == 14:
+        return {"year": year(1998, 2000)}
+    if q == 23:
+        return {"year": year(1998, 2000), "month": rng.randint(1, 7)}
+    if q == 39:
+        return {"year": year(), "month": rng.randint(1, 11)}
+    if q == 64:
+        c = _distinct(rng, _COLORS, 6)
+        return {"year": year(1998, 2001), "price": rng.randint(0, 85),
+                **{f"c{i}": c[i - 1] for i in range(1, 7)}}
+    if q == 66:
+        sm = _distinct(rng, _SM_CARRIERS, 2)
+        return {"year": year(), "time": rng.randint(1, 57600),
+                "smc1": sm[0], "smc2": sm[1]}
+    if q == 72:
+        return {"bp": rng.choice(_BUY_POTENTIAL),
+                "ms": rng.choice(_MARITAL), "year": year()}
+    if q == 75:
+        return {"category": rng.choice(_CATEGORIES),
+                "year": year(1998, 2001)}
+    if q == 78:
+        return {"year": year()}
+    if q == 34:
+        bp = _distinct(rng, _BUY_POTENTIAL, 2)
+        return {"year": year(1998, 2000), "bp1": bp[0], "bp2": bp[1],
+                **{f"county{i}": rng.choice(_COUNTIES)
+                   for i in range(1, 9)}}
+    if q == 45:
+        return {"qoy": rng.randint(1, 4), "year": year()}
+    if q in (46, 68):
+        cities = _distinct(rng, _CITIES, 2)
+        return {"dep": rng.randint(0, 9), "veh": rng.randint(-1, 4),
+                "year": year(1998, 2000), "city1": cities[0],
+                "city2": cities[1]}
+    if q == 49:
+        return {"ramt": rng.randint(5, 15), "year": year(),
+                "month": rng.randint(11, 12)}
+    if q == 54:
+        cat = rng.choice(_CATEGORIES)
+        return {"category": cat,
+                "class": f"{cat.lower()}class{rng.randint(1, 16)}",
+                "month": rng.randint(1, 7), "year": year()}
+    if q == 58:
+        return {"date": _date(rng)}
+    if q == 83:
+        return {"date1": _date(rng), "date2": _date(rng),
+                "date3": _date(rng)}
+    if q in (2, 31):
+        return {"year": year(1998, 2001)}
+    if q in (5, 77, 80):
+        return {"date": _date(rng)}
+    if q == 71:
+        return {"manager": rng.randint(1, 100),
+                "month": rng.randint(11, 12), "year": year()}
+    if q == 36:
+        ss = _distinct(rng, _STATES, 6)
+        return {"year": year(),
+                **{f"s{i}": ss[i - 1] for i in range(1, 7)}}
+    if q == 44:
+        return {"store": rng.randint(1, 6)}
+    if q in (47, 57):
+        return {"year": year(1999, 2001)}
+    if q == 89:
+        return {"year": year()}
+    if q == 9:
+        return {f"t{i}": rng.randint(1000, 5000) for i in range(1, 6)}
+    if q in (13, 48):
+        ms = _distinct(rng, _MARITAL, 3)
+        es = _distinct(rng, _EDUCATION, 3)
+        out = {"year": year(),
+               **{f"ms{i}": ms[i - 1] for i in range(1, 4)},
+               **{f"es{i}": es[i - 1] for i in range(1, 4)},
+               **{f"s{i}": rng.choice(_STATES) for i in range(1, 10)}}
+        return out
+    if q == 15:
+        return {"qoy": rng.randint(1, 4), "year": year()}
+    if q in (19, 55):
+        return {"manager": rng.randint(1, 100),
+                "month": rng.randint(11, 12), "year": year()}
+    if q in (42, 52):
+        return {"month": rng.randint(11, 12), "year": year()}
+    if q == 43:
+        return {"gmt": gmt(), "year": year()}
+    if q == 61:
+        return {"gmt": gmt(), "category": rng.choice(_CATEGORIES),
+                "year": year()}
+    if q == 73:
+        bp = _distinct(rng, _BUY_POTENTIAL, 2)
+        c = _distinct(rng, _COUNTIES, 4)
+        return {"year": year(1998, 2000), "bp1": bp[0], "bp2": bp[1],
+                **{f"county{i}": c[i - 1] for i in range(1, 5)}}
+    if q == 79:
+        return {"dep": rng.randint(0, 9), "veh": rng.randint(-1, 4),
+                "year": year(1998, 2000)}
+    if q == 84:
+        return {"city": rng.choice(_CITIES),
+                "income": rng.randint(0, 70000)}
+    if q == 88:
+        d = _distinct(rng, range(0, 10), 3)
+        return {"d1": d[0], "d2": d[1], "d3": d[2]}
+    if q == 90:
+        return {"hour_am": rng.randint(6, 12),
+                "hour_pm": rng.randint(13, 20), "dep": rng.randint(0, 9)}
+    if q == 91:
+        return {"year": year(), "month": rng.randint(11, 12)}
+    if q == 93:
+        return {"reason": rng.choice(_REASONS)}
+    if q == 96:
+        return {"hour": rng.randint(8, 20), "dep": rng.randint(0, 9)}
+    if q == 76:
+        return {}
+    # any template without an explicit distribution falls back to its
+    # qualification bindings (still a valid, spec-shaped draw)
+    return dict(QUALIFICATION.get(q, {}))
+
+
 def render_query(template_number: int, params: dict | None = None) -> str:
     with open(os.path.join(TEMPLATE_DIR, f"q{template_number}.sql")) as f:
         tpl = f.read()
@@ -199,15 +486,24 @@ def stream_order(stream: int, rng_seed: int | None = None,
 
 def generate_query_streams(output_dir: str, streams: int,
                            rng_seed: int | None = None,
-                           templates: list[int] | None = None) -> list[str]:
+                           templates: list[int] | None = None,
+                           qualification: bool = True) -> list[str]:
     """Write query_{i}.sql stream files (reference layout:
-    `nds/nds_gen_query_stream.py:42-89` emits query_0.sql .. query_N.sql)."""
+    `nds/nds_gen_query_stream.py:42-89` emits query_0.sql .. query_N.sql).
+
+    qualification=False redraws every template's substitution parameters
+    per stream from a (rng_seed, stream)-seeded generator — the dsqgen
+    `-rngseed` behavior — so throughput streams differ in bindings as
+    well as order (and the engine cannot reuse one compiled program
+    across what the benchmark defines as distinct workloads)."""
     os.makedirs(output_dir, exist_ok=True)
     paths = []
     for i in range(streams):
+        rng = random.Random((rng_seed or 0) * 7919 + i)
         parts = []
         for qn in stream_order(i, rng_seed, templates):
-            sql = render_query(qn)
+            params = None if qualification else random_params(qn, rng, i)
+            sql = render_query(qn, params)
             parts.append(
                 f"-- start query {qn} in stream {i} using template "
                 f"query{qn}.tpl\n{sql}\n-- end query {qn} in stream {i} "

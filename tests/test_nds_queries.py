@@ -9,6 +9,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from nds_tpu.datagen import tpcds
 from nds_tpu.engine.device_exec import make_device_factory
 from nds_tpu.engine.session import Session
