@@ -67,6 +67,8 @@ I64_MAX = np.iinfo(np.int64).max
 I64_MIN = np.iinfo(np.int64).min
 
 
+
+
 class DeviceExecError(RuntimeError):
     pass
 
@@ -128,10 +130,15 @@ def _scale_of(t: DType) -> int:
     return t.scale if isinstance(t, DecimalType) else 0
 
 
-def _to_float(arr, t: DType):
+def _to_float(arr, t: DType, fdt=None):
+    """Float compute dtype: f64 (default) is emulated on TPU but matches
+    the CPU oracle exactly; `engine.precision` selects f32/bf16 in
+    floats mode for native VPU arithmetic (the reference's
+    variableFloatAgg tradeoff). fdt comes from the trace."""
+    fdt = fdt or jnp.float64
     if isinstance(t, DecimalType):
-        return arr.astype(jnp.float64) / (10.0 ** t.scale)
-    return arr.astype(jnp.float64)
+        return arr.astype(fdt) / (10.0 ** t.scale)
+    return arr.astype(fdt)
 
 
 def _rescale(arr, from_s: int, to_s: int):
@@ -218,8 +225,10 @@ class DeviceExecutor:
     a whole session: it owns the device buffer pool (columns uploaded once,
     the transcode/load analog) and the per-query compile cache."""
 
-    def __init__(self, tables: dict[str, HostTable]):
+    def __init__(self, tables: dict[str, HostTable],
+                 float_dtype=None):
         self.tables = tables
+        self.float_dtype = float_dtype  # None -> float64 (exact oracle)
         self._buffers: dict[str, jnp.ndarray] = {}
         self._bounds: dict[tuple, tuple] = {}
         self._compiled: dict[object, tuple] = {}
@@ -233,51 +242,72 @@ class DeviceExecutor:
     DEFAULT_SLACK = 2.0
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
+        return self.execute_async(planned, key).result()
+
+    def execute_async(self, planned: P.PlannedQuery,
+                      key: object = None) -> "_AsyncResult":
+        """Dispatch a query without blocking on its completion. jax's
+        async dispatch returns device futures immediately, so a caller
+        can keep N queries in flight (`engine.concurrent_tasks`, the
+        analog of spark.rapids.sql.concurrentGpuTasks,
+        `nds/power_run_gpu.template:38`) and overlap device execution
+        with host-side materialization of earlier results."""
         import time as _time
         key = key if key is not None else id(planned)
-        self.last_timings = {"compile_ms": 0.0}
+        timings = {"compile_ms": 0.0}
+        self.last_timings = timings
         # the cache entry holds a strong ref to the plan: id()-keyed
         # entries must keep their plan alive or a recycled address
         # could serve another query's compiled program
         entry = self._compiled.setdefault(
             key, {"slack": self.DEFAULT_SLACK, "ref": planned})
-        for _attempt in range(4):
-            if "compiled" not in entry:
-                t0 = _time.perf_counter()
-                jitted, side = self._compile(planned, entry["slack"])
-                bufs = self._collect_buffers(planned)
-                # AOT-compile now so compile cost is attributed
-                # separately from steady-state execution
-                entry["compiled"] = jitted.lower(bufs).compile()
-                entry["side"] = side
-                self.last_timings["compile_ms"] += (
-                    _time.perf_counter() - t0) * 1000
+        if "compiled" not in entry:
+            t0 = _time.perf_counter()
+            jitted, side = self._compile(planned, entry["slack"])
             bufs = self._collect_buffers(planned)
-            t1 = _time.perf_counter()
-            row, outs, overflow = entry["compiled"](bufs)
-            # ONE device->host round trip for execution + result: a
-            # separate block_until_ready + int(overflow) + device_get
-            # costs 2-3 tunnel RTTs per query on remote-attached TPUs
-            row_h, outs_h, overflow_h = jax.device_get(
-                (row, outs, overflow))
-            t2 = _time.perf_counter()
-            if int(overflow_h) == 0:
-                out = self._materialize(planned, row_h, outs_h,
-                                        entry["side"])
-                t3 = _time.perf_counter()
-                self.last_timings["execute_ms"] = (t2 - t1) * 1000
-                self.last_timings["materialize_ms"] = (t3 - t2) * 1000
-                return out
-            # M:N join capacity exceeded: recompile with doubled slack
-            # (recovered task-level failure -> listener chain, the
-            # CompletedWithTaskFailures analog of `Manager.notifyAll`)
-            from nds_tpu.utils.report import TaskFailureCollector
-            TaskFailureCollector.notify(
-                f"join expansion overflow: retry with slack "
-                f"{entry['slack'] * 2}")
-            entry.pop("compiled", None)
-            entry["slack"] *= 2
-        raise DeviceExecError("join expansion overflow after retries")
+            # AOT-compile now so compile cost is attributed
+            # separately from steady-state execution
+            entry["compiled"] = jitted.lower(bufs).compile()
+            entry["side"] = side
+            timings["compile_ms"] += (_time.perf_counter() - t0) * 1000
+        bufs = self._collect_buffers(planned)
+        t1 = _time.perf_counter()
+        row, outs, overflow = entry["compiled"](bufs)
+        return _AsyncResult(self, planned, key, entry, timings, t1,
+                            (row, outs, overflow))
+
+    def _finish(self, planned, key, entry, timings, t1, devs,
+                attempt: int = 0):
+        """Blocking half of execute_async: one device->host round trip
+        for execution + result (a separate block_until_ready +
+        int(overflow) + device_get costs 2-3 tunnel RTTs per query on
+        remote-attached TPUs), then overflow-retry with doubled slack."""
+        import time as _time
+        row_h, outs_h, overflow_h = jax.device_get(devs)
+        t2 = _time.perf_counter()
+        if int(overflow_h) == 0:
+            out = self._materialize(planned, row_h, outs_h, entry["side"])
+            t3 = _time.perf_counter()
+            timings["execute_ms"] = (t2 - t1) * 1000
+            timings["materialize_ms"] = (t3 - t2) * 1000
+            self.last_timings = timings
+            return out
+        if attempt >= 3:
+            raise DeviceExecError("join expansion overflow after retries")
+        # M:N join capacity exceeded: recompile with doubled slack
+        # (recovered task-level failure -> listener chain, the
+        # CompletedWithTaskFailures analog of `Manager.notifyAll`)
+        from nds_tpu.utils.report import TaskFailureCollector
+        TaskFailureCollector.notify(
+            f"join expansion overflow: retry with slack "
+            f"{entry['slack'] * 2}")
+        entry.pop("compiled", None)
+        entry["slack"] *= 2
+        nxt = self.execute_async(planned, key)
+        # engineTimings must report the FULL compile bill across retries
+        nxt.timings["compile_ms"] += timings.get("compile_ms", 0.0)
+        return self._finish(planned, key, nxt.entry, nxt.timings, nxt.t1,
+                            nxt.devs, attempt + 1)
 
     def _compile(self, planned: P.PlannedQuery,
                  slack: float = DEFAULT_SLACK):
@@ -357,6 +387,26 @@ class DeviceExecutor:
         return ResultTable(names, arrs, dtypes, valids)
 
 
+class _AsyncResult:
+    """Handle for an in-flight query: dispatch happened, completion and
+    materialization wait until result()."""
+
+    __slots__ = ("ex", "planned", "key", "entry", "timings", "t1", "devs")
+
+    def __init__(self, ex, planned, key, entry, timings, t1, devs):
+        self.ex = ex
+        self.planned = planned
+        self.key = key
+        self.entry = entry
+        self.timings = timings
+        self.t1 = t1
+        self.devs = devs
+
+    def result(self):
+        return self.ex._finish(self.planned, self.key, self.entry,
+                               self.timings, self.t1, self.devs)
+
+
 class _Trace:
     """Interprets a plan while being traced by jax.jit. All python control
     flow here runs at trace time; host-side numpy work (dictionary
@@ -367,6 +417,9 @@ class _Trace:
         self.ex = ex
         self.bufs = bufs
         self.slack = slack
+        # float compute dtype (engine.precision); distributed executors
+        # without the attribute inherit the exact-f64 default
+        self.fdt = getattr(ex, "float_dtype", None) or jnp.float64
         self.scalars: dict[int, tuple] = {}
         self._cache: dict[int, DCtx] = {}
         self._overflows: list = []
@@ -936,12 +989,12 @@ class _Trace:
         valid = (cnt > 0).reshape(1)
         if spec.func == "sum":
             if isinstance(spec.dtype, FloatType):
-                s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.float64), 0.0))
+                s = jnp.sum(jnp.where(w, dv.arr.astype(self.fdt), 0.0))
             else:
                 s = jnp.sum(jnp.where(w, dv.arr.astype(jnp.int64), 0))
             return s.reshape(1), valid, None
         if spec.func == "avg":
-            f = _to_float(dv.arr, spec.arg.dtype)
+            f = _to_float(dv.arr, spec.arg.dtype, self.fdt)
             s = jnp.sum(jnp.where(w, f, 0.0))
             return (s / jnp.maximum(cnt, 1)).reshape(1), valid, None
         if spec.func in ("min", "max"):
@@ -954,10 +1007,10 @@ class _Trace:
             red = jnp.min(masked) if spec.func == "min" else jnp.max(masked)
             return red.reshape(1), valid, dv.sdict
         if spec.func in ("stddev_samp", "stddev"):
-            f = _to_float(dv.arr, spec.arg.dtype)
+            f = _to_float(dv.arr, spec.arg.dtype, self.fdt)
             s1 = jnp.sum(jnp.where(w, f, 0.0))
             s2 = jnp.sum(jnp.where(w, f * f, 0.0))
-            c = cnt.astype(jnp.float64)
+            c = cnt.astype(self.fdt)
             var = (s2 - s1 * s1 / jnp.maximum(c, 1)) / jnp.maximum(
                 c - 1, 1)
             sd = jnp.sqrt(jnp.maximum(var, 0.0))
@@ -987,14 +1040,14 @@ class _Trace:
         valid = cnt > 0
         if spec.func == "sum":
             if isinstance(spec.dtype, FloatType):
-                data = jnp.where(w, arr_s.astype(jnp.float64), 0.0)
+                data = jnp.where(w, arr_s.astype(self.fdt), 0.0)
             else:
                 data = jnp.where(w, arr_s.astype(jnp.int64), 0)
             return self._seg_sum(data, starts2, G), valid, None
         if spec.func == "avg":
-            f = _to_float(arr_s, spec.arg.dtype)
+            f = _to_float(arr_s, spec.arg.dtype, self.fdt)
             s = self._seg_sum(jnp.where(w, f, 0.0), starts2, G)
-            return s / jnp.maximum(cnt, 1).astype(jnp.float64), valid, None
+            return s / jnp.maximum(cnt, 1).astype(self.fdt), valid, None
         if spec.func in ("min", "max"):
             isf = jnp.issubdtype(arr_s.dtype, jnp.floating)
             if isf:
@@ -1020,10 +1073,10 @@ class _Trace:
                 red = red.astype(jnp.int64)
             return red, valid, dv.sdict
         if spec.func in ("stddev_samp", "stddev"):
-            f = _to_float(arr_s, spec.arg.dtype)
+            f = _to_float(arr_s, spec.arg.dtype, self.fdt)
             s1 = self._seg_sum(jnp.where(w, f, 0.0), starts2, G)
             s2 = self._seg_sum(jnp.where(w, f * f, 0.0), starts2, G)
-            c = cnt.astype(jnp.float64)
+            c = cnt.astype(self.fdt)
             var = (s2 - s1 * s1 / jnp.maximum(c, 1)) / jnp.maximum(
                 c - 1, 1)
             sd = jnp.sqrt(jnp.maximum(var, 0.0))
@@ -1103,12 +1156,7 @@ class _Trace:
             arr = _narrow_key(dv)
             if jnp.issubdtype(arr.dtype, jnp.bool_):
                 arr = arr.astype(jnp.int32)
-            if asc:
-                key = arr
-            elif jnp.issubdtype(arr.dtype, jnp.floating):
-                key = -arr.astype(jnp.float64)
-            else:
-                key = -arr
+            key = arr if asc else -arr
             if dv.valid is not None:
                 key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
             ops.append(key)
@@ -1160,9 +1208,9 @@ class _Trace:
         running = bool(spec.order)
         is_f = isinstance(spec.dtype, FloatType)
         if spec.func == "avg":
-            vals = _to_float(vals, spec.arg.dtype)
+            vals = _to_float(vals, spec.arg.dtype, self.fdt)
         elif is_f:
-            vals = vals.astype(jnp.float64)
+            vals = vals.astype(self.fdt)
         else:
             vals = vals.astype(jnp.int64)
         G = n
@@ -1207,7 +1255,7 @@ class _Trace:
             else:
                 res = part_total(data)
             if spec.func == "avg":
-                res = res.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                res = res.astype(self.fdt) / jnp.maximum(cnt, 1)
         elif spec.func in ("min", "max"):
             if jnp.issubdtype(vals.dtype, jnp.floating):
                 fill = jnp.inf if spec.func == "min" else -jnp.inf
@@ -1266,12 +1314,7 @@ class _Trace:
             arr = _narrow_key(dv)
             if jnp.issubdtype(arr.dtype, jnp.bool_):
                 arr = arr.astype(jnp.int32)
-            if asc:
-                key = arr
-            elif jnp.issubdtype(arr.dtype, jnp.floating):
-                key = -arr.astype(jnp.float64)
-            else:
-                key = -arr  # negation stays in range: bounds checked
+            key = arr if asc else -arr  # negation stays in range: bounds checked
             if dv.valid is not None:
                 key = jnp.where(dv.valid, key, jnp.zeros((), key.dtype))
             ops.append(key)
@@ -1510,13 +1553,13 @@ class _Trace:
         v = e.value
         if v is None:
             if isinstance(e.dtype, FloatType):
-                return DVal(jnp.zeros(ctx.n, jnp.float64),
+                return DVal(jnp.zeros(ctx.n, self.fdt),
                             jnp.zeros(ctx.n, dtype=bool))
             dt = jnp.int32 if isinstance(e.dtype, DateType) else jnp.int64
             return DVal(jnp.zeros(ctx.n, dt),
                         jnp.zeros(ctx.n, dtype=bool), None, 0, 0)
         if isinstance(e.dtype, FloatType):
-            arr = jnp.full(ctx.n, float(v), dtype=jnp.float64)
+            arr = jnp.full(ctx.n, float(v), dtype=self.fdt)
             return DVal(arr, None)
         iv = int(v)
         dtype = jnp.int64
@@ -1535,12 +1578,12 @@ class _Trace:
         if isinstance(e.dtype, DateType):
             return DVal(l.arr + r.arr, valid)
         if e.op == "/":
-            la = _to_float(l.arr, lt)
-            ra = _to_float(r.arr, rt)
+            la = _to_float(l.arr, lt, self.fdt)
+            ra = _to_float(r.arr, rt, self.fdt)
             return DVal(la / ra, valid)
         if isinstance(e.dtype, FloatType):
-            return DVal(_apply(e.op, _to_float(l.arr, lt),
-                               _to_float(r.arr, rt)), valid)
+            return DVal(_apply(e.op, _to_float(l.arr, lt, self.fdt),
+                               _to_float(r.arr, rt, self.fdt)), valid)
         if isinstance(e.dtype, DecimalType):
             if e.op == "*":
                 return DVal(l.arr.astype(jnp.int64) * r.arr.astype(jnp.int64),
@@ -1572,13 +1615,15 @@ class _Trace:
         la, ra = l.arr, r.arr
         if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
             if isinstance(lt, FloatType) or isinstance(rt, FloatType):
-                la, ra = _to_float(la, lt), _to_float(ra, rt)
+                la, ra = (_to_float(la, lt, self.fdt),
+                          _to_float(ra, rt, self.fdt))
             else:
                 s = max(_scale_of(lt), _scale_of(rt))
                 la = _rescale(la.astype(jnp.int64), _scale_of(lt), s)
                 ra = _rescale(ra.astype(jnp.int64), _scale_of(rt), s)
         elif isinstance(lt, FloatType) or isinstance(rt, FloatType):
-            la, ra = _to_float(la, lt), _to_float(ra, rt)
+            la, ra = (_to_float(la, lt, self.fdt),
+                          _to_float(ra, rt, self.fdt))
         return DVal(_cmp(e.op, la, ra), valid)
 
     def _string_cmp(self, e: ir.Cmp, ctx: DCtx) -> DVal:
@@ -1622,7 +1667,7 @@ class _Trace:
             valid = edv.valid  # else-branch validity; refined per row below
         else:
             if isinstance(e.dtype, FloatType):
-                default = jnp.zeros(ctx.n, jnp.float64)
+                default = jnp.zeros(ctx.n, self.fdt)
             else:
                 default = jnp.zeros(ctx.n, jnp.int64)
             valid = jnp.zeros(ctx.n, dtype=bool)  # no branch -> NULL
@@ -1685,7 +1730,7 @@ class _Trace:
         if repr(src) == repr(dst):
             return dv.arr
         if isinstance(dst, FloatType):
-            return _to_float(dv.arr, src)
+            return _to_float(dv.arr, src, self.fdt)
         if isinstance(dst, DecimalType):
             return _rescale(dv.arr.astype(jnp.int64), _scale_of(src),
                             dst.scale)
@@ -1753,7 +1798,7 @@ class _Trace:
         dv = self.eval(e.operand, ctx)
         src = e.operand.dtype
         if isinstance(e.dtype, FloatType):
-            return DVal(_to_float(dv.arr, src), dv.valid)
+            return DVal(_to_float(dv.arr, src, self.fdt), dv.valid)
         if isinstance(e.dtype, IntType):
             if isinstance(src, DecimalType):
                 return DVal((dv.arr // 10 ** src.scale).astype(jnp.int64),
@@ -1815,17 +1860,29 @@ def _np_cmp(op, vals, lit):
     raise DeviceExecError(op)
 
 
-def make_device_factory():
+PRECISIONS = {"f64": None, "f32": "float32", "bf16": "bfloat16"}
+
+
+def make_device_factory(precision: str = "f64"):
     """Session executor factory that keeps ONE DeviceExecutor per table
     registry, preserving its device buffers and compile cache across
     queries (the load-once, query-many lifecycle of a power run,
-    `nds/nds_power.py:184-322`)."""
+    `nds/nds_power.py:184-322`).
+
+    precision selects the on-device float compute dtype
+    (`engine.precision`): f64 matches the CPU oracle exactly (emulated
+    on TPU); f32/bf16 run native on the VPU at reduced precision — the
+    floats-mode analog of the reference's variableFloatAgg tradeoff."""
+    if precision not in PRECISIONS:
+        raise ValueError(f"unknown engine.precision {precision!r}")
+    fdt = PRECISIONS[precision]
     holder: dict = {}
 
     def factory(tables):
         ex = holder.get("ex")
         if ex is None or ex.tables is not tables:
-            ex = DeviceExecutor(tables)
+            ex = DeviceExecutor(
+                tables, None if fdt is None else getattr(jnp, fdt))
             holder["ex"] = ex
         return ex
 

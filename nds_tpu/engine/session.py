@@ -135,3 +135,31 @@ class Session:
                 return None
         executor = self._executor_factory(self.tables)
         return executor.execute(planned)
+
+    def sql_async(self, sql_text: str):
+        """Dispatch-without-wait variant of sql(): returns a handle with
+        .result(). SELECTs on executors supporting execute_async (the
+        device engine) overlap with the caller's other work
+        (`engine.concurrent_tasks` pipelining); everything else runs
+        synchronously and returns an already-completed handle."""
+        key = (sql_text, self._views_signature())
+        planned = self._plan_cache.get(key)
+        if planned is None:
+            planned = self.plan(sql_text)
+            self._plan_cache[key] = planned
+        if not isinstance(planned, tuple):
+            executor = self._executor_factory(self.tables)
+            dispatch = getattr(executor, "execute_async", None)
+            if dispatch is not None:
+                return dispatch(planned)
+        return _Completed(self.sql(sql_text))
+
+
+class _Completed:
+    """Already-finished async handle (CPU oracle, DML, view DDL)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value

@@ -62,7 +62,6 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
     from nds_tpu.nds.power import SUITE
     from nds_tpu.utils import power_core
     from nds_tpu.utils.config import EngineConfig
-    from nds_tpu.utils.report import BenchReport
     from nds_tpu.utils.timelog import TimeLog
 
     os.makedirs(out_dir, exist_ok=True)
@@ -83,23 +82,58 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
             "queries": list(SUITE.parse_query_stream(sp).items()),
             "tlog": TimeLog(f"nds-tpu-throughput-{name}"),
             "failures": 0,
-            "total_ms": 0,
         })
+    # flatten round-robin, then run with `engine.concurrent_tasks`
+    # queries in flight: dispatch is async on the device engine
+    # (Session.sql_async), so device execution of query N+1 overlaps
+    # host materialization of query N — the wired-up analog of
+    # spark.rapids.sql.concurrentGpuTasks (`nds/power_run_gpu.template:38`)
+    interleaved = []
     for k in range(max(len(s["queries"]) for s in streams)):
         for s in streams:
-            if k >= len(s["queries"]):
-                continue
-            qname, sql = s["queries"][k]
-            report = BenchReport(qname, config.as_dict())
-            summary = report.report_on(
-                power_core.run_one_query, session, sql, qname, None)
-            ms = summary["queryTimes"][-1]
-            s["tlog"].add(qname, ms)
-            s["total_ms"] += ms
-            if not report.is_success():
-                s["failures"] += 1
+            if k < len(s["queries"]):
+                interleaved.append((s, *s["queries"][k]))
+    depth = max(config.get_int("engine.concurrent_tasks", 2), 1)
+    inflight: list = []
+
+    def _finish_one():
+        s, qname, t0, handle, err = inflight.pop(0)
+        if err is None:
+            try:
+                handle.result()
+            except Exception as exc:  # noqa: BLE001
+                err = exc
+        if err is not None:
+            import traceback
+            traceback.print_exc()
+            s["failures"] += 1
+        done = time.time()
+        # dispatch->result bracket; queue wait from pipelining is
+        # inherent to a time-shared chip, exactly as a query inside a
+        # reference throughput stream waits on cluster resources
+        s["tlog"].add(qname, int((done - t0) * 1000))
+        s["first_t0"] = min(s.get("first_t0", t0), t0)
+        s["last_done"] = done
+
+    for s, qname, sql in interleaved:
+        t0 = time.time()
+        handle, err = None, None
+        try:
+            handle = session.sql_async(sql)
+        except Exception as exc:  # noqa: BLE001
+            err = exc
+        inflight.append((s, qname, t0, handle, err))
+        while len(inflight) >= depth:
+            _finish_one()
+    while inflight:
+        _finish_one()
     for s in streams:
-        s["tlog"].add("Power Test Time", s["total_ms"])
+        # per-stream Power Test Time is the stream's WALL window (first
+        # dispatch -> last result), not the sum of per-query brackets:
+        # pipelined queries overlap, and a sum would double-count
+        ptt = int((s.get("last_done", start) -
+                   s.get("first_t0", start)) * 1000)
+        s["tlog"].add("Power Test Time", ptt)
         s["tlog"].write(os.path.join(out_dir, f"{s['name']}_time.csv"))
     elapse = math.ceil((time.time() - start) * 10) / 10.0
     return elapse, [s["failures"] for s in streams]

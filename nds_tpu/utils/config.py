@@ -63,7 +63,9 @@ DEFAULTS = {
     "engine.mesh.shards": "1",
     "engine.floats": "false",
     "engine.concurrent_tasks": "2",
-    "engine.precision": "f32",
+    # f64 default: floats-mode differential validation matches the CPU
+    # oracle out of the box; f32/bf16 are the opt-in fast path
+    "engine.precision": "f64",
 }
 
 

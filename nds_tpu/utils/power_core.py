@@ -68,7 +68,13 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
         enable_xla_cache()
     if backend == "tpu":
         from nds_tpu.engine.device_exec import make_device_factory
-        factory = make_device_factory()
+        # engine.precision only applies in floats mode: decimal mode's
+        # scaled-int arithmetic must stay exact (the reference's
+        # variableFloatAgg knob is likewise float-mode-only)
+        precision = "f64"
+        if config.get_bool("engine.floats"):
+            precision = config.get("engine.precision", "f64")
+        factory = make_device_factory(precision)
     elif backend == "distributed":
         from nds_tpu.parallel.dist_exec import make_distributed_factory
         from nds_tpu.parallel.mesh import make_mesh

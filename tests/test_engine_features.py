@@ -318,3 +318,67 @@ def test_concat_literal_prefix(sessions):
     exp = both(sessions, sql)
     assert all(t.startswith("cat_") and t.endswith("!")
                for t in exp["tag"])
+
+
+# ------------------------------------------------- config-knob consumers
+
+def test_execute_async_pipelines_queries(sessions):
+    """engine.concurrent_tasks' mechanism: N dispatched queries in
+    flight at once, results collected later, identical to sync."""
+    cpu, dev = sessions
+    sqls = [
+        "select s_cat, sum(s_price) t from sales group by s_cat order by s_cat",
+        "select count(*) c from sales where s_qty > 10",
+        "select s_store, avg(s_qty) a from sales group by s_store order by s_store",
+    ]
+    handles = [dev.sql_async(q) for q in sqls]
+    for q, h in zip(sqls, handles):
+        assert_frames_close(h.result().to_pandas(),
+                            cpu.sql(q).to_pandas(), q[:30])
+
+
+def test_sql_async_on_cpu_backend_is_completed_handle(sessions):
+    cpu, _dev = sessions
+    h = cpu.sql_async("select count(*) c from sales")
+    assert int(h.result().to_pandas()["c"][0]) == N
+
+
+def test_precision_f32_compute(sessions):
+    """engine.precision=f32 consumer: float compute runs in float32 (the
+    floats-mode fast path); results stay within float32 tolerance of the
+    f64 oracle."""
+    from nds_tpu.engine.device_exec import make_device_factory
+    cpu, dev = sessions
+    f32 = Session(dev.catalog, make_device_factory("f32"))
+    for t in dev.tables.values():
+        f32.register_table(t)
+    sql = "select s_cat, avg(s_qty) a from sales group by s_cat order by s_cat"
+    got = f32.sql(sql).to_pandas()
+    exp = cpu.sql(sql).to_pandas()
+    assert got["a"].to_numpy().dtype == np.float32
+    np.testing.assert_allclose(got["a"].to_numpy(dtype=float),
+                               exp["a"].to_numpy(dtype=float), rtol=1e-5)
+
+
+def test_precision_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_device_factory("f16")
+
+
+def test_make_session_precision_only_in_floats_mode(tmp_path):
+    """Decimal mode must pin f64 regardless of engine.precision."""
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    from nds_tpu.nds.power import SUITE
+    cfg = EngineConfig(overrides={"engine.backend": "tpu",
+                                  "engine.precision": "f32"})
+    sess = power_core.make_session(SUITE, cfg)
+    ex = sess._executor_factory({})
+    assert ex.float_dtype is None  # f64
+    cfg2 = EngineConfig(overrides={"engine.backend": "tpu",
+                                   "engine.floats": "true",
+                                   "engine.precision": "f32"})
+    sess2 = power_core.make_session(SUITE, cfg2)
+    ex2 = sess2._executor_factory({})
+    import jax.numpy as jnp
+    assert ex2.float_dtype == jnp.float32
