@@ -1,0 +1,68 @@
+"""Sweep all 99 NDS templates through the distributed executor on the
+virtual 8-device CPU mesh; report per-query wall time and mismatches."""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import pandas as pd
+
+from nds_tpu.datagen import tpcds
+from nds_tpu.engine.session import Session
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds import streams
+from nds_tpu.nds.schema import get_schemas
+from nds_tpu.parallel.dist_exec import make_distributed_factory
+
+sys.path.insert(0, "/root/repo/tests")
+from test_device_engine import assert_frames_close  # noqa: E402
+
+SF = 0.01
+THRESHOLD = 1000
+
+schemas = get_schemas()
+raw = {t: tpcds.gen_table(t, SF) for t in schemas}
+cpu = Session.for_nds()
+dist = Session.for_nds(make_distributed_factory(
+    n_devices=8, shard_threshold=THRESHOLD))
+for t in schemas:
+    cpu.register_table(from_arrays(t, schemas[t], raw[t]))
+    dist.register_table(from_arrays(t, schemas[t], raw[t]))
+
+qids = streams.available_templates()
+start = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+stop = int(sys.argv[2]) if len(sys.argv) > 2 else len(qids)
+fails = []
+for qn in qids[start:stop]:
+    t0 = time.perf_counter()
+    try:
+        sql = streams.render_query(qn)
+        stmts = [s for s in sql.split(";") if s.strip()]
+        exps = [cpu.sql(s) for s in stmts]
+        t1 = time.perf_counter()
+        gots = [dist.sql(s) for s in stmts]
+        t2 = time.perf_counter()
+        for part, (e, g) in enumerate(zip(exps, gots), 1):
+            if e is None or g is None:
+                continue
+            assert_frames_close(g.to_pandas(), e.to_pandas(),
+                                f"{qn}_part{part}")
+        print(f"q{qn}: OK cpu={t1-t0:.1f}s dist={t2-t1:.1f}s", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        fails.append(qn)
+        print(f"q{qn}: FAIL {type(exc).__name__}: {str(exc)[:200]}",
+              flush=True)
+print("FAILS:", fails, flush=True)

@@ -1,0 +1,246 @@
+"""Chunked (out-of-core) execution: tables larger than HBM stream
+through the device in fixed-size chunks.
+
+SURVEY.md §7 hard part 4: at SF3K a fact table (and its shuffle) exceeds
+HBM, and the reference gets spill for free from Spark's block shuffle
+(SURVEY.md §2.6). The TPU-native answer here is HOST-STAGED execution:
+
+- big tables live in host RAM only; the device never holds more than
+  ``chunk_rows`` of them at once;
+- phase A (streaming scan): one compiled chunk program per streamed
+  table evaluates every pushed-down scan filter for that table
+  (`plan.Scan.filters`) over each chunk and returns just a keep-bitmap
+  — values never round-trip; the host gathers surviving rows into a
+  reduced table. Filters are re-applied in phase B, so phase A may be
+  conservative (any filter it cannot evaluate keeps all rows);
+- phase B: the UNCHANGED plan executes against the reduced table with
+  the normal static-shape engine — now sized by post-filter survivors,
+  not raw rows.
+
+This bounds device residency by max(chunk, survivors): the engine runs
+any query whose post-filter working set fits HBM, regardless of raw
+table size. (The follow-on stage for full-scan aggregations — partial
+aggregation per chunk with host combine — composes with the same chunk
+loop.)
+
+The per-chunk program is compiled ONCE per (table, plan): every chunk
+has the same static shape; the tail chunk passes its logical row count
+as a traced scalar, not a new shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.engine import device_exec as dx
+from nds_tpu.engine.device_exec import DCtx, DVal
+from nds_tpu.io.host_table import HostColumn, HostTable
+from nds_tpu.sql import plan as P
+
+# stream tables above this many bytes (column data, host-side estimate);
+# the default targets a 16G-HBM chip with headroom for join expansion
+DEFAULT_STREAM_BYTES = 2 << 30
+DEFAULT_CHUNK_ROWS = 1 << 20
+
+
+def _table_bytes(t: HostTable) -> int:
+    total = 0
+    for c in t.columns.values():
+        total += c.values.nbytes
+        if c.null_mask is not None:
+            total += c.null_mask.nbytes
+    return total
+
+
+class _PhaseBExecutor(dx.DeviceExecutor):
+    """Per-plan executor over {full tables, streamed->reduced}: device
+    buffers for NON-streamed tables come from a pool shared across every
+    phase-B executor (dimension columns upload once per session, the
+    load-once/query-many lifecycle), while reduced-table buffers stay
+    local — their contents differ per plan."""
+
+    def __init__(self, tables, float_dtype, shared_buffers: dict,
+                 streamed: set):
+        super().__init__(tables, float_dtype)
+        self._shared = shared_buffers
+        self._streamed = streamed
+
+    def _upload(self, bufs: dict, table: str, name: str) -> None:
+        pool = (self._buffers if table in self._streamed
+                else self._shared)
+        key = f"{table}.{name}"
+        if key not in pool:
+            col = self.tables[table].columns[name]
+            pool[key] = jnp.asarray(col.values)
+            if col.null_mask is not None:
+                pool[key + "#v"] = jnp.asarray(col.null_mask)
+        bufs[key] = pool[key]
+        if key + "#v" in pool:
+            bufs[key + "#v"] = pool[key + "#v"]
+
+
+class ChunkedExecutor(dx.DeviceExecutor):
+    """DeviceExecutor that streams oversized tables through the chip."""
+
+    # phase-B executors kept alive (compiled programs + reduced
+    # buffers); older ones evict so reduced-row HBM doesn't accumulate
+    # across a 99-query power run
+    MAX_REDUCED = 16
+
+    def __init__(self, tables: dict[str, HostTable],
+                 stream_bytes: int = DEFAULT_STREAM_BYTES,
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                 float_dtype=None):
+        super().__init__(tables, float_dtype)
+        self.stream_bytes = stream_bytes
+        self.chunk_rows = chunk_rows
+        # (plan key) -> phase-B executor
+        self._reduced: dict[object, _PhaseBExecutor] = {}
+        # (table, filter repr) -> reduced HostTable, shared across plans
+        self._survivor_cache: dict[tuple, HostTable] = {}
+
+    def _is_streamed(self, table: str) -> bool:
+        return _table_bytes(self.tables[table]) > self.stream_bytes
+
+    # ----------------------------------------------------------------- API
+
+    def execute_async(self, planned: P.PlannedQuery, key: object = None):
+        key = key if key is not None else id(planned)
+        scans = self._streamed_scans(planned)
+        if not scans:
+            return super().execute_async(planned, key)
+        if key not in self._reduced:
+            reduced = {}
+            for table, table_scans in scans.items():
+                reduced[table] = self._reduce_table(table, table_scans)
+            sub = _PhaseBExecutor({**self.tables, **reduced},
+                                  self.float_dtype, self._buffers,
+                                  set(reduced))
+            while len(self._reduced) >= self.MAX_REDUCED:
+                self._reduced.pop(next(iter(self._reduced)))
+            self._reduced[key] = sub
+        sub = self._reduced[key]
+        res = sub.execute_async(planned, key)
+        self.last_timings = sub.last_timings
+        return res
+
+    def _streamed_scans(self, planned: P.PlannedQuery) -> dict:
+        """{table: [Scan, ...]} for streamed tables in this plan."""
+        out: dict[str, list] = {}
+        for root in [planned.root] + list(planned.scalar_subplans):
+            for node in P.walk_plan(root):
+                if isinstance(node, P.Scan) and self._is_streamed(
+                        node.table):
+                    out.setdefault(node.table, []).append(node)
+        return out
+
+    # ------------------------------------------------- phase A: chunk scan
+
+    def _reduce_table(self, table: str, scans: list) -> HostTable:
+        t = self.tables[table]
+        # one reduced table serves every scan of it in the plan: a row
+        # survives if ANY scan's filter conjunction accepts it (each
+        # scan re-applies its own filters in phase B)
+        cache_key = (table, tuple(sorted(
+            repr(s.filters) for s in scans)))
+        hit = self._survivor_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        need_cols = sorted({name for s in scans for name, _ in s.output})
+        keep = self._chunk_keep_mask(table, scans, need_cols)
+        idx = np.nonzero(keep)[0]
+        cols = {}
+        for name in t.columns:
+            c = t.columns[name]
+            cols[name] = HostColumn(
+                c.dtype, c.values[idx], c.dictionary,
+                None if c.null_mask is None else c.null_mask[idx])
+        reduced = HostTable(table, t.schema, cols)
+        self._survivor_cache[cache_key] = reduced
+        return reduced
+
+    def _chunk_keep_mask(self, table: str, scans: list,
+                         need_cols: list) -> np.ndarray:
+        t = self.tables[table]
+        n = t.nrows
+        C = min(self.chunk_rows, max(n, 1))
+        # an EMPTY filter conjunction accepts every row: if any scan of
+        # this table is filterless, no reduction is possible (the one
+        # reduced table serves all scans of it in phase B)
+        if any(not s.filters for s in scans):
+            return np.ones(n, dtype=bool)
+        live_scans = scans
+
+        def fn(bufs, n_valid):
+            base = jnp.arange(C, dtype=jnp.int32) < n_valid
+            keep = jnp.zeros(C, dtype=bool)
+            for scan in live_scans:
+                tr = dx._Trace(self, bufs)
+                ctx = DCtx(C, base)
+                for name, _dt in scan.output:
+                    col = t.columns[name]
+                    lo, hi = self.col_bounds(table, name)
+                    sdict = col.dictionary if col.is_string else None
+                    ctx.cols[(scan.binding, name)] = DVal(
+                        bufs[name], bufs.get(name + "#v"), sdict, lo, hi)
+                for pred in scan.filters:
+                    ctx = tr._apply_filter(ctx, pred)
+                keep = keep | ctx.row
+            return keep
+
+        try:
+            jitted = jax.jit(fn)
+            keep_np = np.empty(n, dtype=bool)
+            for start in range(0, n, C):
+                stop = min(start + C, n)
+                bufs = {}
+                for name in need_cols:
+                    col = t.columns[name]
+                    sl = col.values[start:stop]
+                    m = (None if col.null_mask is None
+                         else col.null_mask[start:stop])
+                    if stop - start < C:  # tail: pad to the chunk shape
+                        pad = C - (stop - start)
+                        sl = np.concatenate(
+                            [sl, np.zeros(pad, dtype=sl.dtype)])
+                        if m is not None:
+                            m = np.concatenate(
+                                [m, np.zeros(pad, dtype=bool)])
+                    bufs[name] = jnp.asarray(sl)
+                    if m is not None:
+                        bufs[name + "#v"] = jnp.asarray(m)
+                keep_np[start:stop] = np.asarray(
+                    jitted(bufs, jnp.int32(stop - start)))[:stop - start]
+            return keep_np
+        except Exception as exc:  # noqa: BLE001 - conservative fallback
+            from nds_tpu.utils.report import TaskFailureCollector
+            TaskFailureCollector.notify(
+                f"chunked scan fell back to full rows for {table}: "
+                f"{type(exc).__name__}: {exc}")
+            return np.ones(n, dtype=bool)
+
+
+def make_chunked_factory(stream_bytes: int = DEFAULT_STREAM_BYTES,
+                         chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                         precision: str = "f64"):
+    """Session executor factory (make_device_factory analog) for the
+    out-of-core engine."""
+    if precision not in dx.PRECISIONS:
+        raise ValueError(f"unknown engine.precision {precision!r}")
+    name = dx.PRECISIONS[precision]
+    float_dtype = None if name is None else getattr(jnp, name)
+    holder: dict = {}
+
+    def factory(tables):
+        ex = holder.get("ex")
+        if ex is None or ex.tables is not tables:
+            ex = ChunkedExecutor(tables, stream_bytes, chunk_rows,
+                                 float_dtype)
+            holder["ex"] = ex
+        return ex
+
+    factory.invalidate = holder.clear
+    return factory

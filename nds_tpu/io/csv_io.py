@@ -166,6 +166,95 @@ def read_parquet(paths: list[str] | str, name: str, schema: Schema) -> HostTable
     return from_arrow(name, schema, pa.concat_tables(tables, promote_options="permissive"))
 
 
+# warehouse output formats beyond parquet (the reference's transcode
+# writes parquet/orc/avro/json, `nds/nds_transcode.py:69-152`; avro has
+# no codec in this image and raises with that message)
+FORMAT_EXT = {"parquet": ".parquet", "orc": ".orc", "json": ".json",
+              "avro": ".avro"}
+
+
+def write_arrow(t: pa.Table, path: str, fmt: str = "parquet",
+                compression: str = "snappy") -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if fmt == "parquet":
+        pq.write_table(t, path, compression=compression,
+                       row_group_size=1 << 20)
+    elif fmt == "orc":
+        import pyarrow.orc as paorc
+        cols = []
+        for i, f in enumerate(t.schema):
+            c = t.column(i)
+            if pa.types.is_dictionary(f.type):
+                c = c.cast(pa.string())
+            cols.append(c)
+        paorc.write_table(pa.Table.from_arrays(cols,
+                                               names=t.column_names),
+                          path, compression=compression)
+    elif fmt == "json":
+        # JSON-lines records, the layout pyarrow.json reads back; dates
+        # as ISO strings, decimals as exact decimal strings
+        import json as _json
+        with open(path, "w") as f:
+            for row in t.to_pylist():
+                f.write(_json.dumps(row, default=str) + "\n")
+    elif fmt == "avro":
+        raise ValueError(
+            "avro output needs an avro codec, which is not available in "
+            "this environment (reference parity: nds/nds_transcode.py:79)")
+    else:
+        raise ValueError(f"unknown output format {fmt!r}")
+
+
+def write_table(table: HostTable, path: str, fmt: str = "parquet",
+                compression: str = "snappy") -> None:
+    write_arrow(to_arrow(table), path, fmt, compression)
+
+
+def read_table_fmt(paths: list[str] | str, name: str, schema: Schema,
+                   fmt: str) -> HostTable:
+    """Read a warehouse table written by ``write_table`` in any format."""
+    if fmt == "parquet":
+        return read_parquet(paths, name, schema)
+    if isinstance(paths, str):
+        paths = [paths]
+    if fmt == "orc":
+        import pyarrow.orc as paorc
+        tables = [paorc.read_table(p) for p in paths]
+        return from_arrow(name, schema,
+                          pa.concat_tables(tables,
+                                           promote_options="permissive"))
+    if fmt == "json":
+        import pyarrow.json as pajson
+        # dates and decimals are ISO/decimal STRINGS in the json lines
+        # (json has no such types); read as string, cast after
+        read_types, casts = {}, {}
+        for f in schema:
+            t = _arrow_read_type(f.dtype)
+            if isinstance(f.dtype, (DateType, DecimalType)):
+                read_types[f.name] = pa.string()
+                casts[f.name] = t
+            else:
+                read_types[f.name] = t
+        want = pa.schema(read_types)
+        tables = []
+        for p in paths:
+            t = pajson.read_json(
+                p, parse_options=pajson.ParseOptions(
+                    explicit_schema=want))
+            cols = []
+            for i, fld in enumerate(t.schema):
+                c = t.column(i)
+                if fld.name in casts:
+                    c = c.cast(casts[fld.name])
+                cols.append(c)
+            tables.append(pa.Table.from_arrays(
+                cols, names=t.column_names))
+        return from_arrow(name, schema,
+                          pa.concat_tables(tables,
+                                           promote_options="permissive"))
+    raise ValueError(f"unknown input format {fmt!r}")
+
+
 def write_tbl(arrays: dict[str, np.ndarray], schema: Schema, path: str,
               trailing_delimiter: bool = True) -> None:
     """Write generator output in dbgen's .tbl text format (for parity with

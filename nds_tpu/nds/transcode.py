@@ -47,9 +47,11 @@ def _raw_paths(input_dir: str, name: str) -> list[str]:
 
 def transcode_table(name, schema, input_dir: str, output_dir: str,
                     compression: str = "snappy",
-                    partition: bool = True) -> float:
+                    partition: bool = True,
+                    output_format: str = "parquet") -> float:
     t0 = time.perf_counter()
     table = csv_io.read_tbl(_raw_paths(input_dir, name), name, schema)
+    ext = csv_io.FORMAT_EXT[output_format]
     part_col = TABLE_PARTITIONING.get(name) if partition else None
     if part_col and table.nrows:
         col = table.column(part_col)
@@ -66,20 +68,20 @@ def transcode_table(name, schema, input_dir: str, output_dir: str,
             label = "__HIVE_DEFAULT_PARTITION__" if b < 0 else str(
                 int(b) * 30)
             out = os.path.join(output_dir, name, f"{part_col}={label}",
-                               "part-0.parquet")
-            os.makedirs(os.path.dirname(out), exist_ok=True)
-            import pyarrow.parquet as pq
-            pq.write_table(sub, out, compression=compression)
+                               f"part-0{ext}")
+            csv_io.write_arrow(sub, out, output_format, compression)
     else:
-        out = os.path.join(output_dir, name, "part-0.parquet")
-        csv_io.write_parquet(table, out, compression=compression)
+        out = os.path.join(output_dir, name, f"part-0{ext}")
+        csv_io.write_table(table, out, output_format,
+                           compression=compression)
     return time.perf_counter() - t0
 
 
 def transcode(input_dir: str, output_dir: str, report_path: str,
               tables: list[str] | None = None,
               compression: str = "snappy", update: bool = False,
-              use_decimal: bool = True, partition: bool = True) -> dict:
+              use_decimal: bool = True, partition: bool = True,
+              output_format: str = "parquet") -> dict:
     schemas = (get_maintenance_schemas(use_decimal) if update
                else get_schemas(use_decimal))
     if tables:
@@ -91,7 +93,8 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
     timings = {}
     for name, schema in schemas.items():
         timings[name] = transcode_table(
-            name, schema, input_dir, output_dir, compression, partition)
+            name, schema, input_dir, output_dir, compression, partition,
+            output_format)
         print(f"Time taken: {timings[name]:.3f} s for table {name}")
     load_end = int(time.time())
     report = ["Total conversion time for %d tables was %.3fs" % (
@@ -107,21 +110,8 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
     return timings
 
 
-def get_rngseed(report_path: str) -> int:
-    """Parse the RNGSEED back out of a load report
-    (`nds/nds_bench.py:60-74` contract)."""
-    with open(report_path) as f:
-        for line in f:
-            if line.startswith("RNGSEED used:"):
-                return int(line.split(":")[1].strip())
-    raise ValueError(f"no RNGSEED in {report_path}")
-
-
-def get_load_time(report_path: str) -> float:
-    """Total load seconds from the report header line."""
-    with open(report_path) as f:
-        first = f.readline()
-    return float(first.rstrip("s\n").split()[-1].rstrip("s"))
+# anchored report parsing, shared with NDS-H (`nds/nds_bench.py:60-89`)
+from nds_tpu.utils.loadreport import get_load_time, get_rngseed  # noqa: E402,F401
 
 
 def main(argv=None) -> None:
@@ -138,11 +128,17 @@ def main(argv=None) -> None:
     p.add_argument("--no_partition", action="store_true",
                    help="disable fact date partitioning")
     p.add_argument("--compression", default="snappy")
+    p.add_argument("--output_format", default="parquet",
+                   choices=["parquet", "orc", "json", "avro"],
+                   help="warehouse file format "
+                        "(`nds/nds_transcode.py:69-152`; avro raises — "
+                        "no codec in this environment)")
     args = p.parse_args(argv)
     transcode(args.input_dir, args.output_dir, args.report_file,
               args.tables, args.compression, update=args.update,
               use_decimal=not args.floats,
-              partition=not args.no_partition)
+              partition=not args.no_partition,
+              output_format=args.output_format)
 
 
 if __name__ == "__main__":

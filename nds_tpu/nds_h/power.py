@@ -84,8 +84,12 @@ def main(argv=None) -> None:
                    default=None,
                    help="overrides engine.backend from template/property "
                         "files (default tpu)")
-    p.add_argument("--input_format", choices=["parquet", "raw"],
+    p.add_argument("--input_format",
+                   choices=["parquet", "orc", "json", "raw"],
                    default="parquet")
+    p.add_argument("--extra_time_log",
+                   help="write a second copy of the CSV time log here "
+                        "(`nds/nds_power.py:305-308`)")
     p.add_argument("--json_summary_folder",
                    help="folder for per-query JSON summaries")
     p.add_argument("--output_prefix",
@@ -105,7 +109,8 @@ def main(argv=None) -> None:
         config=config, input_format=args.input_format,
         json_summary_folder=args.json_summary_folder,
         output_prefix=args.output_prefix, warmup=args.warmup,
-        profile_dir=args.profile_dir)
+        profile_dir=args.profile_dir,
+        extra_time_log=args.extra_time_log)
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 
 

@@ -23,7 +23,8 @@ from nds_tpu.nds_h.schema import get_schemas
 
 
 def transcode_table(name, schema, input_dir: str, output_dir: str,
-                    compression: str = "snappy") -> float:
+                    compression: str = "snappy",
+                    output_format: str = "parquet") -> float:
     t0 = time.perf_counter()
     tdir = os.path.join(input_dir, name)
     if os.path.isdir(tdir):
@@ -33,14 +34,16 @@ def transcode_table(name, schema, input_dir: str, output_dir: str,
         single = os.path.join(input_dir, f"{name}.tbl")
         paths = [single]
     table = csv_io.read_tbl(paths, name, schema)
-    out = os.path.join(output_dir, name, "part-0.parquet")
-    csv_io.write_parquet(table, out, compression=compression)
+    ext = csv_io.FORMAT_EXT[output_format]
+    out = os.path.join(output_dir, name, f"part-0{ext}")
+    csv_io.write_table(table, out, output_format, compression=compression)
     return time.perf_counter() - t0
 
 
 def transcode(input_dir: str, output_dir: str, report_path: str,
               tables: list[str] | None = None,
-              compression: str = "snappy") -> dict:
+              compression: str = "snappy",
+              output_format: str = "parquet") -> dict:
     schemas = get_schemas()
     if tables:
         unknown = set(tables) - set(schemas)
@@ -51,7 +54,8 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
     timings = {}
     for name, schema in schemas.items():
         timings[name] = transcode_table(
-            name, schema, input_dir, output_dir, compression)
+            name, schema, input_dir, output_dir, compression,
+            output_format)
         print(f"Time taken: {timings[name]:.3f} s for table {name}")
     load_end = int(time.time())
     report = ["Total conversion time for %d tables was %.3fs" % (
@@ -67,21 +71,8 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
     return timings
 
 
-def get_rngseed(report_path: str) -> int:
-    """Parse the RNGSEED back out of a load report
-    (`nds/nds_bench.py:60-74` contract)."""
-    with open(report_path) as f:
-        for line in f:
-            if line.startswith("RNGSEED used:"):
-                return int(line.split(":")[1].strip())
-    raise ValueError(f"no RNGSEED in {report_path}")
-
-
-def get_load_time(report_path: str) -> float:
-    """Total load seconds from the report header line."""
-    with open(report_path) as f:
-        first = f.readline()
-    return float(first.rstrip("s\n").split()[-1].rstrip("s"))
+# anchored report parsing, shared with NDS (`nds/nds_bench.py:60-89`)
+from nds_tpu.utils.loadreport import get_load_time, get_rngseed  # noqa: E402,F401
 
 
 def main(argv=None) -> None:
@@ -92,9 +83,15 @@ def main(argv=None) -> None:
     p.add_argument("report_file", help="load-report text file")
     p.add_argument("--tables", nargs="+", help="subset of tables")
     p.add_argument("--compression", default="snappy")
+    p.add_argument("--output_format", default="parquet",
+                   choices=["parquet", "orc", "json", "avro"],
+                   help="warehouse file format "
+                        "(`nds/nds_transcode.py:69-152`; avro raises — "
+                        "no codec in this environment)")
     args = p.parse_args(argv)
     transcode(args.input_dir, args.output_dir, args.report_file,
-              args.tables, args.compression)
+              args.tables, args.compression,
+              output_format=args.output_format)
 
 
 if __name__ == "__main__":

@@ -18,7 +18,13 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
   engine.concurrent_tasks   async dispatch depth (analog of
                             spark.rapids.sql.concurrentGpuTasks,
                             nds/power_run_gpu.template:38)
-  engine.precision          bf16|f32 for float mode on-device compute
+  engine.precision          f64|f32|bf16 float compute dtype in floats
+                            mode (f64 default matches the CPU oracle;
+                            f32/bf16 run native-speed on the VPU)
+  engine.stream_bytes       tables above this many bytes stream through
+                            the device in chunks instead of uploading
+                            whole (out-of-core path; 0 = off)
+  engine.chunk_rows         rows per streamed chunk
 """
 
 from __future__ import annotations

@@ -67,14 +67,24 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
         from nds_tpu.utils.xla_cache import enable as enable_xla_cache
         enable_xla_cache()
     if backend == "tpu":
-        from nds_tpu.engine.device_exec import make_device_factory
         # engine.precision only applies in floats mode: decimal mode's
         # scaled-int arithmetic must stay exact (the reference's
         # variableFloatAgg knob is likewise float-mode-only)
         precision = "f64"
         if config.get_bool("engine.floats"):
             precision = config.get("engine.precision", "f64")
-        factory = make_device_factory(precision)
+        stream_bytes = config.get_int("engine.stream_bytes", 0)
+        if stream_bytes > 0:
+            # out-of-core: oversized tables chunk-stream through HBM
+            from nds_tpu.engine.chunked_exec import make_chunked_factory
+            from nds_tpu.engine.chunked_exec import DEFAULT_CHUNK_ROWS
+            factory = make_chunked_factory(
+                stream_bytes,
+                config.get_int("engine.chunk_rows", DEFAULT_CHUNK_ROWS),
+                precision)
+        else:
+            from nds_tpu.engine.device_exec import make_device_factory
+            factory = make_device_factory(precision)
     elif backend == "distributed":
         from nds_tpu.parallel.dist_exec import make_distributed_factory
         from nds_tpu.parallel.mesh import make_mesh
@@ -106,7 +116,8 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
             continue
         t0 = time.perf_counter()
         tdir = os.path.join(data_dir, name)
-        if fmt == "parquet":
+        if fmt in csv_io.FORMAT_EXT:
+            ext = csv_io.FORMAT_EXT[fmt]
             if log is not None and os.path.isdir(tdir):
                 # versioned warehouse: the snapshot manifest names the
                 # live files (maintenance commits new versions)
@@ -116,10 +127,10 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
                 paths = sorted(
                     os.path.join(root, f)
                     for root, _dirs, files in os.walk(tdir)
-                    for f in files if f.endswith(".parquet"))
+                    for f in files if f.endswith(ext))
             else:
-                paths = [os.path.join(data_dir, f"{name}.parquet")]
-            table = csv_io.read_parquet(paths, name, schema)
+                paths = [os.path.join(data_dir, f"{name}{ext}")]
+            table = csv_io.read_table_fmt(paths, name, schema, fmt)
         elif fmt == "raw":
             if os.path.isdir(tdir):
                 paths = sorted(
@@ -152,7 +163,8 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                      output_prefix: str | None = None,
                      warmup: int = 0,
                      query_subset: list[str] | None = None,
-                     profile_dir: str | None = None) -> int:
+                     profile_dir: str | None = None,
+                     extra_time_log: str | None = None) -> int:
     """The power loop (`nds/nds_power.py:184-322`): every query runs
     regardless of earlier failures (the reference never aborts
     mid-stream; ``--allow_failure`` only downgrades the exit code,
@@ -232,6 +244,11 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     total_ms = int((time.perf_counter() - total_start) * 1000)
     tlog.add("Total Time", total_ms)
     tlog.write(time_log_path)
+    if extra_time_log:
+        # second copy of the time log, e.g. on shared storage — the
+        # reference's --extra_time_log writes the same rows via Spark to
+        # a cloud path (`nds/nds_power.py:305-308`)
+        tlog.write(extra_time_log)
     print(f"Power Test Time: {power_ms} millis")
     return failures
 

@@ -23,6 +23,8 @@ class TimeLog:
         self.rows.append([self.app_id, query_name, int(millis)])
 
     def write(self, path: str) -> None:
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
             w.writerow(HEADER)

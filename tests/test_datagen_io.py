@@ -97,6 +97,32 @@ class TestIO:
         assert list(back.column("o_orderpriority").decode()[:5]) == \
             list(ht.column("o_orderpriority").decode()[:5])
 
+    @pytest.mark.parametrize("fmt", ["orc", "json"])
+    def test_format_roundtrip(self, tmp_path, schemas, fmt):
+        """Non-parquet warehouse formats (`nds/nds_transcode.py:69-152`
+        writes parquet/orc/avro/json; avro has no codec here)."""
+        arrays = tpch.gen_table("orders", SF, 4, 1)
+        schema = schemas["orders"]
+        ht = from_arrays("orders", schema, arrays)
+        p = str(tmp_path / ("orders" + csv_io.FORMAT_EXT[fmt]))
+        csv_io.write_table(ht, p, fmt)
+        back = csv_io.read_table_fmt(p, "orders", schema, fmt)
+        assert back.nrows == ht.nrows
+        assert np.array_equal(back.column("o_orderkey").values,
+                              ht.column("o_orderkey").values)
+        assert np.array_equal(back.column("o_totalprice").values,
+                              ht.column("o_totalprice").values)
+        assert np.array_equal(back.column("o_orderdate").values,
+                              ht.column("o_orderdate").values)
+        assert list(back.column("o_orderpriority").decode()[:5]) == \
+            list(ht.column("o_orderpriority").decode()[:5])
+
+    def test_avro_raises_clearly(self, tmp_path, schemas):
+        ht = from_arrays("orders", schemas["orders"],
+                         tpch.gen_table("orders", SF, 4, 1))
+        with pytest.raises(ValueError, match="avro"):
+            csv_io.write_table(ht, str(tmp_path / "o.avro"), "avro")
+
     def test_string_codes_sorted(self, schemas):
         arrays = tpch.gen_table("customer", SF, 8, 3)
         ht = from_arrays("customer", schemas["customer"], arrays)

@@ -146,6 +146,43 @@ class TestTranscode:
         transcode.transcode(refresh_raw, wh2, rep, update=True)
         assert os.path.isdir(os.path.join(wh2, "s_purchase"))
 
+    def test_drifted_report_raises(self, tmp_path):
+        """Anchored parse: a report whose header drifted must raise, not
+        return a silently-wrong float."""
+        bad = str(tmp_path / "bad.txt")
+        with open(bad, "w") as f:
+            f.write("Conversion finished in about 12s maybe\n")
+        with pytest.raises(ValueError):
+            transcode.get_load_time(bad)
+        with pytest.raises(ValueError):
+            transcode.get_rngseed(bad)
+
+    def test_orc_warehouse_end_to_end(self, pipeline, tmp_path):
+        """--output_format orc -> power --input_format orc matches the
+        parquet-warehouse results (`nds/nds_transcode.py:69-152` format
+        breadth)."""
+        wh_orc = str(tmp_path / "wh_orc")
+        rep = str(tmp_path / "rep_orc.txt")
+        tables = ["store_sales", "date_dim", "time_dim", "store",
+                  "household_demographics"]
+        transcode.transcode(pipeline["raw"], wh_orc, rep, tables=tables,
+                            output_format="orc")
+        ssdir = os.path.join(wh_orc, "store_sales")
+        assert any(f.endswith(".orc") for _r, _d, fs in os.walk(ssdir)
+                   for f in fs)
+        from nds_tpu.nds.power import SUITE
+        cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+        sess_orc = power_core.make_session(SUITE, cfg)
+        power_core.load_warehouse(SUITE, sess_orc, wh_orc, "orc",
+                                  tables=tables)
+        sess_pq = power_core.make_session(SUITE, cfg)
+        power_core.load_warehouse(SUITE, sess_pq, pipeline["wh"],
+                                  "parquet", tables=tables)
+        sql = streams.render_query(96)
+        exp = sess_pq.sql(sql).to_pandas()
+        got = sess_orc.sql(sql).to_pandas()
+        assert got.equals(exp)
+
 
 class TestPowerRun:
     def test_cpu_power_subset_and_validate(self, pipeline, tmp_path):
@@ -171,6 +208,19 @@ class TestPowerRun:
             summary = json.load(f)
         assert summary["env"]["engineConf"]["engine.backend"] == "cpu"
         assert summary["queryStatus"] == ["Completed"]
+
+    def test_extra_time_log(self, pipeline, tmp_path):
+        """--extra_time_log writes a second identical copy of the CSV
+        time log (`nds/nds_power.py:305-308`)."""
+        from nds_tpu.nds.power import SUITE
+        cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+        tlog = str(tmp_path / "t.csv")
+        extra = str(tmp_path / "remote" / "t_extra.csv")
+        failures = power_core.run_query_stream(
+            SUITE, pipeline["wh"], pipeline["stream"], tlog, config=cfg,
+            query_subset=["query96"], extra_time_log=extra)
+        assert failures == 0
+        assert open(extra).read() == open(tlog).read()
 
     def test_failure_never_aborts_the_stream(self, pipeline, tmp_path):
         """The reference runs every query regardless of failures; only
@@ -369,6 +419,23 @@ class TestToolwrap:
         assert os.listdir(d / "date_dim") == ["date_dim.dat"]
         assert os.listdir(d / "lineitem") == ["lineitem.tbl.3"]
         assert os.listdir(d / "web_site") == ["web_site_1_4.dat"]
+
+
+def test_external_dsqgen_streams(tmp_path):
+    """The licensed-tool path (`toolwrap.run_dsqgen`): exercised only
+    when a built dsdgen/dsqgen kit is present. Recorded as SKIPPED when
+    absent — the TPC tools are licensed and never vendored
+    (SURVEY.md §2.4 licensing note)."""
+    from nds_tpu.datagen import toolwrap
+    tools = os.environ.get("NDS_TPCDS_TOOLS")
+    dsqgen = os.path.join(tools, "dsqgen") if tools else None
+    if not (dsqgen and os.path.isfile(dsqgen)):
+        pytest.skip("licensed TPC-DS toolkit not present "
+                    "(set NDS_TPCDS_TOOLS to its tools/ dir)")
+    out = str(tmp_path / "streams")
+    toolwrap.run_dsqgen(dsqgen, os.path.join(tools, "..", "query_templates"),
+                        out, scale=1, streams=2)
+    assert os.path.isfile(os.path.join(out, "query_0.sql"))
 
 
 def test_dbgen_version_layout(tmp_path):
