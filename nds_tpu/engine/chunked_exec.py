@@ -151,14 +151,24 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return hit
         need_cols = sorted({name for s in scans for name, _ in s.output})
         keep = self._chunk_keep_mask(table, scans, need_cols)
-        idx = np.nonzero(keep)[0]
-        cols = {}
-        for name in t.columns:
-            c = t.columns[name]
-            cols[name] = HostColumn(
-                c.dtype, c.values[idx], c.dictionary,
-                None if c.null_mask is None else c.null_mask[idx])
-        reduced = HostTable(table, t.schema, cols)
+        if keep.all():
+            # zero reduction (filterless scan / fallback): the original
+            # table IS the result — no multi-GB host copy
+            reduced = t
+        else:
+            idx = np.nonzero(keep)[0]
+            cols = {}
+            for name in t.columns:
+                c = t.columns[name]
+                cols[name] = HostColumn(
+                    c.dtype, c.values[idx], c.dictionary,
+                    None if c.null_mask is None else c.null_mask[idx])
+            reduced = HostTable(table, t.schema, cols)
+        # bounded like _reduced: host RAM for survivor copies must not
+        # accumulate across a 99-query run (live phase-B executors keep
+        # their own references; eviction only drops the shared entry)
+        while len(self._survivor_cache) >= self.MAX_REDUCED:
+            self._survivor_cache.pop(next(iter(self._survivor_cache)))
         self._survivor_cache[cache_key] = reduced
         return reduced
 
@@ -174,6 +184,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return np.ones(n, dtype=bool)
         live_scans = scans
 
+        skipped: list = []
+
         def fn(bufs, n_valid):
             base = jnp.arange(C, dtype=jnp.int32) < n_valid
             keep = jnp.zeros(C, dtype=bool)
@@ -187,7 +199,15 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     ctx.cols[(scan.binding, name)] = DVal(
                         bufs[name], bufs.get(name + "#v"), sdict, lo, hi)
                 for pred in scan.filters:
-                    ctx = tr._apply_filter(ctx, pred)
+                    # PER-PREDICATE fallback: a filter the chunk
+                    # program cannot evaluate (e.g. it references a
+                    # scalar-subquery result, q32/q92 shape) is simply
+                    # skipped — the other predicates (date ranges!)
+                    # still reduce, and phase B re-applies everything
+                    try:
+                        ctx = tr._apply_filter(ctx, pred)
+                    except Exception as exc:  # noqa: BLE001
+                        skipped.append((pred, exc))
                 keep = keep | ctx.row
             return keep
 
@@ -214,6 +234,12 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         bufs[name + "#v"] = jnp.asarray(m)
                 keep_np[start:stop] = np.asarray(
                     jitted(bufs, jnp.int32(stop - start)))[:stop - start]
+            if skipped:
+                from nds_tpu.utils.report import TaskFailureCollector
+                TaskFailureCollector.notify(
+                    f"chunked scan of {table}: {len(skipped)} filter(s) "
+                    f"not chunk-evaluable, re-applied in phase B only "
+                    f"({type(skipped[0][1]).__name__})")
             return keep_np
         except Exception as exc:  # noqa: BLE001 - conservative fallback
             from nds_tpu.utils.report import TaskFailureCollector

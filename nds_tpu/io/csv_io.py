@@ -154,9 +154,8 @@ def to_arrow(table: HostTable) -> pa.Table:
 
 def write_parquet(table: HostTable, path: str, compression: str = "snappy",
                   row_group_rows: int = 1 << 20) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    pq.write_table(to_arrow(table), path, compression=compression,
-                   row_group_size=row_group_rows)
+    write_arrow(to_arrow(table), path, "parquet", compression,
+                row_group_rows)
 
 
 def read_parquet(paths: list[str] | str, name: str, schema: Schema) -> HostTable:
@@ -174,11 +173,12 @@ FORMAT_EXT = {"parquet": ".parquet", "orc": ".orc", "json": ".json",
 
 
 def write_arrow(t: pa.Table, path: str, fmt: str = "parquet",
-                compression: str = "snappy") -> None:
+                compression: str = "snappy",
+                row_group_rows: int = 1 << 20) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if fmt == "parquet":
         pq.write_table(t, path, compression=compression,
-                       row_group_size=1 << 20)
+                       row_group_size=row_group_rows)
     elif fmt == "orc":
         import pyarrow.orc as paorc
         cols = []

@@ -105,7 +105,7 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
                 err = exc
         if err is not None:
             import traceback
-            traceback.print_exception(err)
+            traceback.print_exception(type(err), err, err.__traceback__)
             s["failures"] += 1
         done = time.time()
         # dispatch->result bracket; queue wait from pipelining is

@@ -69,9 +69,15 @@ class DistributedExecutor(dx.DeviceExecutor):
                  n_devices: int | None = None,
                  shard_tables: set[str] | None = None,
                  shard_threshold: int = DEFAULT_SHARD_THRESHOLD,
-                 slack: float = 2.0):
+                 slack: float = 2.0,
+                 multiprocess: bool | None = None):
         super().__init__(tables)
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        # multi-controller SPMD (one process per host): buffers must be
+        # GLOBAL jax.Arrays, each process materializing only the shards
+        # its devices own (parallel.multihost). Auto-detected.
+        self.multiprocess = (jax.process_count() > 1
+                             if multiprocess is None else multiprocess)
         self.n_dev = int(np.prod(self.mesh.devices.shape))
         # 2-D (host, lane) mesh: collectives span BOTH axes; the
         # exchange runs its hierarchical DCN-then-ICI form
@@ -96,13 +102,24 @@ class DistributedExecutor(dx.DeviceExecutor):
             return table in self._explicit_shard
         return self.tables[table].nrows >= self.shard_threshold
 
+    def _dev(self, arr: np.ndarray, sharded: bool):
+        """Host array -> device buffer. Single-process: plain upload
+        (jit lays it out). Multi-process: a global jax.Array built
+        shard-by-shard so each host only holds its own rows."""
+        if not self.multiprocess:
+            return jnp.asarray(arr)
+        from nds_tpu.parallel.multihost import make_global_array
+        spec = P_(self.axes) if sharded else P_()
+        return make_global_array(self.mesh, spec, np.asarray(arr))
+
     # buffers: sharded tables pad to a multiple of n_dev
     def _upload(self, bufs: dict, table: str, name: str) -> None:
         key = f"{table}.{name}"
         if key not in self._buffers:
             col = self.tables[table].columns[name]
             vals = col.values
-            if self._is_sharded(table):
+            sharded = self._is_sharded(table)
+            if sharded:
                 cap = pad_to_multiple(max(len(vals), self.n_dev),
                                       self.n_dev)
                 pad = cap - len(vals)
@@ -112,10 +129,11 @@ class DistributedExecutor(dx.DeviceExecutor):
                 if col.null_mask is not None:
                     m = np.concatenate(
                         [col.null_mask, np.zeros(pad, dtype=bool)])
-                    self._buffers[key + "#v"] = jnp.asarray(m)
+                    self._buffers[key + "#v"] = self._dev(m, True)
             elif col.null_mask is not None:
-                self._buffers[key + "#v"] = jnp.asarray(col.null_mask)
-            self._buffers[key] = jnp.asarray(vals)
+                self._buffers[key + "#v"] = self._dev(
+                    col.null_mask, False)
+            self._buffers[key] = self._dev(vals, sharded)
         bufs[key] = self._buffers[key]
         if key + "#v" in self._buffers:
             bufs[key + "#v"] = self._buffers[key + "#v"]
@@ -564,7 +582,8 @@ class _DistTrace(dx._Trace):
 
 def make_distributed_factory(mesh=None, n_devices=None,
                              shard_tables=None,
-                             shard_threshold=DEFAULT_SHARD_THRESHOLD):
+                             shard_threshold=DEFAULT_SHARD_THRESHOLD,
+                             multiprocess=None):
     """Session executor factory for the distributed engine (one executor
     per table registry, like `device_exec.make_device_factory`)."""
     holder: dict = {}
@@ -575,7 +594,8 @@ def make_distributed_factory(mesh=None, n_devices=None,
             ex = DistributedExecutor(
                 tables, mesh=mesh, n_devices=n_devices,
                 shard_tables=shard_tables,
-                shard_threshold=shard_threshold)
+                shard_threshold=shard_threshold,
+                multiprocess=multiprocess)
             holder["ex"] = ex
         return ex
 

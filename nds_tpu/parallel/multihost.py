@@ -1,0 +1,97 @@
+"""Multi-host (multi-process) runtime entry point.
+
+The reference scales out by Spark executor topology — a static config of
+instances x cores on a cluster (`nds/base.template:29-31`). The
+TPU-native equivalent is jax's multi-controller SPMD runtime: every host
+runs the SAME driver process, `jax.distributed.initialize` wires them
+into one global device world (gRPC coordination over DCN), and the
+engine's shard_map programs span the global mesh — XLA routes
+collectives over ICI within a slice and DCN across slices.
+
+Launch contract (env-driven, one process per host):
+
+    NDS_TPU_COORDINATOR=host0:12355   coordinator address
+    NDS_TPU_NUM_PROCESSES=4           world size
+    NDS_TPU_PROCESS_ID=0..3           this process's rank
+
+On a real TPU pod slice all three are auto-detected by jax and may be
+omitted. ``python -m nds_tpu.nds.power --backend distributed`` calls
+``maybe_initialize()`` at session construction, so the same CLI works
+single-process (no env vars, virtual or single-chip mesh) and
+multi-process (env vars set by the launcher) — the analog of the same
+spark-submit working on local[*] and a cluster.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+_initialized = False
+
+
+def maybe_initialize() -> bool:
+    """Initialize jax's distributed runtime when the env asks for it
+    (idempotent — jax.distributed.initialize may run only once per
+    process, and one driver builds several sessions, e.g. maintenance
+    then power). Returns True when running multi-process."""
+    global _initialized
+    import jax
+    coord = os.environ.get("NDS_TPU_COORDINATOR")
+    nproc = os.environ.get("NDS_TPU_NUM_PROCESSES")
+    if coord and nproc and int(nproc) > 1 and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(os.environ.get("NDS_TPU_PROCESS_ID", "0")))
+        _initialized = True
+        return True
+    return jax.process_count() > 1
+
+
+def is_primary() -> bool:
+    """True on the process that owns report/log writing (rank 0) —
+    every process computes, one records (the reference's driver/executor
+    split collapses to rank-0-writes in multi-controller SPMD)."""
+    import jax
+    return jax.process_index() == 0
+
+
+def global_mesh(shards: int | None = None):
+    """1-D data mesh over the GLOBAL device world (all processes).
+
+    Single-process: ``shards`` restricts the mesh to that many devices
+    (validated). Multi-process: the mesh must span every process's
+    devices — a device subset would leave some ranks with nothing
+    addressable at the first collective — so any ``shards`` other than
+    the world size is an error, not a silent slice."""
+    import jax
+    from nds_tpu.parallel.mesh import make_mesh
+    devices = jax.devices()
+    if jax.process_count() > 1:
+        if shards not in (None, len(devices)):
+            raise ValueError(
+                f"engine.mesh.shards={shards} but the multi-process "
+                f"world has {len(devices)} devices; the mesh must span "
+                f"all of them (omit the knob or set it to "
+                f"{len(devices)})")
+        return make_mesh(devices=devices)
+    return make_mesh(shards if shards and shards > 1 else None)
+
+
+def make_global_array(mesh, spec, full_value: np.ndarray):
+    """Build a global jax.Array laid out per (mesh, spec) from host data.
+
+    Per-host shard loading: the callback materializes ONLY the global
+    row ranges owned by this process's addressable devices — a host
+    never holds device buffers for rows another host owns. (Row-range
+    -> parquet-file mapping lets the IO layer skip reading forever-
+    remote rows; device memory is the contract enforced here.)
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        full_value.shape, sharding, lambda idx: full_value[idx])

@@ -86,9 +86,14 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
             from nds_tpu.engine.device_exec import make_device_factory
             factory = make_device_factory(precision)
     elif backend == "distributed":
+        from nds_tpu.parallel import multihost
         from nds_tpu.parallel.dist_exec import make_distributed_factory
-        from nds_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh(config.get_int("engine.mesh.shards", 1))
+        # env-driven multi-process launch (NDS_TPU_COORDINATOR et al.):
+        # every host runs this same driver; the mesh spans the global
+        # device world after jax.distributed.initialize
+        multihost.maybe_initialize()
+        shards = config.get_int("engine.mesh.shards", 0)
+        mesh = multihost.global_mesh(shards if shards > 1 else None)
         factory = make_distributed_factory(mesh=mesh)
     elif backend == "cpu":
         factory = None
@@ -173,6 +178,13 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     config = config or EngineConfig()
     session = make_session(suite, config)
     backend = config.get("engine.backend", "cpu")
+    # multi-controller SPMD: every process computes every query, rank 0
+    # records (reports/time logs/result files would otherwise collide
+    # on shared storage)
+    primary = True
+    if backend == "distributed":
+        from nds_tpu.parallel.multihost import is_primary
+        primary = is_primary()
     app_id = f"{suite.name}-tpu-{backend}-{int(time.time())}"
     tlog = TimeLog(app_id)
     total_start = time.perf_counter()
@@ -207,14 +219,15 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                 except Exception:
                     break
         report = BenchReport(qname, config.as_dict())
+        out_pref = output_prefix if primary else None
         if profiler_cm:
             import jax
             with jax.profiler.TraceAnnotation(qname):
                 summary = report.report_on(run_one_query, session, sql,
-                                           qname, output_prefix)
+                                           qname, out_pref)
         else:
             summary = report.report_on(run_one_query, session, sql,
-                                       qname, output_prefix)
+                                       qname, out_pref)
         # engine-side perf accounting: compile vs execute vs
         # device->host materialization (device backends expose
         # last_timings; the CPU oracle has none)
@@ -229,7 +242,7 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         print(f"Time taken: {elapsed_ms} millis for {qname}")
         if not report.is_success():
             failures += 1
-        if json_summary_folder:
+        if json_summary_folder and primary:
             cwd = os.getcwd()
             os.chdir(json_summary_folder)
             try:
@@ -243,12 +256,13 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     tlog.add("Power Test Time", power_ms)
     total_ms = int((time.perf_counter() - total_start) * 1000)
     tlog.add("Total Time", total_ms)
-    tlog.write(time_log_path)
-    if extra_time_log:
-        # second copy of the time log, e.g. on shared storage — the
-        # reference's --extra_time_log writes the same rows via Spark to
-        # a cloud path (`nds/nds_power.py:305-308`)
-        tlog.write(extra_time_log)
+    if primary:
+        tlog.write(time_log_path)
+        if extra_time_log:
+            # second copy of the time log, e.g. on shared storage — the
+            # reference's --extra_time_log writes the same rows via
+            # Spark to a cloud path (`nds/nds_power.py:305-308`)
+            tlog.write(extra_time_log)
     print(f"Power Test Time: {power_ms} millis")
     return failures
 

@@ -6,6 +6,8 @@ executes for real across 8 XLA host devices. Ground truth is the CPU
 oracle, same epsilon contract as the single-device differential tests.
 """
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -106,10 +108,18 @@ def test_distributed_matches_oracle(qn, cpu_session, dist_session):
     assert_frames_close(got, exp, qn)
 
 
-# NDS (TPC-DS) under distribution: representative star-join shapes —
-# multi-dim agg (7), day-of-week pivot (43), two-channel city join
-# (68), returns-reason join (93), half-hour count (96)
-NDS_DIST_QUERIES = [7, 43, 68, 93, 96]
+# NDS (TPC-DS) under distribution: a shape-complete sweep over the full
+# 25-table catalog — star joins (7/19/26/29/42/55), rollup (5/22),
+# windows (12/51/89/98), intersect/except (38/87), semi/anti
+# (16/82/93/95), correlated subqueries (1/65), pivots (43/62/88),
+# multi-channel unions (33/60), returns flows (85/93/99). The handful
+# of year-over-year CTE monsters (q4/q11/q74/q64) are covered by the
+# single-device differential tier; their distributed compiles run many
+# minutes on the 8-process virtual CPU mesh and add no new collective
+# shape beyond what q1/q38 exercise.
+NDS_DIST_QUERIES = [1, 3, 5, 7, 12, 15, 16, 19, 22, 26, 29, 33, 38,
+                    42, 43, 51, 55, 60, 62, 65, 68, 82, 85, 87, 88,
+                    89, 93, 95, 96, 98, 99]
 
 
 @pytest.fixture(scope="module")
@@ -117,14 +127,10 @@ def nds_sessions():
     from nds_tpu.datagen import tpcds
     from nds_tpu.nds.schema import get_schemas as nds_schemas
     schemas = nds_schemas()
-    tables = ("store_sales", "store_returns", "date_dim", "item",
-              "customer", "customer_demographics",
-              "household_demographics", "promotion", "store", "reason",
-              "customer_address", "time_dim")
     cpu = Session.for_nds()
     dist = Session.for_nds(make_distributed_factory(
         n_devices=8, shard_threshold=THRESHOLD))
-    for t in tables:
+    for t in schemas:
         raw = tpcds.gen_table(t, SF)
         cpu.register_table(from_arrays(t, schemas[t], raw))
         dist.register_table(from_arrays(t, schemas[t], raw))
@@ -136,9 +142,14 @@ def test_nds_distributed_matches_oracle(qn, nds_sessions):
     from nds_tpu.nds import streams as nds_streams
     cpu, dist = nds_sessions
     sql = nds_streams.render_query(qn)
-    exp = cpu.sql(sql).to_pandas()
-    got = dist.sql(sql).to_pandas()
-    assert_frames_close(got, exp, f"nds{qn}")
+    for part, stmt in enumerate(
+            [s for s in sql.split(";") if s.strip()], 1):
+        exp = cpu.sql(stmt)
+        got = dist.sql(stmt)
+        if exp is None or got is None:
+            continue
+        assert_frames_close(got.to_pandas(), exp.to_pandas(),
+                            f"nds{qn}_part{part}")
 
 
 def test_left_join_nullable_key_distributed():
@@ -231,6 +242,41 @@ def test_hierarchical_exchange_dcn_ici():
     for k in np.unique(ko[oko]):
         devs = {i for i in range(H * D) if (ko[i][oko[i]] == k).any()}
         assert len(devs) == 1, f"key {k} split across devices {devs}"
+
+
+def test_two_process_multihost():
+    """REAL multi-process DCN axis: two OS processes x 4 virtual CPU
+    devices join one jax.distributed world (8 global devices) and run
+    distributed queries against per-process oracles. This is the launch
+    path `--backend distributed` takes under a multi-host launcher
+    (parallel/multihost.py; the reference analog is the executor
+    topology config, `nds/base.template:29-31`)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    child = os.path.join(os.path.dirname(__file__),
+                         "_multihost_child.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(port), str(rank), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK rank={rank}" in out, out[-4000:]
 
 
 MULTIHOST_QUERIES = [1, 3, 5, 13, 16, 18]

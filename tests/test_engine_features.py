@@ -420,6 +420,16 @@ class TestChunkedExecution:
         assert_frames_close(sess.sql(sql).to_pandas(),
                             cpu.sql(sql).to_pandas(), sql[:40])
 
+    def test_scalar_subquery_filter(self, chunked):
+        """q32/q92 shape: a pushed-down predicate referencing a scalar
+        subquery is not chunk-evaluable — it must be skipped in phase A
+        (other predicates still reduce) and re-applied in phase B."""
+        cpu, sess = chunked
+        sql = ("select count(*) c from sales where s_day < 10 and "
+               "s_price > (select avg(s_price) from sales)")
+        assert_frames_close(sess.sql(sql).to_pandas(),
+                            cpu.sql(sql).to_pandas(), "scalar-filter")
+
     def test_streamed_table_never_uploads_whole(self, chunked):
         """The memory contract: the chunked executor's own buffer pool
         must hold no full column of a streamed table."""
