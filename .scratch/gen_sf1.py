@@ -1,0 +1,15 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+from nds_tpu.datagen import tpch
+from nds_tpu.io import table_cache
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds_h.schema import get_schemas
+schemas = get_schemas()
+out = "/root/repo/.bench_data/nds_h_sf1"
+t0 = time.time()
+tables = {}
+for t in schemas:
+    tables[t] = from_arrays(t, schemas[t], tpch.gen_table(t, 1.0))
+    print(t, tables[t].nrows, f"{time.time()-t0:.0f}s", flush=True)
+table_cache.save_tables(out, tables)
+print("saved", out, flush=True)

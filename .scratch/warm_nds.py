@@ -1,0 +1,92 @@
+"""Cache warmer: compile NDS (and optionally NDS-H) bench programs into
+the persistent XLA cache WITHOUT touching device memory — lowering from
+ShapeDtypeStruct avatars, so N warmers can run in parallel against the
+remote compile service while bench.py executes.
+
+Usage: python warm_nds.py <leg> <start_idx> <stop_idx> [reverse]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+leg = sys.argv[1]
+start, stop = int(sys.argv[2]), int(sys.argv[3])
+rev = len(sys.argv) > 4 and sys.argv[4] == "reverse"
+
+from nds_tpu.utils.xla_cache import enable as enable_xla_cache
+
+enable_xla_cache()
+
+import jax
+import numpy as np
+
+from nds_tpu.engine.device_exec import DeviceExecutor
+from nds_tpu.engine.session import Session
+from nds_tpu.io import table_cache
+from nds_tpu.sql import plan as P
+
+if leg == "nds":
+    from nds_tpu.nds import streams
+    from nds_tpu.nds.schema import get_schemas
+    qids = streams.available_templates()
+    mk = Session.for_nds
+    data_dir = "/root/repo/.bench_data/nds_sf0.1"
+else:
+    from nds_tpu.nds_h import streams
+    from nds_tpu.nds_h.schema import get_schemas
+    qids = list(range(1, 23))
+    mk = Session.for_nds_h
+    data_dir = "/root/repo/.bench_data/nds_h_sf0.3"
+
+tables = table_cache.load_tables(data_dir, get_schemas())
+assert tables is not None, data_dir
+sess = mk()
+for t in tables.values():
+    sess.register_table(t)
+ex = DeviceExecutor(tables)
+
+qs = qids[start:stop]
+if rev:
+    qs = list(reversed(qs))
+
+
+def specs_for(planned):
+    out = {}
+    roots = [planned.root] + list(planned.scalar_subplans)
+    for root in roots:
+        for node in P.walk_plan(root):
+            if not isinstance(node, P.Scan):
+                continue
+            t = tables[node.table]
+            for name, _dt in node.output:
+                key = f"{node.table}.{name}"
+                col = t.columns[name]
+                out[key] = jax.ShapeDtypeStruct(
+                    col.values.shape, col.values.dtype)
+                if col.null_mask is not None:
+                    out[key + "#v"] = jax.ShapeDtypeStruct(
+                        col.null_mask.shape, np.dtype(bool))
+    return out
+
+
+for qn in qs:
+    t0 = time.time()
+    try:
+        sql = streams.render_query(qn)
+        if leg == "nds_h":
+            stmts = list(streams.statements(qn, sql))
+        else:
+            stmts = [s for s in sql.split(";") if s.strip()]
+        for si, s in enumerate(stmts):
+            planned = sess.plan(s)
+            if planned is None or getattr(planned, "root", None) is None:
+                continue
+            jitted, _side = ex._compile(planned)
+            specs = specs_for(planned)
+            jitted.lower(specs).compile()
+        print(f"warm {leg} q{qn}: {time.time()-t0:.0f}s", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"warm {leg} q{qn}: FAIL {type(exc).__name__}: "
+              f"{str(exc)[:150]}", flush=True)

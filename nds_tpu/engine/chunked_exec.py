@@ -37,7 +37,11 @@ import jax.numpy as jnp
 
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal
-from nds_tpu.io.host_table import HostColumn, HostTable
+from nds_tpu.engine.types import (
+    INT64, DecimalType, FloatType, Schema, StringType,
+)
+from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
+from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
 
 # stream tables above this many bytes (column data, host-side estimate);
@@ -82,6 +86,89 @@ class _PhaseBExecutor(dx.DeviceExecutor):
             bufs[key + "#v"] = pool[key + "#v"]
 
 
+def _walk_skip(node: P.Node, skip: set):
+    """walk_plan that does not descend below replaced nodes."""
+    yield node
+    if id(node) in skip:
+        return
+    for c in P.children(node):
+        yield from _walk_skip(c, skip)
+
+
+class _MergeTrace(dx._Trace):
+    """Trace that substitutes a chunk-partial MERGE for one Aggregate
+    node: when execution reaches the original aggregate, it instead
+    aggregates the concatenated per-chunk partials (already in the
+    buffer set) and re-maps the merged columns onto the original
+    binding — sum of sums, sum of counts, min of mins, and
+    sum/count recomposition for avg."""
+
+    def run(self, node: P.Node) -> DCtx:
+        rep = getattr(self.ex, "_replace", None)
+        if rep and id(node) in rep and id(node) not in self._cache:
+            self._cache[id(node)] = self._merged_ctx(*rep[id(node)])
+        return super().run(node)
+
+    def _merged_ctx(self, merge_node: P.Aggregate,
+                    A: P.Aggregate, sum_dtypes: dict) -> DCtx:
+        mctx = self.run(merge_node)
+        mb = merge_node.binding
+        out = DCtx(mctx.n, mctx.row)
+        for n, _e in A.group_keys:
+            out.cols[(A.binding, n)] = mctx.cols[(mb, n)]
+        for n, spec in A.aggs:
+            if spec.func == "avg":
+                s = mctx.cols[(mb, n + "__s")]
+                c = mctx.cols[(mb, n + "__c")]
+                f = dx._to_float(s.arr, sum_dtypes[n], self.fdt)
+                cnt = c.arr.astype(self.fdt)
+                arr = f / jnp.maximum(cnt, 1)
+                valid = c.arr > 0
+                if s.valid is not None:
+                    valid = valid & s.valid
+                out.cols[(A.binding, n)] = DVal(arr, valid)
+            else:
+                out.cols[(A.binding, n)] = mctx.cols[(mb, n)]
+        return out
+
+
+class _PartialAggExecutor(_PhaseBExecutor):
+    """Phase-B executor for the partial-aggregation path: executes the
+    ORIGINAL plan, but the subtree under the split Aggregate never runs
+    (its buffers are never uploaded) — the merge plan over the partials
+    table stands in for it via _MergeTrace. Non-streamed buffers come
+    from the shared pool (_PhaseBExecutor contract)."""
+
+    def __init__(self, tables, float_dtype, shared_buffers, streamed,
+                 replace: dict, extra_roots: list):
+        super().__init__(tables, float_dtype, shared_buffers, streamed)
+        self._replace = replace
+        self._extra_roots = extra_roots
+
+    def _collect_buffers(self, planned: P.PlannedQuery) -> dict:
+        bufs = {}
+        roots = ([planned.root] + list(planned.scalar_subplans)
+                 + self._extra_roots)
+        for root in roots:
+            for node in _walk_skip(root, set(self._replace)):
+                if isinstance(node, P.Scan):
+                    for name, _dt in node.output:
+                        self._upload(bufs, node.table, name)
+        return bufs
+
+    def _compile(self, planned: P.PlannedQuery,
+                 slack: float = dx.DeviceExecutor.DEFAULT_SLACK):
+        side = {}
+
+        def fn(bufs):
+            tr = _MergeTrace(self, bufs, slack)
+            row, outs, dicts = tr.run_query(planned)
+            side["dicts"] = dicts
+            return row, outs, tr.total_overflow()
+
+        return jax.jit(fn), side
+
+
 class ChunkedExecutor(dx.DeviceExecutor):
     """DeviceExecutor that streams oversized tables through the chip."""
 
@@ -116,9 +203,32 @@ class ChunkedExecutor(dx.DeviceExecutor):
             reduced = {}
             for table, table_scans in scans.items():
                 reduced[table] = self._reduce_table(table, table_scans)
-            sub = _PhaseBExecutor({**self.tables, **reduced},
-                                  self.float_dtype, self._buffers,
-                                  set(reduced))
+            sub = None
+            # filters didn't shrink some table under the budget: try
+            # per-chunk PARTIAL AGGREGATION before resorting to a full
+            # upload (the q1 full-scan-aggregate shape)
+            big = [t for t, r in reduced.items()
+                   if _table_bytes(r) > self.stream_bytes]
+            if len(big) == 1 and len(scans[big[0]]) == 1:
+                try:
+                    sub = self._try_partial_agg(
+                        planned, big[0], scans[big[0]][0], reduced)
+                except Exception as exc:  # noqa: BLE001 - fall back
+                    from nds_tpu.utils.report import TaskFailureCollector
+                    TaskFailureCollector.notify(
+                        f"partial-agg path failed for {big[0]}, falling "
+                        f"back to full upload: "
+                        f"{type(exc).__name__}: {exc}")
+            if sub is None:
+                # identity reductions (keep-all) are the session's own
+                # table objects — those buffers can live in the shared
+                # pool; genuinely reduced tables differ per plan and
+                # stay executor-local
+                local = {t for t, r in reduced.items()
+                         if r is not self.tables[t]}
+                sub = _PhaseBExecutor({**self.tables, **reduced},
+                                      self.float_dtype, self._buffers,
+                                      local)
             while len(self._reduced) >= self.MAX_REDUCED:
                 self._reduced.pop(next(iter(self._reduced)))
             self._reduced[key] = sub
@@ -136,6 +246,221 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         node.table):
                     out.setdefault(node.table, []).append(node)
         return out
+
+    # -------------------------------------------- phase A: partial agg
+
+    MERGEABLE = ("sum", "count", "min", "max", "avg")
+
+    def _try_partial_agg(self, planned: P.PlannedQuery, table: str,
+                         scan: P.Scan, reduced: dict):
+        """Split the plan at the first Aggregate above the streamed
+        scan: per-chunk partial aggregation on device, host concat of
+        the (small) partials, then the ORIGINAL plan runs with the
+        aggregate's subtree replaced by a merge over the partials.
+        Returns a phase-B executor, or None when the plan shape does
+        not split."""
+        for sub in planned.scalar_subplans:
+            for node in P.walk_plan(sub):
+                if isinstance(node, P.Scan) and node.table == table:
+                    return None  # scalar subplan scans the big table
+        path = self._path_to(planned.root, scan)
+        if path is None:
+            return None
+        agg_i = None
+        for i in range(len(path) - 2, -1, -1):
+            node = path[i]
+            if isinstance(node, P.Aggregate):
+                agg_i = i
+                break
+            if not isinstance(node, (P.Filter, P.Project, P.Join,
+                                     P.SemiJoin, P.DerivedScan)):
+                return None  # blocking op (sort/window/...) below any agg
+            # chunking only distributes over sides whose rows partition
+            # the operator's OUTPUT: either side of an inner join, the
+            # LEFT of a left-outer or semi/anti join. Chunking a semi
+            # join's RIGHT (the EXISTS set) would evaluate membership
+            # against one chunk at a time — q22's NOT EXISTS(orders)
+            # counted a customer once per orders-chunk without this.
+            child = path[i + 1]
+            if isinstance(node, P.SemiJoin) and child is not node.left:
+                return None
+            if isinstance(node, P.Join):
+                if node.kind == "full":
+                    return None  # distributes over neither side
+                if node.kind != "inner" and child is not node.left:
+                    return None
+        if agg_i is None:
+            return None
+        A = path[agg_i]
+        if any(spec.distinct or spec.func not in self.MERGEABLE
+               for _n, spec in A.aggs):
+            return None
+        agg2, sum_dtypes = self._decompose(A)
+        base = {**self.tables,
+                **{t: r for t, r in reduced.items() if t != table}}
+        planned_a = P.PlannedQuery(
+            root=agg2, scalar_subplans=list(planned.scalar_subplans),
+            column_names=[])
+        plan_local = {t for t, r in reduced.items()
+                      if r is not self.tables[t]} | {table}
+        parts = self._run_partial_chunks(base, reduced[table], table,
+                                         planned_a, plan_local)
+        ptable = self._partials_host_table(agg2, parts)
+        pb = "__pa_scan__"
+        scan_p = P.Scan(table=ptable.name, binding=pb,
+                        output=list(agg2.output), filters=[])
+        mg_keys = [(n, ir.ColRef(pb, n, e.dtype))
+                   for n, e in A.group_keys]
+        mg_aggs = []
+        for n, spec in A.aggs:
+            if spec.func == "avg":
+                sdt = sum_dtypes[n]
+                mg_aggs.append((n + "__s", P.AggSpec(
+                    "sum", ir.ColRef(pb, n + "__s", sdt), False, sdt)))
+                mg_aggs.append((n + "__c", P.AggSpec(
+                    "sum", ir.ColRef(pb, n + "__c", INT64), False,
+                    INT64)))
+            elif spec.func == "count":
+                mg_aggs.append((n, P.AggSpec(
+                    "sum", ir.ColRef(pb, n, INT64), False, INT64)))
+            else:  # sum / min / max merge with themselves
+                mg_aggs.append((n, P.AggSpec(
+                    spec.func, ir.ColRef(pb, n, spec.dtype), False,
+                    spec.dtype)))
+        merge_node = P.Aggregate(child=scan_p, group_keys=mg_keys,
+                                 aggs=mg_aggs, binding="__pa_merge__")
+        sub = _PartialAggExecutor(
+            {**base, ptable.name: ptable}, self.float_dtype,
+            self._buffers, plan_local | {ptable.name},
+            {id(A): (merge_node, A, sum_dtypes)}, [merge_node])
+        return sub
+
+    @staticmethod
+    def _path_to(root: P.Node, target: P.Node):
+        if root is target:
+            return [root]
+        for c in P.children(root):
+            p = ChunkedExecutor._path_to(c, target)
+            if p is not None:
+                return [root] + p
+        return None
+
+    @staticmethod
+    def _decompose(A: P.Aggregate):
+        """avg -> (sum, count) pair so partials merge exactly; other
+        mergeable funcs keep their own spec. Returns (agg2, {avg name:
+        sum dtype})."""
+        aggs2, sum_dtypes = [], {}
+        for n, spec in A.aggs:
+            if spec.func != "avg":
+                aggs2.append((n, spec))
+                continue
+            arg_dt = spec.arg.dtype
+            if isinstance(arg_dt, (FloatType, DecimalType)):
+                sdt = arg_dt
+            else:
+                sdt = INT64
+            sum_dtypes[n] = sdt
+            aggs2.append((n + "__s",
+                          P.AggSpec("sum", spec.arg, False, sdt)))
+            aggs2.append((n + "__c",
+                          P.AggSpec("count", spec.arg, False, INT64)))
+        agg2 = P.Aggregate(child=A.child, group_keys=list(A.group_keys),
+                           aggs=aggs2, binding=A.binding)
+        return agg2, sum_dtypes
+
+    @staticmethod
+    def _slice_table(t: HostTable, start: int, stop: int) -> HostTable:
+        cols = {}
+        for name, c in t.columns.items():
+            cols[name] = HostColumn(
+                c.dtype, c.values[start:stop], c.dictionary,
+                None if c.null_mask is None
+                else c.null_mask[start:stop])
+        return HostTable(t.name, t.schema, cols)
+
+    def _run_partial_chunks(self, base: dict, big: HostTable,
+                            table: str, planned_a: P.PlannedQuery,
+                            plan_local: set):
+        """Execute the partial aggregate once per chunk. All full-size
+        chunks share ONE compiled program (same static shape, buffers
+        swapped per chunk); the tail chunk compiles once more at its
+        own size."""
+        n = big.nrows
+        C = min(self.chunk_rows, max(n, 1))
+        spans = [(s, min(s + C, n)) for s in range(0, n, C)]
+        by_size: dict[int, list] = {}
+        for span in spans:
+            by_size.setdefault(span[1] - span[0], []).append(span)
+        # bounds of the table being chunked must come from ALL its rows:
+        # the chunk program compiles ONCE from chunk 0's executor, and
+        # col_bounds feed key packing clips, group capacity, and int32
+        # narrowing — chunk-0-local bounds would silently corrupt later
+        # chunks (clustered layouts make this the common case, not the
+        # edge case)
+        bx = dx.DeviceExecutor({table: big})
+        full_bounds = {(table, name): bx.col_bounds(table, name)
+                       for name in big.columns}
+        parts = []
+        for size, group in by_size.items():
+            s0, e0 = group[0]
+            # every per-plan table (reduced variants + the chunked one)
+            # stays executor-local; only immutable full tables share
+            # the session pool
+            ex = _PhaseBExecutor(
+                {**base, table: self._slice_table(big, s0, e0)},
+                self.float_dtype, self._buffers, plan_local)
+            ex._bounds.update(full_bounds)
+            parts.append(ex.execute(planned_a))  # compiles + runs chunk 0
+            entry = ex._compiled[id(planned_a)]
+            for s, e in group[1:]:
+                bufs = ex._collect_buffers(planned_a)
+                for name in big.columns:
+                    bkey = f"{table}.{name}"
+                    if bkey not in bufs:
+                        continue
+                    col = big.columns[name]
+                    bufs[bkey] = jnp.asarray(col.values[s:e])
+                    if bkey + "#v" in bufs:
+                        bufs[bkey + "#v"] = jnp.asarray(
+                            col.null_mask[s:e])
+                row, outs, overflow = entry["compiled"](bufs)
+                row_h, outs_h, over_h = jax.device_get(
+                    (row, outs, overflow))
+                if int(over_h) != 0:
+                    raise dx.DeviceExecError(
+                        "overflow inside a partial-agg chunk")
+                parts.append(ex._materialize(planned_a, row_h, outs_h,
+                                             entry["side"]))
+        return parts
+
+    @staticmethod
+    def _partials_host_table(agg2: P.Aggregate, parts) -> HostTable:
+        names = [n for n, _dt in agg2.output]
+        dtypes = [dt for _n, dt in agg2.output]
+        fields = []
+        cols = {}
+        for i, (name, dt) in enumerate(zip(names, dtypes)):
+            vals = np.concatenate([np.asarray(p.cols[i]) for p in parts])
+            valid_parts = []
+            any_valid = any(p.valids[i] is not None for p in parts)
+            if any_valid:
+                for p in parts:
+                    v = p.valids[i]
+                    valid_parts.append(
+                        np.ones(len(p.cols[i]), dtype=bool)
+                        if v is None else np.asarray(v))
+                mask = np.concatenate(valid_parts)
+            else:
+                mask = None
+            if isinstance(dt, StringType):
+                codes, dictionary = encode_strings(vals.astype(str))
+                cols[name] = HostColumn(dt, codes, dictionary, mask)
+            else:
+                cols[name] = HostColumn(dt, vals, None, mask)
+            fields.append((name, dt, True))
+        schema = Schema.of(*fields)
+        return HostTable("__pa_partials__", schema, cols)
 
     # ------------------------------------------------- phase A: chunk scan
 

@@ -694,10 +694,17 @@ class _Trace:
             total = offs[-1]
             K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
             slots = jnp.arange(K, dtype=jnp.int32)
-            ridx = jnp.clip(_ss(offs, slots.astype(offs.dtype),
-                                side="right"),
+            # slot->pair search runs on int32: offsets clamp to K+1
+            # (order-preserving for every slot < K <= INT32_MAX, and
+            # the clamped values can never be selected), keeping the
+            # searchsorted sort native — an int64 offs sort is emulated
+            # on TPU and was the q16-class M:N cost center
+            if K + 1 >= 2**31:  # pragma: no cover - absurd capacity
+                raise DeviceExecError(f"join capacity {K} exceeds int32")
+            offs32 = jnp.minimum(offs, K + 1).astype(jnp.int32)
+            ridx = jnp.clip(_ss(offs32, slots, side="right"),
                             0, rctx.n - 1)
-            prev = jnp.where(ridx > 0, jnp.take(offs, ridx - 1), 0)
+            prev = jnp.where(ridx > 0, jnp.take(offs32, ridx - 1), 0)
             within = slots - prev
             lpos = jnp.clip(jnp.take(lo, ridx) + within, 0, lctx.n - 1)
             lidx2 = jnp.take(order, lpos)
@@ -975,11 +982,18 @@ class _Trace:
                         jnp.ones(1, bool), None)
             w = _ok(dv, ctx.row)
             if spec.distinct:
-                key = jnp.where(w, dv.arr.astype(jnp.int64), I64_MAX)
-                ks = jnp.sort(key)
+                # sentinel-FREE distinct: validity is its own sort
+                # operand, so no value (INT32_MAX, +inf, a bool True)
+                # can collide with the invalid marker; _narrow_key
+                # keeps the value operand on the native i32 sort path
+                arr = _narrow_key(dv)
+                iv = jnp.where(w, 0, 1).astype(jnp.int32)
+                iv_s, v_s = lax.sort([iv, arr], num_keys=2,
+                                     is_stable=False)
+                w_s = iv_s == 0  # valid rows form the sorted prefix
                 newv = jnp.concatenate(
-                    [jnp.ones(1, bool), ks[1:] != ks[:-1]])
-                cnt = jnp.sum(newv & (ks != I64_MAX))
+                    [jnp.ones(1, bool), v_s[1:] != v_s[:-1]])
+                cnt = jnp.sum(newv & w_s)
             else:
                 cnt = jnp.sum(w)
             return (cnt.reshape(1).astype(jnp.int64),

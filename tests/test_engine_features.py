@@ -438,16 +438,100 @@ class TestChunkedExecution:
                "group by s_cat order by s_cat")
         sess.sql(sql)
         ex = sess._executor_factory(sess.tables)
-        assert not any(k.startswith("sales.") for k in ex._buffers)
-        # and the phase-B executor holds only the reduced rows
         subs = list(ex._reduced.values())
         assert subs
-        reduced = subs[-1].tables["sales"]
-        full = ex.tables["sales"]
+        sub = subs[-1]
+        from nds_tpu.engine.chunked_exec import _PartialAggExecutor
         import numpy as np
-        expect = int(((np.asarray(full.column("s_qty").values) > 40)
-                      & full.column("s_qty").null_mask).sum())
-        assert reduced.nrows == expect
+        full = ex.tables["sales"]
+        # THIS plan's executor must hold no full-length sales buffer
+        # (identity reductions from OTHER queries — e.g. a global avg
+        # subquery needing every row — may legitimately share the pool)
+        for pool in (sub._buffers,):
+            for k, v in pool.items():
+                if k.startswith("sales."):
+                    assert v.shape[0] < full.nrows, k
+        if isinstance(sub, _PartialAggExecutor):
+            # partial-agg phase B: the big table is never uploaded at
+            # all — only the per-chunk partials are
+            assert "__pa_partials__" in sub.tables
+            assert not any(k.startswith("sales.") for k in sub._buffers)
+            assert sub.tables["__pa_partials__"].nrows < full.nrows
+        else:
+            # survivor-reduction phase B holds only the reduced rows
+            expect = int(((np.asarray(full.column("s_qty").values) > 40)
+                          & full.column("s_qty").null_mask).sum())
+            assert sub.tables["sales"].nrows == expect
+
+    @pytest.fixture(scope="class")
+    def chunked_pa(self, sessions):
+        """stream_bytes sized so ONLY `sales` streams (other fits):
+        exercises the partial-aggregation split with joins below the
+        aggregate."""
+        from nds_tpu.engine.chunked_exec import make_chunked_factory
+        cpu, dev = sessions
+        sess = Session(dev.catalog,
+                       make_chunked_factory(stream_bytes=2000,
+                                            chunk_rows=64))
+        for t in dev.tables.values():
+            sess.register_table(t)
+        return cpu, sess
+
+    @pytest.mark.parametrize("sql", [
+        # avg must recompose exactly from per-chunk (sum, count)
+        "select s_cat, avg(s_qty) a, count(*) c from sales "
+        "group by s_cat order by s_cat",
+        # global aggregate (no group keys), all mergeable funcs
+        "select sum(s_price) t, count(*) c, avg(s_qty) a, "
+        "min(s_day) mn, max(s_day) mx from sales",
+        # join below the aggregate: build side replicated, probe chunked
+        "select s_cat, sum(s_qty) q from sales, other "
+        "where s_store = o_store group by s_cat order by s_cat",
+        # count(col) skips NULLs per chunk and merges by sum
+        "select s_store, count(s_qty) c from sales group by s_store "
+        "order by s_store",
+    ])
+    def test_partial_agg_matches_oracle(self, chunked_pa, sql):
+        cpu, sess = chunked_pa
+        from nds_tpu.engine.chunked_exec import _PartialAggExecutor
+        assert_frames_close(sess.sql(sql).to_pandas(),
+                            cpu.sql(sql).to_pandas(), sql[:40])
+        ex = sess._executor_factory(sess.tables)
+        assert any(isinstance(s, _PartialAggExecutor)
+                   for s in ex._reduced.values()), \
+            "partial-agg path was expected to engage"
+
+    def test_partial_agg_semijoin_right_falls_back(self, sessions):
+        """q22 regression: when the STREAMED table is the right side of
+        a NOT EXISTS, partial aggregation must not engage (membership
+        against one chunk at a time inflates the anti join)."""
+        from nds_tpu.engine.chunked_exec import (
+            _PartialAggExecutor, make_chunked_factory,
+        )
+        cpu, dev = sessions
+        # stream only `sales` (the EXISTS set in this query)
+        sess = Session(dev.catalog,
+                       make_chunked_factory(stream_bytes=2000,
+                                            chunk_rows=64))
+        for t in dev.tables.values():
+            sess.register_table(t)
+        sql = ("select o_cat, count(*) c from other where not exists "
+               "(select 1 from sales where s_store = o_store) "
+               "group by o_cat order by o_cat")
+        assert_frames_close(sess.sql(sql).to_pandas(),
+                            cpu.sql(sql).to_pandas(), "q22-shape")
+        ex = sess._executor_factory(sess.tables)
+        assert not any(isinstance(s, _PartialAggExecutor)
+                       for s in ex._reduced.values())
+
+    def test_partial_agg_distinct_falls_back(self, chunked_pa):
+        """count(distinct) cannot merge from partials — the plan must
+        fall back to the full-upload phase B and still be correct."""
+        cpu, sess = chunked_pa
+        sql = ("select s_cat, count(distinct s_store) d from sales "
+               "group by s_cat order by s_cat")
+        assert_frames_close(sess.sql(sql).to_pandas(),
+                            cpu.sql(sql).to_pandas(), "distinct-fallback")
 
     def test_survivor_cache_shared_across_plans(self, chunked):
         _cpu, sess = chunked
