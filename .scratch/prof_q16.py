@@ -1,0 +1,29 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+from nds_tpu.utils.xla_cache import enable
+enable()
+import jax
+from nds_tpu.engine.device_exec import DeviceExecutor
+from nds_tpu.engine.session import Session
+from nds_tpu.io import table_cache
+from nds_tpu.nds_h import streams
+from nds_tpu.nds_h.schema import get_schemas
+
+tables = table_cache.load_tables("/root/repo/.bench_data/nds_h_sf0.3",
+                                 get_schemas())
+sess = Session.for_nds_h(lambda t: ex)
+ex = DeviceExecutor(tables)
+for t in tables.values():
+    sess.register_table(t)
+
+qn = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+sql = list(streams.statements(qn))
+for s in sql:
+    sess.sql(s)  # warm
+for trial in range(3):
+    t0 = time.perf_counter()
+    for s in sql:
+        r = sess.sql(s)
+    dt = (time.perf_counter() - t0) * 1000
+    print(f"q{qn} trial{trial}: {dt:.0f} ms  timings={ex.last_timings}",
+          flush=True)

@@ -1,0 +1,30 @@
+import sys, time
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+from nds_tpu.utils.xla_cache import enable
+enable()
+from nds_tpu.engine.chunked_exec import make_chunked_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.io import table_cache
+from nds_tpu.nds_h import streams
+from nds_tpu.nds_h.schema import get_schemas
+from test_device_engine import assert_frames_close
+
+tables = table_cache.load_tables("/root/repo/.bench_data/nds_h_sf1", get_schemas())
+def mk(f=None):
+    s = Session.for_nds_h(f)
+    for t in tables.values():
+        s.register_table(t)
+    return s
+dev = mk(make_chunked_factory(stream_bytes=256 << 20, chunk_rows=1 << 21))
+cpu = mk()
+for attempt in range(3):
+    try:
+        t0 = time.perf_counter()
+        g = dev.sql(streams.render_query(7))
+        t1 = time.perf_counter()
+        e = cpu.sql(streams.render_query(7))
+        assert_frames_close(g.to_pandas(), e.to_pandas(), "sf1-q7")
+        print(f"sf1 q7: dev {1000*(t1-t0):.0f} ms MATCH", flush=True)
+        break
+    except Exception as exc:
+        print(f"sf1 q7 attempt {attempt}: {type(exc).__name__}: {str(exc)[:150]}", flush=True)

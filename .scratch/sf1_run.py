@@ -1,0 +1,57 @@
+"""NDS-H SF1 power run on the real chip with out-of-core streaming:
+lineitem (~770MB of columns) streams through the chunked executor;
+results validate against the CPU oracle. VERDICT item 3 done criterion."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+from nds_tpu.utils.xla_cache import enable
+enable()
+import numpy as np
+from nds_tpu.engine.chunked_exec import make_chunked_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.io import table_cache
+from nds_tpu.nds_h import streams
+from nds_tpu.nds_h.schema import get_schemas
+sys.path.insert(0, "/root/repo/tests")
+
+tables = table_cache.load_tables("/root/repo/.bench_data/nds_h_sf1",
+                                 get_schemas())
+assert tables is not None
+
+def mk(factory=None):
+    s = Session.for_nds_h(factory)
+    for t in tables.values():
+        s.register_table(t)
+    return s
+
+dev = mk(make_chunked_factory(stream_bytes=256 << 20,
+                              chunk_rows=1 << 21))
+cpu = mk()
+from test_device_engine import assert_frames_close  # noqa: E402
+
+total_dev = total_cpu = 0.0
+fails = []
+for qn in range(1, 23):
+    try:
+        stmts = list(streams.statements(qn))
+        t0 = time.perf_counter()
+        g = None
+        for s in stmts:
+            r = dev.sql(s)
+            g = r if r is not None else g
+        t1 = time.perf_counter()
+        e = None
+        for s in stmts:
+            r = cpu.sql(s)
+            e = r if r is not None else e
+        t2 = time.perf_counter()
+        assert_frames_close(g.to_pandas(), e.to_pandas(), f"sf1-q{qn}")
+        total_dev += t1 - t0
+        total_cpu += t2 - t1
+        print(f"sf1 q{qn}: dev {1000*(t1-t0):.0f} ms cpu "
+              f"{1000*(t2-t1):.0f} ms MATCH", flush=True)
+    except Exception as exc:
+        fails.append(qn)
+        print(f"sf1 q{qn}: FAIL {type(exc).__name__}: {str(exc)[:200]}",
+              flush=True)
+print(f"SF1 TOTAL dev {total_dev:.1f}s cpu {total_cpu:.1f}s "
+      f"fails={fails}", flush=True)

@@ -48,6 +48,8 @@ for t in tables.values():
 ex = DeviceExecutor(tables)
 
 qs = qids[start:stop]
+if os.environ.get("QLIST"):
+    qs = [int(x) for x in os.environ["QLIST"].split(",")]
 if rev:
     qs = list(reversed(qs))
 
@@ -85,7 +87,15 @@ for qn in qs:
                 continue
             jitted, _side = ex._compile(planned)
             specs = specs_for(planned)
-            jitted.lower(specs).compile()
+            for attempt in range(3):
+                try:
+                    jitted.lower(specs).compile()
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    if attempt == 2 or "remote_compile" not in str(exc):
+                        raise
+                    print(f"  q{qn} stmt{si}: transient, retry",
+                          flush=True)
         print(f"warm {leg} q{qn}: {time.time()-t0:.0f}s", flush=True)
     except Exception as exc:  # noqa: BLE001
         print(f"warm {leg} q{qn}: FAIL {type(exc).__name__}: "

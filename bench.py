@@ -181,9 +181,24 @@ def _run_leg(leg: str) -> None:
         try:
             sql = streams.render_query(qn)
             stmts = _statements(leg, qn, sql)
-            # untimed warmup: AOT compile + one execution per statement
+            # untimed warmup: AOT compile + one execution per statement.
+            # The remote compile service drops connections under long
+            # compiles ("response body closed" / "Unexpected EOF") —
+            # transient, so retry PER STATEMENT (retrying the whole
+            # list would replay a succeeded CREATE VIEW and turn the
+            # transient into a hard 'view already exists')
             for s in stmts:
-                dev.sql(s)
+                for attempt in range(3):
+                    try:
+                        dev.sql(s)
+                        break
+                    except Exception as exc:  # noqa: BLE001
+                        if (attempt == 2
+                                or "remote_compile" not in str(exc)):
+                            raise
+                        print(f"[bench] {leg} q{qn}: transient compile "
+                              f"error, retrying statement",
+                              file=sys.stderr, flush=True)
             dev_s = _run_query(dev, stmts)
             BANK.setdefault((leg, qn), {})["device_s"] = dev_s
             # engine-side perf accounting (compile/execute/materialize)

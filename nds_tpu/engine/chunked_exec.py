@@ -413,6 +413,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
             ex._bounds.update(full_bounds)
             parts.append(ex.execute(planned_a))  # compiles + runs chunk 0
             entry = ex._compiled[id(planned_a)]
+            compiled, side = entry["compiled"], entry["side"]
+            slack = entry["slack"]
             for s, e in group[1:]:
                 bufs = ex._collect_buffers(planned_a)
                 for name in big.columns:
@@ -424,14 +426,27 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     if bkey + "#v" in bufs:
                         bufs[bkey + "#v"] = jnp.asarray(
                             col.null_mask[s:e])
-                row, outs, overflow = entry["compiled"](bufs)
-                row_h, outs_h, over_h = jax.device_get(
-                    (row, outs, overflow))
-                if int(over_h) != 0:
-                    raise dx.DeviceExecError(
-                        "overflow inside a partial-agg chunk")
+                for attempt in range(4):
+                    row, outs, overflow = compiled(bufs)
+                    row_h, outs_h, over_h = jax.device_get(
+                        (row, outs, overflow))
+                    if int(over_h) == 0:
+                        break
+                    if attempt == 3:
+                        raise dx.DeviceExecError(
+                            "partial-agg chunk overflow persisted")
+                    # skewed chunk expands past the chunk-0-sized join
+                    # capacity: double slack and recompile, same as the
+                    # executor's own overflow-retry contract
+                    from nds_tpu.utils.report import TaskFailureCollector
+                    slack *= 2
+                    TaskFailureCollector.notify(
+                        f"partial-agg chunk [{s}:{e}] overflow; "
+                        f"recompiling with slack={slack}")
+                    jitted, side = ex._compile(planned_a, slack)
+                    compiled = jitted.lower(bufs).compile()
                 parts.append(ex._materialize(planned_a, row_h, outs_h,
-                                             entry["side"]))
+                                             side))
         return parts
 
     @staticmethod

@@ -447,10 +447,9 @@ class TestChunkedExecution:
         # THIS plan's executor must hold no full-length sales buffer
         # (identity reductions from OTHER queries — e.g. a global avg
         # subquery needing every row — may legitimately share the pool)
-        for pool in (sub._buffers,):
-            for k, v in pool.items():
-                if k.startswith("sales."):
-                    assert v.shape[0] < full.nrows, k
+        for k, v in sub._buffers.items():
+            if k.startswith("sales."):
+                assert v.shape[0] < full.nrows, k
         if isinstance(sub, _PartialAggExecutor):
             # partial-agg phase B: the big table is never uploaded at
             # all — only the per-chunk partials are
