@@ -557,3 +557,38 @@ def test_make_session_stream_bytes_selects_chunked():
     ex = sess._executor_factory({})
     assert isinstance(ex, ChunkedExecutor)
     assert ex.stream_bytes == 1024 and ex.chunk_rows == 128
+
+
+def test_device_result_compaction(sessions):
+    """Large-capacity results compact on device before the host
+    transfer (COMPACT_MIN_ROWS); forced low here — results must be
+    identical to the uncompacted path."""
+    from nds_tpu.engine.device_exec import DeviceExecutor
+
+    cpu, dev = sessions
+
+    class SmallCompact(DeviceExecutor):
+        COMPACT_MIN_ROWS = 2
+
+    ex_holder = [None]
+
+    def factory(tables):
+        if ex_holder[0] is None or ex_holder[0].tables is not tables:
+            ex_holder[0] = SmallCompact(tables)
+        return ex_holder[0]
+
+    sess = Session(dev.catalog, factory)
+    for t in dev.tables.values():
+        sess.register_table(t)
+    # string-dictionary, decimal, float, and int outputs all travel
+    # the compacted transfer (threshold 2 engages every multi-row
+    # capacity, including the G=4 group-by)
+    for sql in [
+        "select s_cat, sum(s_price) t from sales where s_qty > 25 "
+        "group by s_cat order by s_cat",
+        "select s_cat, avg(s_qty) a from sales group by s_cat "
+        "order by s_cat",
+        "select s_id, s_qty from sales where s_qty > 45 order by s_id",
+    ]:
+        assert_frames_close(sess.sql(sql).to_pandas(),
+                            cpu.sql(sql).to_pandas(), sql[:40])
