@@ -155,6 +155,68 @@ def _run_query(session, stmts: list[str]) -> float:
     return time.perf_counter() - t0
 
 
+# -------------------------------------------------- CPU-oracle time bank
+#
+# The 121-query CPU-oracle denominator costs more wall-clock than the
+# device leg itself; re-deriving it every driver run is what pushed
+# round 3 past the budget (VERDICT r3 "what's missing" #1). CPU times
+# are a property of (suite, SF, query, host) only — the deterministic
+# generators make the data identical across runs — so they bank to
+# DATA_ROOT and reload. BENCH_CPU=fresh forces re-measurement.
+
+def _cpu_bank_path(leg: str) -> str:
+    sf = SF_H if leg == "nds_h" else SF_DS
+    return os.path.join(DATA_ROOT, f"cpu_times_{leg}_sf{sf:g}.json")
+
+
+def _load_cpu_bank(leg: str, tables) -> dict:
+    if os.environ.get("BENCH_CPU", "auto") == "fresh":
+        return {}
+    try:
+        with open(_cpu_bank_path(leg)) as f:
+            bank = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    # fingerprint: banked times are only valid for identical data
+    rows = {t: tb.nrows for t, tb in tables.items()}
+    if bank.get("rows") != rows:
+        return {}
+    return bank.get("times", {})
+
+
+def _save_cpu_bank(leg: str, tables, times: dict) -> None:
+    path = _cpu_bank_path(leg)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"rows": {t: tb.nrows for t, tb in tables.items()},
+                   "times": times}, f)
+    os.replace(tmp, path)
+
+
+# transient transport failures from the remote-attached chip extend
+# beyond compiles (round 3 lost q22 to a BrokenPipeError mid-transfer):
+# any failure matching these marks retries instead of failing the query
+_TRANSIENT = ("brokenpipe", "unexpected eof", "response body closed",
+              "connection", "unavailable", "deadline", "transport",
+              "remote_compile", "socket")
+
+
+def _is_transient(exc: BaseException) -> bool:
+    s = f"{type(exc).__name__}: {exc}".lower()
+    return any(t in s for t in _TRANSIENT)
+
+
+def _cleanup_views(session, stmts: list[str]) -> None:
+    """Best-effort drop of any views a half-completed statement list
+    left behind, so a retry can replay CREATE VIEW statements."""
+    for s in stmts:
+        if s.lstrip().lower().startswith("drop view"):
+            try:
+                session.sql(s)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 def _run_leg(leg: str) -> None:
     from nds_tpu.engine.device_exec import make_device_factory
     from nds_tpu.engine.session import Session
@@ -184,6 +246,11 @@ def _run_leg(leg: str) -> None:
         dev.register_table(t)
         cpu.register_table(t)
 
+    cpu_bank = _load_cpu_bank(leg, tables)
+    if cpu_bank:
+        print(f"[bench] {leg}: {len(cpu_bank)} banked cpu-oracle times "
+              f"from {_cpu_bank_path(leg)}", file=sys.stderr, flush=True)
+
     for qn in qids:
         # one broken query must not cost the rest of the run (the
         # reference's --allow_failure mode, `nds/nds_power.py:391-393`)
@@ -202,18 +269,36 @@ def _run_leg(leg: str) -> None:
                         dev.sql(s)
                         break
                     except Exception as exc:  # noqa: BLE001
-                        if (attempt == 2
-                                or "remote_compile" not in str(exc)):
+                        if attempt == 2 or not _is_transient(exc):
                             raise
                         print(f"[bench] {leg} q{qn}: transient compile "
                               f"error, retrying statement",
                               file=sys.stderr, flush=True)
-            dev_s = _run_query(dev, stmts)
+            # timed run, with transient-transport retry (the whole
+            # statement list replays; drops run first so re-created
+            # views don't collide)
+            for attempt in range(3):
+                try:
+                    dev_s = _run_query(dev, stmts)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    if attempt == 2 or not _is_transient(exc):
+                        raise
+                    print(f"[bench] {leg} q{qn}: transient error in "
+                          f"timed run ({type(exc).__name__}), retrying",
+                          file=sys.stderr, flush=True)
+                    _cleanup_views(dev, stmts)
             BANK.setdefault((leg, qn), {})["device_s"] = dev_s
             # engine-side perf accounting (compile/execute/materialize)
             dev_ex = dev._executor_factory(dev.tables)
             tm = dict(dev_ex.last_timings)
-            cpu_s = _run_query(cpu, stmts)
+            banked = cpu_bank.get(str(qn))
+            if banked is not None:
+                cpu_s = float(banked)
+            else:
+                cpu_s = _run_query(cpu, stmts)
+                cpu_bank[str(qn)] = cpu_s
+                _save_cpu_bank(leg, tables, cpu_bank)
             BANK[(leg, qn)]["cpu_s"] = cpu_s
         except Exception as exc:  # noqa: BLE001
             BANK.pop((leg, qn), None)
@@ -222,8 +307,11 @@ def _run_leg(leg: str) -> None:
             continue
         print(f"[bench] {leg} q{qn}: tpu {dev_s*1000:.0f} ms "
               f"(exec {tm.get('execute_ms', 0):.0f} "
-              f"mat {tm.get('materialize_ms', 0):.0f}) | "
-              f"cpu {cpu_s*1000:.0f} ms", file=sys.stderr, flush=True)
+              f"mat {tm.get('materialize_ms', 0):.0f} "
+              f"{tm.get('scan_gbps', 0):.1f}GB/s) | "
+              f"cpu {cpu_s*1000:.0f} ms"
+              f"{' [banked]' if banked is not None else ''}",
+              file=sys.stderr, flush=True)
         # the full combined partial (not a leg-scoped line): a hard kill
         # can defer the SIGTERM handler inside XLA C++, so the last
         # printed line must already carry every completed leg

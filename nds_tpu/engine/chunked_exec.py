@@ -66,6 +66,11 @@ class _PhaseBExecutor(dx.DeviceExecutor):
     load-once/query-many lifecycle), while reduced-table buffers stay
     local — their contents differ per plan."""
 
+    # tables here are already survivor-reduced by the union of the
+    # plan's scan filters; a second per-scan shrink would desync
+    # _PartialAggExecutor's buffer walk from the trace for marginal gain
+    SCAN_REDUCE = False
+
     def __init__(self, tables, float_dtype, shared_buffers: dict,
                  streamed: set):
         super().__init__(tables, float_dtype)

@@ -65,6 +65,11 @@ DEFAULT_SHARD_THRESHOLD = 8192
 class DistributedExecutor(dx.DeviceExecutor):
     """Session-compatible executor that runs plans SPMD over a mesh."""
 
+    # buffer keys here map back to table names for shard-spec routing
+    # (_split_keys); survivor-reduced prefixes would break that and the
+    # shard layout is the capacity story on a mesh anyway
+    SCAN_REDUCE = False
+
     def __init__(self, tables: dict[str, HostTable], mesh=None,
                  n_devices: int | None = None,
                  shard_tables: set[str] | None = None,
