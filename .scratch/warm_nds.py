@@ -55,6 +55,8 @@ if rev:
 
 
 def specs_for(planned):
+    """Avatar specs mirroring DeviceExecutor._collect_buffers: reduced
+    scans get reduced-prefix keys at reduced pow2 capacity."""
     out = {}
     roots = [planned.root] + list(planned.scalar_subplans)
     for root in roots:
@@ -62,14 +64,20 @@ def specs_for(planned):
             if not isinstance(node, P.Scan):
                 continue
             t = tables[node.table]
+            rv = ex.scan_view(node)
             for name, _dt in node.output:
-                key = f"{node.table}.{name}"
                 col = t.columns[name]
+                if rv is not None:
+                    key = f"{rv.prefix}.{name}"
+                    shape = (rv.capacity,)
+                else:
+                    key = f"{node.table}.{name}"
+                    shape = col.values.shape
                 out[key] = jax.ShapeDtypeStruct(
-                    col.values.shape, col.values.dtype)
+                    shape, col.values.dtype)
                 if col.null_mask is not None:
                     out[key + "#v"] = jax.ShapeDtypeStruct(
-                        col.null_mask.shape, np.dtype(bool))
+                        shape, np.dtype(bool))
     return out
 
 

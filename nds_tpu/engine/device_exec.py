@@ -514,8 +514,13 @@ class DeviceExecutor:
         if keep is None or s > t.nrows * self.REDUCE_MAX_FRAC:
             self._scan_views[ck] = "full"
             return None
-        rv = _ReducedScan(f"{node.table}@{abs(hash(ck)) % (1 << 32):08x}",
-                          node.table, s, np.nonzero(keep)[0])
+        # deterministic digest (NOT hash(): per-process randomization
+        # would rename buffer keys and miss the persistent XLA cache
+        # across processes/driver runs)
+        import hashlib
+        h = hashlib.md5(sig.encode()).hexdigest()[:8]
+        rv = _ReducedScan(f"{node.table}@{h}", node.table, s,
+                          np.nonzero(keep)[0])
         while len(self._scan_views) >= self.MAX_SCAN_VIEWS:
             old = self._scan_views.pop(next(iter(self._scan_views)))
             if isinstance(old, _ReducedScan):
