@@ -1,0 +1,78 @@
+"""Quick differential check of filtered-scan survivor reduction:
+forces REDUCE_MIN_ROWS=1 so tiny test tables reduce, runs NDS-H 22
+queries + a sample of NDS queries device-vs-oracle on the CPU backend."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+from nds_tpu.engine import device_exec as dx
+dx.DeviceExecutor.REDUCE_MIN_ROWS = 1  # force reduction everywhere
+
+from nds_tpu.datagen import tpcds, tpch
+from nds_tpu.engine.device_exec import make_device_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds import streams as nds_streams
+from nds_tpu.nds.schema import get_schemas as nds_schemas
+from nds_tpu.nds_h import streams as h_streams
+from nds_tpu.nds_h.schema import get_schemas as h_schemas
+
+from tests.test_device_engine import assert_frames_close, run_query
+
+SF = 0.01
+
+
+def make_sessions(schemas_fn, gen, for_fn):
+    schemas = schemas_fn()
+    raw = {t: gen.gen_table(t, SF) for t in schemas}
+    cpu = for_fn(None)
+    dev = for_fn(make_device_factory())
+    for t in schemas:
+        ht = from_arrays(t, schemas[t], raw[t])
+        cpu.register_table(ht)
+        dev.register_table(ht)
+    return cpu, dev
+
+
+def check(tag, cpu, dev, stmts_fn, qns):
+    bad = []
+    for qn in qns:
+        try:
+            for s in stmts_fn(qn):
+                rc = cpu.sql(s)
+                rd = dev.sql(s)
+                if rc is not None:
+                    assert_frames_close(rd.to_pandas(), rc.to_pandas(), qn)
+            print(f"{tag} q{qn}: OK", flush=True)
+        except Exception as e:  # noqa: BLE001
+            bad.append((qn, e))
+            print(f"{tag} q{qn}: FAIL {type(e).__name__}: {e}", flush=True)
+    return bad
+
+
+def main():
+    bad = []
+    cpu, dev = make_sessions(h_schemas, tpch, Session.for_nds_h)
+    bad += check("nds_h", cpu, dev, h_streams.statements, range(1, 23))
+    qns = [int(a) for a in sys.argv[1:]] or [
+        1, 4, 6, 7, 10, 13, 18, 25, 34, 37, 48, 68, 85, 91]
+    cpu, dev = make_sessions(nds_schemas, tpcds, Session.for_nds)
+
+    def nds_stmts(qn):
+        return [s for s in nds_streams.render_query(qn).split(";")
+                if s.strip()]
+
+    bad += check("nds", cpu, dev, nds_stmts, qns)
+    print("FAILURES:", len(bad))
+    sys.exit(1 if bad else 0)
+
+
+main()

@@ -144,7 +144,8 @@ def main(argv=None) -> None:
     p.add_argument("data_dir")
     p.add_argument("streams", nargs="+", help="query_N.sql stream files")
     p.add_argument("--out_dir", default="throughput_logs")
-    p.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    p.add_argument("--backend", choices=["tpu", "cpu", "distributed"],
+                   default="tpu")
     p.add_argument("--input_format", choices=["parquet", "raw"],
                    default="parquet")
     p.add_argument("--allow_failure", action="store_true")

@@ -85,7 +85,7 @@ def main(argv=None) -> None:
                    help="overrides engine.backend from template/property "
                         "files (default tpu)")
     p.add_argument("--input_format",
-                   choices=["parquet", "orc", "json", "raw"],
+                   choices=["parquet", "orc", "json", "avro", "raw"],
                    default="parquet")
     p.add_argument("--extra_time_log",
                    help="write a second copy of the CSV time log here "

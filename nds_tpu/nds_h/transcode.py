@@ -86,8 +86,8 @@ def main(argv=None) -> None:
     p.add_argument("--output_format", default="parquet",
                    choices=["parquet", "orc", "json", "avro"],
                    help="warehouse file format "
-                        "(`nds/nds_transcode.py:69-152`; avro raises — "
-                        "no codec in this environment)")
+                        "(`nds/nds_transcode.py:69-152`; avro via the "
+                        "built-in container codec, io/avro_io.py)")
     args = p.parse_args(argv)
     transcode(args.input_dir, args.output_dir, args.report_file,
               args.tables, args.compression,

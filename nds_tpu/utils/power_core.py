@@ -125,8 +125,13 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
             ext = csv_io.FORMAT_EXT[fmt]
             if log is not None and os.path.isdir(tdir):
                 # versioned warehouse: the snapshot manifest names the
-                # live files (maintenance commits new versions)
+                # live files (maintenance commits new versions, always
+                # as parquet — formats may mix, so read per-extension)
                 paths = log.current([name]).get(name, [])
+                table = csv_io.read_paths_auto(paths, name, schema, fmt)
+                session.register_table(table)
+                timings[name] = time.perf_counter() - t0
+                continue
             elif os.path.isdir(tdir):
                 # recursive: partitioned tables nest hive-style dirs
                 paths = sorted(

@@ -12,13 +12,16 @@ import sys
 
 def main() -> None:
     port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    # local virtual devices per process (argv[4], default 4): the
+    # 4-process tier runs 4x2, the 2-process tier 2x4
+    ndev = int(sys.argv[4]) if len(sys.argv) > 4 else 4
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["NDS_TPU_PLATFORM"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = " ".join(f for f in flags.split()
                      if "xla_force_host_platform_device_count" not in f)
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=4").strip()
+        flags + f" --xla_force_host_platform_device_count={ndev}").strip()
     os.environ.setdefault("JAX_ENABLE_X64", "true")
     # the power_core "distributed" backend reads the launch contract
     # from these (parallel/multihost.py)
@@ -36,8 +39,8 @@ def main() -> None:
 
     assert multihost.maybe_initialize(), "distributed init did not run"
     assert jax.process_count() == nproc, jax.process_count()
-    assert len(jax.local_devices()) == 4
-    assert len(jax.devices()) == 4 * nproc
+    assert len(jax.local_devices()) == ndev
+    assert len(jax.devices()) == ndev * nproc
 
     import numpy as np
 

@@ -108,18 +108,19 @@ def test_distributed_matches_oracle(qn, cpu_session, dist_session):
     assert_frames_close(got, exp, qn)
 
 
-# NDS (TPC-DS) under distribution: a shape-complete sweep over the full
-# 25-table catalog — star joins (7/19/26/29/42/55), rollup (5/22),
-# windows (12/51/89/98), intersect/except (38/87), semi/anti
-# (16/82/93/95), correlated subqueries (1/65), pivots (43/62/88),
-# multi-channel unions (33/60), returns flows (85/93/99). The handful
-# of year-over-year CTE monsters (q4/q11/q74/q64) are covered by the
-# single-device differential tier; their distributed compiles run many
-# minutes on the 8-process virtual CPU mesh and add no new collective
-# shape beyond what q1/q38 exercise.
-NDS_DIST_QUERIES = [1, 3, 5, 7, 12, 15, 16, 19, 22, 26, 29, 33, 38,
-                    42, 43, 51, 55, 60, 62, 65, 68, 82, 85, 87, 88,
-                    89, 93, 95, 96, 98, 99]
+# NDS (TPC-DS) under distribution: ALL 99 templates (VERDICT r3 "next"
+# #6) — every operator shape, including the year-over-year CTE monsters
+# (q4/q11/q64/q74) whose wide plans and biggest intermediate capacities
+# are exactly the ones most likely to break the exchange. Their virtual-
+# mesh compiles are minutes each; the tier is slow-marked and the
+# compiles amortize across runs via the persistent cache where the
+# backend supports it.
+def _all_nds_templates():
+    from nds_tpu.nds import streams as nds_streams
+    return nds_streams.available_templates()
+
+
+NDS_DIST_QUERIES = _all_nds_templates()
 
 
 @pytest.fixture(scope="module")
@@ -244,13 +245,10 @@ def test_hierarchical_exchange_dcn_ici():
         assert len(devs) == 1, f"key {k} split across devices {devs}"
 
 
-def test_two_process_multihost():
-    """REAL multi-process DCN axis: two OS processes x 4 virtual CPU
-    devices join one jax.distributed world (8 global devices) and run
-    distributed queries against per-process oracles. This is the launch
-    path `--backend distributed` takes under a multi-host launcher
-    (parallel/multihost.py; the reference analog is the executor
-    topology config, `nds/base.template:29-31`)."""
+def _launch_multihost(nproc: int, ndev: int) -> None:
+    """Launch nproc OS processes x ndev virtual CPU devices into one
+    jax.distributed world and assert every rank completes its
+    distributed-vs-oracle sweep (tests/_multihost_child.py)."""
     import socket
     import subprocess
     import sys
@@ -263,9 +261,10 @@ def test_two_process_multihost():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     procs = [subprocess.Popen(
-        [sys.executable, child, str(port), str(rank), "2"],
+        [sys.executable, child, str(port), str(rank), str(nproc),
+         str(ndev)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for rank in range(2)]
+        env=env) for rank in range(nproc)]
     outs = []
     try:
         for p in procs:
@@ -277,6 +276,23 @@ def test_two_process_multihost():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert f"MULTIHOST_OK rank={rank}" in out, out[-4000:]
+
+
+def test_two_process_multihost():
+    """REAL multi-process DCN axis: two OS processes x 4 virtual CPU
+    devices join one jax.distributed world (8 global devices) and run
+    distributed queries against per-process oracles. This is the launch
+    path `--backend distributed` takes under a multi-host launcher
+    (parallel/multihost.py; the reference analog is the executor
+    topology config, `nds/base.template:29-31`)."""
+    _launch_multihost(2, 4)
+
+
+def test_four_process_multihost():
+    """4-process world (4 x 2 devices): more DCN participants than the
+    2-process tier — collective membership, rank-0 gating, and the
+    global-array shard loading must hold beyond the pairwise case."""
+    _launch_multihost(4, 2)
 
 
 MULTIHOST_QUERIES = [1, 3, 5, 13, 16, 18]

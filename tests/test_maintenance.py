@@ -259,3 +259,55 @@ def test_maintenance_functions_on_device_engine():
     maintenance.run_dm_query(
         sess, maintenance.replace_date(qs["DF_SS"], d1, d2))
     assert sess.tables["store_sales"].nrows < n1
+
+
+@pytest.mark.slow
+class TestDistributedBackend:
+    """Maintenance + throughput drives through the `distributed` backend
+    (VERDICT r3 "next" #7): DML and concurrent streams must work over
+    the mesh executor, not only single-device."""
+
+    def test_maintenance_distributed_backend(self, warehouse, tmp_path):
+        from nds_tpu.utils.config import EngineConfig
+
+        cfg = EngineConfig(overrides={"engine.backend": "distributed"})
+        failures = maintenance.run_maintenance(
+            warehouse["wh"], warehouse["refresh"],
+            str(tmp_path / "dm_dist.csv"), config=cfg,
+            commit=False)  # no_commit: the cpu test owns the warehouse
+        assert failures == 0
+        from nds_tpu.utils.timelog import TimeLog
+        rows = {q: ms for _a, q, ms in TimeLog.read(
+            str(tmp_path / "dm_dist.csv"))}
+        assert "Data Maintenance Time" in rows
+        assert sum(1 for q in rows if q.startswith(("LF_", "DF_"))) == 11
+
+    def test_throughput_distributed_backend(self, warehouse, tmp_path,
+                                            monkeypatch):
+        from nds_tpu.nds.streams import generate_query_streams
+        from nds_tpu.nds.throughput import run_streams
+
+        # stream subprocesses re-run interpreter startup, where the
+        # deployment sitecustomize can re-pin jax to the remote TPU
+        # plugin; NDS_TPU_PLATFORM wins (device_exec import contract)
+        monkeypatch.setenv("NDS_TPU_PLATFORM", "cpu")
+
+        sdir = tmp_path / "streams"
+        generate_query_streams(str(sdir), 3, rng_seed=11)  # query_0..2
+        # truncate each stream to its first 3 queries: the test is the
+        # concurrent distributed drive, not 99-query latency
+        short = []
+        for i in (1, 2):
+            txt = (sdir / f"query_{i}.sql").read_text()
+            parts = txt.split("-- start query")
+            cut = "-- start query".join(parts[:4])
+            p = tmp_path / f"short_{i}.sql"
+            p.write_text(cut)
+            short.append(str(p))
+        elapse, codes = run_streams(
+            warehouse["wh"], short, str(tmp_path / "tp"),
+            backend="distributed")
+        assert codes == [0, 0]
+        assert elapse > 0
+        logs = sorted(os.listdir(tmp_path / "tp"))
+        assert [f for f in logs if f.endswith("_time.csv")], logs

@@ -1,3 +1,7 @@
+"""SQL frontend tests: lexer/parser AST shapes and planner rules over
+the TPC-DS/TPC-H grammar subset (the engine half the reference
+delegates to Spark's parser)."""
+
 import pytest
 
 from nds_tpu.sql import ast

@@ -1,3 +1,7 @@
+"""Utility-layer tests: layered config precedence, reference-format
+time logs and JSON summaries, guard checks (reference surface:
+nds/check.py, PysparkBenchReport.py, properties files)."""
+
 import json
 import os
 

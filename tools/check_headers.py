@@ -1,0 +1,41 @@
+"""CI quality gate: every Python source file must open with a module
+docstring that cites its design intent (this repo's documentation
+contract — the analog of the reference's license-header gate,
+`/.github/workflows/license-header-check.yml`).
+
+Exit code 0 when clean; prints each offending file otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOTS = ("nds_tpu", "tests", "tools")
+EXEMPT = {"__init__.py"}
+
+
+def main() -> int:
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    bad = []
+    for root in ROOTS:
+        for p in sorted((repo / root).rglob("*.py")):
+            if p.name in EXEMPT:
+                continue
+            try:
+                tree = ast.parse(p.read_text())
+            except SyntaxError as exc:
+                bad.append(f"{p}: syntax error: {exc}")
+                continue
+            if ast.get_docstring(tree) is None:
+                bad.append(f"{p}: missing module docstring")
+    for line in bad:
+        print(line)
+    print(f"{'FAIL' if bad else 'OK'}: "
+          f"{len(bad)} file(s) missing headers")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
