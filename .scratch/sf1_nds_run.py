@@ -1,0 +1,104 @@
+"""NDS (TPC-DS) SF1 power run on the real chip with out-of-core
+streaming: the big facts (store_sales ~2.9M rows, inventory ~11.7M,
+catalog/web sales) stream through the chunked executor; every query
+validates against the CPU oracle. VERDICT r3 "next" #4 done criterion.
+Writes per-query wall-clocks to SF1_NDS.json (committed artifact).
+
+Usage: python .scratch/sf1_nds_run.py [start_q] [stop_q]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from nds_tpu.utils.xla_cache import enable
+enable()
+
+from nds_tpu.engine.chunked_exec import make_chunked_factory
+from nds_tpu.engine.session import Session
+from nds_tpu.io import table_cache
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.nds import streams
+from nds_tpu.nds.schema import get_schemas
+sys.path.insert(0, "/root/repo/tests")
+
+DATA = "/root/repo/.bench_data/nds_sf1"
+OUT = "/root/repo/SF1_NDS.json"
+
+schemas = get_schemas()
+tables = table_cache.load_tables(DATA, schemas)
+if tables is None:
+    print("generating SF1 tables (cached thereafter)...", flush=True)
+    from nds_tpu.datagen import tpcds
+    tables = {t: from_arrays(t, schemas[t], tpcds.gen_table(t, 1.0))
+              for t in schemas}
+    table_cache.save_tables(DATA, tables)
+
+
+def mk(factory=None):
+    s = Session.for_nds(factory)
+    for t in tables.values():
+        s.register_table(t)
+    return s
+
+
+dev = mk(make_chunked_factory(stream_bytes=256 << 20,
+                              chunk_rows=1 << 21))
+cpu = mk()
+from test_device_engine import assert_frames_close  # noqa: E402
+
+bank = {}
+if os.path.exists(OUT):
+    bank = json.load(open(OUT)).get("queries", {})
+
+qids = streams.available_templates()
+lo = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+hi = int(sys.argv[2]) if len(sys.argv) > 2 else len(qids)
+for qn in qids[lo:hi]:
+    if str(qn) in bank and bank[str(qn)].get("status") == "MATCH":
+        continue
+    try:
+        stmts = [s for s in streams.render_query(qn).split(";")
+                 if s.strip()]
+        t0 = time.perf_counter()
+        gs = []
+        for s in stmts:
+            r = dev.sql(s)
+            if r is not None:
+                gs.append(r)
+        t1 = time.perf_counter()
+        es = []
+        for s in stmts:
+            r = cpu.sql(s)
+            if r is not None:
+                es.append(r)
+        t2 = time.perf_counter()
+        for g, e in zip(gs, es):
+            assert_frames_close(g.to_pandas(), e.to_pandas(),
+                                f"sf1-q{qn}")
+        bank[str(qn)] = {"status": "MATCH",
+                         "device_s": round(t1 - t0, 3),
+                         "cpu_s": round(t2 - t1, 3)}
+        print(f"sf1 nds q{qn}: dev {1000*(t1-t0):.0f} ms "
+              f"cpu {1000*(t2-t1):.0f} ms MATCH", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        bank[str(qn)] = {"status": "FAIL",
+                         "error": f"{type(exc).__name__}: "
+                                  f"{str(exc)[:200]}"}
+        print(f"sf1 nds q{qn}: FAIL {type(exc).__name__}: "
+              f"{str(exc)[:200]}", flush=True)
+    done = [q for q, r in bank.items() if r.get("status") == "MATCH"]
+    summary = {
+        "suite": "nds", "scale_factor": 1.0,
+        "stream_bytes": 256 << 20,
+        "matched": len(done), "total": len(qids),
+        "device_total_s": round(sum(bank[q]["device_s"]
+                                    for q in done), 2),
+        "cpu_total_s": round(sum(bank[q]["cpu_s"] for q in done), 2),
+        "queries": bank,
+    }
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(summary, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+print("done:", json.load(open(OUT))["matched"], "/", len(qids))
