@@ -563,6 +563,12 @@ class DeviceExecutor:
             handled += 1
         return keep if handled else None
 
+    def _reduced_to_device(self, arr: np.ndarray):
+        """Device placement for reduced-scan buffers; DistributedExecutor
+        overrides to build replicated global arrays in multiprocess
+        mode."""
+        return jnp.asarray(arr)
+
     def _upload_reduced(self, bufs: dict, rv: "_ReducedScan",
                         name: str) -> None:
         key = f"{rv.prefix}.{name}"
@@ -578,9 +584,10 @@ class DeviceExecutor:
                 if nulls is not None:
                     nulls = np.concatenate(
                         [nulls, np.zeros(pad, dtype=bool)])
-            self._buffers[key] = jnp.asarray(vals)
+            self._buffers[key] = self._reduced_to_device(vals)
             if nulls is not None:
-                self._buffers[key + "#v"] = jnp.asarray(nulls)
+                self._buffers[key + "#v"] = self._reduced_to_device(
+                    nulls)
         bufs[key] = self._buffers[key]
         if key + "#v" in self._buffers:
             bufs[key + "#v"] = self._buffers[key + "#v"]

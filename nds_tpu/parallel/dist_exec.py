@@ -65,10 +65,11 @@ DEFAULT_SHARD_THRESHOLD = 8192
 class DistributedExecutor(dx.DeviceExecutor):
     """Session-compatible executor that runs plans SPMD over a mesh."""
 
-    # buffer keys here map back to table names for shard-spec routing
-    # (_split_keys); survivor-reduced prefixes would break that and the
-    # shard layout is the capacity story on a mesh anyway
-    SCAN_REDUCE = False
+    # survivor reduction applies to REPLICATED tables only (scan_view
+    # below): filtered dimension scans shrink every device's copy and
+    # all downstream gather-join capacities; sharded tables keep the
+    # shard layout as their capacity story
+    SCAN_REDUCE = True
 
     def __init__(self, tables: dict[str, HostTable], mesh=None,
                  n_devices: int | None = None,
@@ -166,12 +167,48 @@ class DistributedExecutor(dx.DeviceExecutor):
 
         return build, side
 
+    # survivor cap for turning a SHARDED filtered scan into a
+    # replicated reduced build side (the broadcast-join move Spark AQE
+    # makes under its broadcast threshold): survivors above this keep
+    # the sharded layout — replicating them would cost more than the
+    # exchange they avoid
+    BROADCAST_ROWS = 1 << 18
+
+    def scan_view(self, node):
+        rv = super().scan_view(node)
+        if rv is None or not self._is_sharded(node.table):
+            return rv
+        # sharded table: only take the reduced (replicated) form when
+        # the survivor set is broadcast-sized
+        if rv.nrows <= self.BROADCAST_ROWS:
+            return rv
+        # reject permanently: the decision is deterministic, and the
+        # cached view's survivor idx is O(rows) host memory (multi-GB
+        # for a half-surviving SF100 fact) that would otherwise be
+        # retained without ever uploading a buffer
+        for ck, v in self._scan_views.items():
+            if v is rv:
+                self._scan_views[ck] = "full"
+                break
+        return None
+
+    def _reduced_to_device(self, arr):
+        # multiprocess mode needs global (replicated) jax.Arrays
+        return self._dev(arr, sharded=False)
+
     def _split_keys(self, planned):
         bufs = self._collect_buffers(planned)
         sharded, repl = [], []
         for k in bufs:
             table = k.split(".", 1)[0]
-            (sharded if self._is_sharded(table) else repl).append(k)
+            if "@" in table:
+                # reduced-scan buffers ("table@digest.col") are always
+                # replicated — broadcast-sized by scan_view's cap even
+                # when the base table is sharded
+                repl.append(k)
+            else:
+                (sharded if self._is_sharded(table)
+                 else repl).append(k)
         return sharded, repl
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
@@ -294,7 +331,10 @@ class _DistTrace(dx._Trace):
     # ---------------------------------------------------------- plan nodes
 
     def _run_scan(self, node: P.Scan) -> DCtx:
-        if not self.ex._is_sharded(node.table):
+        if (not self.ex._is_sharded(node.table)
+                or self.ex.scan_view(node) is not None):
+            # replicated table, or a sharded one whose filtered
+            # survivors broadcast as a reduced replicated build side
             ctx = super()._run_scan(node)
             ctx.sharded = False
             return ctx

@@ -317,3 +317,54 @@ def test_multihost_mesh_matches_oracle(qn, cpu_session,
     exp = run_query(cpu_session, qn).to_pandas()
     got = run_query(multihost_session, qn).to_pandas()
     assert_frames_close(got, exp, f"2d-{qn}")
+
+
+def test_replicated_scan_reduction_on_mesh(raw, cpu_session):
+    """Survivor reduction on the mesh: filtered REPLICATED scans shrink
+    to reduced pow2 capacity (sharded tables keep the shard layout);
+    results must match the oracle and the shrink must engage."""
+    from nds_tpu.engine.device_exec import _ReducedScan
+    from nds_tpu.parallel.dist_exec import DistributedExecutor
+
+    class SmallReduce(DistributedExecutor):
+        REDUCE_MIN_ROWS = 1
+
+    holder: dict = {}
+
+    def factory(tables):
+        ex = holder.get("ex")
+        if ex is None or ex.tables is not tables:
+            # facts shard; dimensions replicate — so the filtered
+            # customer/part scans are the replicated-reduction targets
+            ex = SmallReduce(tables, n_devices=8,
+                             shard_tables={"lineitem", "orders",
+                                           "partsupp"})
+            holder["ex"] = ex
+        return ex
+
+    schemas = get_schemas()
+    sess = Session.for_nds_h(factory)
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    # q3: filtered replicated customer against two sharded facts;
+    # q10: date-filtered SHARDED orders whose broadcast-sized survivor
+    # set must flip to a replicated reduced build (the AQE-style
+    # broadcast-join move)
+    for qn in (3, 10):
+        exp = run_query(cpu_session, qn).to_pandas()
+        got = run_query(sess, qn).to_pandas()
+        assert_frames_close(got, exp, f"reduce-dist-{qn}")
+    ex = holder["ex"]
+    reduced = [v for v in ex._scan_views.values()
+               if isinstance(v, _ReducedScan)]
+    assert reduced, "no scan reduced on the mesh"
+    for rv in reduced:
+        assert rv.capacity & (rv.capacity - 1) == 0
+    # engagement is proven by UPLOADED reduced buffers (cache entries
+    # exist even when the gate rejects or the trace never reads them)
+    up = {k.split(".", 1)[0].split("@", 1)[0]
+          for k in ex._buffers if "@" in k.split(".", 1)[0]}
+    assert any(not ex._is_sharded(t) for t in up), \
+        "replicated-dimension reduction never uploaded a buffer"
+    assert any(ex._is_sharded(t) for t in up), \
+        "sharded->broadcast reduction never uploaded a buffer"
