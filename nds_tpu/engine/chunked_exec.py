@@ -406,6 +406,14 @@ class ChunkedExecutor(dx.DeviceExecutor):
         bx = dx.DeviceExecutor({table: big})
         full_bounds = {(table, name): bx.col_bounds(table, name)
                        for name in big.columns}
+        # same hazard for the presorted-build fast path: a chunk-0-local
+        # "sorted" verdict would bake a sort-skip into the program later
+        # chunks reuse with swapped (possibly unsorted) buffers — seed
+        # the WHOLE-table verdict instead (a slice of a globally sorted
+        # column is still sorted, so chunk reuse stays valid)
+        full_bounds.update(
+            {(table, name, "sorted"): bx.col_is_sorted(table, name)
+             for name in big.columns})
         parts = []
         for size, group in by_size.items():
             s0, e0 = group[0]

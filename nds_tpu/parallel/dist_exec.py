@@ -211,11 +211,22 @@ class DistributedExecutor(dx.DeviceExecutor):
                  else repl).append(k)
         return sharded, repl
 
+    # compiled shard_map programs are large (the 8-way virtual-CPU
+    # forms of the big NDS plans run to GBs of executable + constant
+    # memory each); a 99-query power run must not accumulate them
+    # unboundedly — LRU-evict beyond this many entries
+    MAX_COMPILED = 24
+
     def execute(self, planned: P.PlannedQuery, key: object = None):
         key = key if key is not None else id(planned)
         if key not in self._compiled:
+            while len(self._compiled) >= self.MAX_COMPILED:
+                self._compiled.pop(next(iter(self._compiled)))
             # strong ref to the plan object, same rationale as the base
             self._compiled[key] = (self._compile(planned), {}, planned)
+        else:
+            # LRU refresh: move the hit to the back of the dict order
+            self._compiled[key] = self._compiled.pop(key)
         (build, side), state, _ref = self._compiled[key]
         slack = state.get("slack", self.slack)
         for attempt in range(3):

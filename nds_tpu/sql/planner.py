@@ -759,6 +759,7 @@ class Planner:
         # expanding build over the fact, q12's 2x-capacity M:N trap)
         start = max(rels, key=lambda r: r.phys_size)
         current = start.node
+        current_rel = start  # bare relation until the first join lands
         joined = {start.binding}
         del remaining[start.binding]
         pending = list(norm)
@@ -794,10 +795,29 @@ class Planner:
                 pairs = cand[best]
                 keys = ([p[0] for p in pairs], [p[1] for p in pairs])
                 right_unique = _uniq(best)
-            current = P.Join("inner", current, nxt.node, keys[0], keys[1],
+            build = nxt.node
+            # the start-largest heuristic assumes the largest rel is a
+            # fact (probe); in dimension-centric blocks (q10:
+            # customer_demographics at 1.92M is the biggest rel but IS
+            # the unique side of its first edge) that would run the
+            # join as an expanding M:N at full capacity. While
+            # `current` is still the bare start relation, flip the
+            # sides so the unique start becomes the gather build.
+            if not right_unique and current_rel is not None:
+                snames = {k.name for k in keys[0]
+                          if isinstance(k, ir.ColRef)
+                          and k.binding == current_rel.binding}
+                if (bool(current_rel.unique_on)
+                        and set(current_rel.unique_on) <= snames):
+                    current = nxt.node
+                    build = current_rel.node
+                    keys = (keys[1], keys[0])
+                    right_unique = True
+            current = P.Join("inner", current, build, keys[0], keys[1],
                              None, right_unique,
-                             output=current.output + nxt.node.output,
+                             output=current.output + build.output,
                              binding=getattr(current, "binding", ""))
+            current_rel = None
             joined.add(nxt.binding)
             del remaining[nxt.binding]
             pending = [e for e in pending

@@ -368,3 +368,39 @@ def test_replicated_scan_reduction_on_mesh(raw, cpu_session):
         "replicated-dimension reduction never uploaded a buffer"
     assert any(ex._is_sharded(t) for t in up), \
         "sharded->broadcast reduction never uploaded a buffer"
+
+
+def test_compiled_program_lru_eviction(raw, cpu_session):
+    """A 99-query power run must not accumulate compiled shard_map
+    programs unboundedly (the full-tier process OOMed at 130GB):
+    entries evict LRU past MAX_COMPILED, and an evicted query
+    recompiles correctly on its next run."""
+    from nds_tpu.parallel.dist_exec import DistributedExecutor
+
+    class TwoSlots(DistributedExecutor):
+        MAX_COMPILED = 2
+
+    holder: dict = {}
+
+    def factory(tables):
+        ex = holder.get("ex")
+        if ex is None or ex.tables is not tables:
+            ex = TwoSlots(tables, n_devices=8,
+                          shard_threshold=THRESHOLD)
+            holder["ex"] = ex
+        return ex
+
+    schemas = get_schemas()
+    sess = Session.for_nds_h(factory)
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    oracle = {}
+    for qn in (6, 1, 3):
+        oracle[qn] = run_query(cpu_session, qn).to_pandas()
+        got = run_query(sess, qn).to_pandas()
+        assert_frames_close(got, oracle[qn], f"lru-{qn}")
+    ex = holder["ex"]
+    assert len(ex._compiled) <= 2
+    # q6 was evicted; re-running it must recompile and still match
+    got = run_query(sess, 6).to_pandas()
+    assert_frames_close(got, oracle[6], "lru-q6-again")
