@@ -1,0 +1,24 @@
+#!/bin/bash
+# Chunked drive of the 99-template distributed differential tier:
+# one pytest process per 9-template batch so compiled shard_map
+# executables (GBs each on the virtual CPU mesh) never accumulate past
+# a process boundary (the full-run process peaked at 130GB and OOMed).
+set -u
+mkdir -p .scratch/dist99
+PASS=0; FAIL=0
+for start in $(seq 0 9 98); do
+  ids=""
+  for q in $(python -c "
+from nds_tpu.nds import streams
+qs = streams.available_templates()[$start:$start+9]
+print(' '.join(str(q) for q in qs))"); do
+    ids="$ids tests/test_distributed.py::test_nds_distributed_matches_oracle[$q]"
+  done
+  timeout 7200 python -m pytest $ids -q > .scratch/dist99/batch_$start.log 2>&1
+  code=$?
+  p=$(grep -oE "[0-9]+ passed" .scratch/dist99/batch_$start.log | grep -oE "[0-9]+" | head -1)
+  f=$(grep -oE "[0-9]+ failed" .scratch/dist99/batch_$start.log | grep -oE "[0-9]+" | head -1)
+  PASS=$((PASS + ${p:-0})); FAIL=$((FAIL + ${f:-0}))
+  echo "batch $start: exit=$code passed=${p:-0} failed=${f:-0} (total $PASS/$((PASS+FAIL)))"
+done
+echo "DIST99 DONE: $PASS passed, $FAIL failed"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# One pytest process per template (for the batches whose 9-template
+# processes OOMed: the q11/q64 YoY family compiles are tens of GB each)
+set -u
+for q in "$@"; do
+  timeout 7200 python -m pytest "tests/test_distributed.py::test_nds_distributed_matches_oracle[$q]" -q > .scratch/dist99/single_$q.log 2>&1
+  code=$?
+  res=$(tail -1 .scratch/dist99/single_$q.log | tr -d '\n')
+  echo "q$q: exit=$code $res"
+done

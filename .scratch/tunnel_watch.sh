@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 min; when it answers, relaunch bench.py
+# (banked cpu times + persistent XLA cache make the restart cheap).
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u) tunnel UP - starting bench" >> .scratch/tunnel_watch.log
+    nohup python bench.py > .scratch/bench_r4_run2.log 2>&1
+    echo "$(date -u) bench exited $?" >> .scratch/tunnel_watch.log
+    exit 0
+  fi
+  echo "$(date -u) tunnel down" >> .scratch/tunnel_watch.log
+  sleep 300
+done

@@ -74,7 +74,7 @@ def _metric_name(leg: str) -> str:
             else f"nds_sf{SF_DS:g}_power_total")
 
 
-def _combined_line() -> str:
+def _combined_dict() -> dict:
     legs = {}
     dev = cpu = completed = total = 0
     for leg in LEGS:
@@ -84,7 +84,7 @@ def _combined_line() -> str:
         cpu += line["value"] * line["vs_baseline"]
         completed += line["queries_completed"]
         total += line["queries_total"]
-    return json.dumps({
+    return {
         "metric": "nds+nds_h_power_total",
         "value": round(dev, 4),
         "unit": "s",
@@ -92,7 +92,11 @@ def _combined_line() -> str:
         "queries_completed": completed,
         "queries_total": total,
         "legs": legs,
-    })
+    }
+
+
+def _combined_line() -> str:
+    return json.dumps(_combined_dict())
 
 
 def _emit_final() -> None:
@@ -167,6 +171,81 @@ def _run_query(session, stmts: list[str]) -> float:
 def _cpu_bank_path(leg: str) -> str:
     sf = SF_H if leg == "nds_h" else SF_DS
     return os.path.join(DATA_ROOT, f"cpu_times_{leg}_sf{sf:g}.json")
+
+
+# ------------------------------------------- device-time bank (stale
+# fallback): the remote chip tunnel can be down for hours (round 4 lost
+# most of a day to one outage). Completed per-query device times
+# persist here; when the device is unreachable at startup the bench
+# emits the banked metric labeled "stale_device_times": true instead of
+# hanging the driver in jax initialization.
+
+def _dev_bank_path(leg: str) -> str:
+    sf = SF_H if leg == "nds_h" else SF_DS
+    return os.path.join(DATA_ROOT, f"device_times_{leg}_sf{sf:g}.json")
+
+
+def _save_dev_bank(leg: str) -> None:
+    path = _dev_bank_path(leg)
+    # merge with what's on disk: a partial run must refine, never
+    # destroy, the last complete run's banked times (the stale
+    # fallback's whole value)
+    try:
+        with open(path) as f:
+            times = json.load(f)
+    except (OSError, ValueError):
+        times = {}
+    times.update({str(qn): r["device_s"] for (lg, qn), r in BANK.items()
+                  if lg == leg and "device_s" in r})
+    with open(path + ".tmp", "w") as f:
+        json.dump(times, f)
+    os.replace(path + ".tmp", path)
+
+
+def _device_reachable(timeout_s: int = 120) -> bool:
+    """jax.devices() blocks forever on a dead tunnel; probe in a
+    subprocess with a hard timeout (same pattern as __graft_entry__)."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return int(proc.stdout.strip().splitlines()[-1]) >= 1
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _emit_stale_from_banks() -> bool:
+    """Load banked device+cpu times and emit the combined line with an
+    explicit staleness marker. Returns False if no banked device leg
+    exists (nothing honest to report)."""
+    any_pairs = False
+    for leg in LEGS:
+        try:
+            with open(_dev_bank_path(leg)) as f:
+                dev_times = json.load(f)
+        except (OSError, ValueError):
+            continue
+        try:
+            with open(_cpu_bank_path(leg)) as f:
+                cpu_times = json.load(f).get("times", {})
+        except (OSError, ValueError):
+            cpu_times = {}
+        for qn, ds in dev_times.items():
+            if qn in cpu_times:
+                BANK[(leg, int(qn))] = {"device_s": ds,
+                                        "cpu_s": cpu_times[qn]}
+                any_pairs = True
+    if not any_pairs:
+        return False
+    line = _combined_dict()
+    line["stale_device_times"] = True
+    line["note"] = ("TPU unreachable at bench time; values are the "
+                    "last completed real-chip run's banked per-query "
+                    "times")
+    print(json.dumps(line), flush=True)
+    return True
 
 
 def _load_cpu_bank(leg: str, tables) -> dict:
@@ -289,6 +368,7 @@ def _run_leg(leg: str) -> None:
                           file=sys.stderr, flush=True)
                     _cleanup_views(dev, stmts)
             BANK.setdefault((leg, qn), {})["device_s"] = dev_s
+            _save_dev_bank(leg)
             # engine-side perf accounting (compile/execute/materialize)
             dev_ex = dev._executor_factory(dev.tables)
             tm = dict(dev_ex.last_timings)
@@ -332,6 +412,17 @@ def main() -> None:
         else:
             from nds_tpu.nds import streams as nds_streams
             LEG_TOTALS[leg] = len(nds_streams.available_templates())
+
+    # the probe only matters when a stale emit is possible: without a
+    # banked device leg there is nothing to fall back to, and a healthy
+    # tunnel shouldn't pay a second serial jax init
+    if any(os.path.exists(_dev_bank_path(leg)) for leg in LEGS) \
+            and not _device_reachable():
+        print("[bench] TPU unreachable (tunnel down) — emitting banked "
+              "metric from the last completed real-chip run",
+              file=sys.stderr, flush=True)
+        if _emit_stale_from_banks():
+            return
 
     from nds_tpu.utils.xla_cache import enable as enable_xla_cache
     cache_dir = enable_xla_cache()
