@@ -32,10 +32,10 @@ from nds_tpu.nds.transcode import get_load_time, get_rngseed
 from nds_tpu.utils.timelog import TimeLog
 
 
-def _run(cmd: list[str]) -> None:
+def _run(cmd: list[str], backend: str | None = None) -> None:
     from nds_tpu.utils.power_core import subprocess_env
     print("+", " ".join(cmd))
-    subprocess.run(cmd, check=True, env=subprocess_env())
+    subprocess.run(cmd, check=True, env=subprocess_env(backend))
 
 
 def get_power_time(time_log_path: str) -> float:
@@ -97,15 +97,17 @@ def run_full_bench(cfg: dict) -> dict:
 
     if not skip.get("data_gen", False):
         _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
-              str(scale), str(parallel), raw_dir, "--overwrite_output"])
+              str(scale), str(parallel), raw_dir, "--overwrite_output"],
+             backend="cpu")
         # one refresh set per maintenance run (2 per full bench)
         for update in (1, 2):
             _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
                   str(scale), "1", f"{refresh_base}{update}",
-                  "--update", str(update), "--overwrite_output"])
+                  "--update", str(update), "--overwrite_output"],
+                 backend="cpu")
     if not skip.get("load_test", False):
         _run([sys.executable, "-m", "nds_tpu.nds.transcode",
-              raw_dir, wh_dir, load_report])
+              raw_dir, wh_dir, load_report], backend="cpu")
     metrics["load_time_s"] = tld = get_load_time(load_report)
     rngseed = get_rngseed(load_report)
 
@@ -122,7 +124,8 @@ def run_full_bench(cfg: dict) -> dict:
         _run([sys.executable, "-m", "nds_tpu.nds.power",
               wh_dir, os.path.join(stream_dir, "query_0.sql"), power_log,
               "--backend", backend,
-              "--json_summary_folder", os.path.join(report_dir, "json")])
+              "--json_summary_folder", os.path.join(report_dir, "json")],
+             backend=backend)
     metrics["power_time_s"] = tpt = get_power_time(power_log)
 
     ttts, tdms = [], []
@@ -156,7 +159,7 @@ def run_full_bench(cfg: dict) -> dict:
                                   f"maintenance{round_no}_time.csv")
             _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
                   wh_dir, f"{refresh_base}{round_no}", dm_log,
-                  "--backend", backend])
+                  "--backend", backend], backend=backend)
             tdms.append(get_maintenance_time(dm_log))
     metrics["throughput_times_s"] = ttts
     metrics["maintenance_times_s"] = tdms

@@ -31,10 +31,10 @@ from nds_tpu.nds_h.transcode import get_load_time, get_rngseed
 from nds_tpu.utils.timelog import TimeLog
 
 
-def _run(cmd: list[str]) -> None:
+def _run(cmd: list[str], backend: str | None = None) -> None:
     from nds_tpu.utils.power_core import subprocess_env
     print("+", " ".join(cmd))
-    subprocess.run(cmd, check=True, env=subprocess_env())
+    subprocess.run(cmd, check=True, env=subprocess_env(backend))
 
 
 def get_power_time(time_log_path: str) -> float:
@@ -73,10 +73,11 @@ def run_full_bench(cfg: dict) -> dict:
 
     if not cfg.get("skip", {}).get("data_gen", False):
         _run([sys.executable, "-m", "nds_tpu.nds_h.gen_data",
-              str(scale), str(parallel), raw_dir, "--overwrite_output"])
+              str(scale), str(parallel), raw_dir, "--overwrite_output"],
+             backend="cpu")
     if not cfg.get("skip", {}).get("load_test", False):
         _run([sys.executable, "-m", "nds_tpu.nds_h.transcode",
-              raw_dir, wh_dir, load_report])
+              raw_dir, wh_dir, load_report], backend="cpu")
     metrics["load_time_s"] = tld = get_load_time(load_report)
     rngseed = get_rngseed(load_report)
 
@@ -90,7 +91,8 @@ def run_full_bench(cfg: dict) -> dict:
         _run([sys.executable, "-m", "nds_tpu.nds_h.power",
               wh_dir, os.path.join(stream_dir, "stream_0.sql"), power_log,
               "--backend", backend,
-              "--json_summary_folder", os.path.join(report_dir, "json")])
+              "--json_summary_folder", os.path.join(report_dir, "json")],
+             backend=backend)
     metrics["power_time_s"] = tpt = get_power_time(power_log)
 
     tstreams = [os.path.join(stream_dir, f"stream_{i}.sql")

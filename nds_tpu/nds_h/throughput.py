@@ -33,7 +33,7 @@ def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                data_dir, sp, tlog, "--backend", backend,
                "--input_format", input_format]
         from nds_tpu.utils.power_core import subprocess_env
-        procs.append(subprocess.Popen(cmd, env=subprocess_env()))
+        procs.append(subprocess.Popen(cmd, env=subprocess_env(backend)))
     codes = [p.wait() for p in procs]
     elapse = time.time() - start
     # round up to 0.1 s, the reference's Ttt granularity

@@ -272,15 +272,28 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     return failures
 
 
-def subprocess_env() -> dict:
+def subprocess_env(backend: str | None = None) -> dict:
     """Environment for phase subprocesses: nds_tpu importable regardless
     of the orchestrator's cwd (preserving the ambient PYTHONPATH — the
-    TPU plugin's site dir may live there)."""
+    TPU plugin's site dir may live there).
+
+    A cpu-backend subprocess additionally pins NDS_TPU_PLATFORM=cpu:
+    the deployment sitecustomize re-points JAX at the remote TPU plugin
+    at interpreter startup, and initializing that backend can block
+    indefinitely when the chip tunnel is down — a pure-CPU phase must
+    never touch the accelerator at all."""
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    if backend == "cpu":
+        env["NDS_TPU_PLATFORM"] = "cpu"
+    elif backend is not None:
+        # the backend argument is authoritative: a stale cpu pin in the
+        # launching shell must not silently demote tpu/distributed
+        # phases to CPU timings
+        env.pop("NDS_TPU_PLATFORM", None)
     return env
 
 

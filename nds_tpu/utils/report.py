@@ -87,10 +87,26 @@ class BenchReport:
         conf = dict(self._engine_info)
         try:
             import jax
-            conf.setdefault("backend", jax.default_backend())
-            conf.setdefault("device_count", jax.device_count())
-            conf.setdefault(
-                "devices", [str(d) for d in jax.devices()][:8])
+
+            # NEVER initialize backends from the reporter:
+            # jax.default_backend()/devices() force platform discovery,
+            # and on a remote-attached chip (axon) that blocks
+            # indefinitely when the tunnel is down — which froze even
+            # pure-CPU power runs. Only report a backend that is
+            # ALREADY live; otherwise record the configured platform.
+            from jax._src import xla_bridge as _xb
+            if getattr(_xb, "_backends", None):
+                # discovery already completed: the canonical accessors
+                # are cached and non-blocking now, and report the
+                # PRIORITY backend (not registration order)
+                conf.setdefault("backend", jax.default_backend())
+                conf.setdefault("device_count", jax.device_count())
+                conf.setdefault(
+                    "devices", [str(d) for d in jax.devices()][:8])
+            else:
+                conf.setdefault(
+                    "backend",
+                    f"configured:{jax.config.jax_platforms or 'auto'}")
             self.summary["env"]["engineVersion"] = f"jax-{jax.__version__}"
         except Exception:  # jax optional for harness-only paths
             self.summary["env"]["engineVersion"] = "cpu-harness"
