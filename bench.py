@@ -1,5 +1,6 @@
-"""Round benchmark: NDS-H (22 queries) + NDS (99 queries) power runs,
-TPU engine vs CPU oracle.
+"""Round benchmark: NDS-H (22 queries) + NDS (103 statements — the 99
+TPC-DS templates with q14/q23/q24/q39 split into _part1/_part2) power
+runs, TPU engine vs CPU oracle.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} as the
 LAST line of stdout (the driver's contract). That line is the combined
@@ -39,9 +40,10 @@ import time
 # compute dominates the per-query tunnel RTT floor, small enough that
 # the CPU-oracle denominator finishes within the driver budget; data
 # (.bench_data/) and XLA executables (.xla_cache/) persist across runs,
-# so the driver's timed run skips datagen and compiles
-SF_H = float(os.environ.get("BENCH_SF", "0.3"))
-SF_DS = float(os.environ.get("BENCH_NDS_SF", "0.1"))
+# so the driver's timed run skips datagen and compiles. Round 5 moved
+# both legs to SF1 (VERDICT r4: SF0.1/0.3 times are tunnel-RTT noise).
+SF_H = float(os.environ.get("BENCH_SF", "1"))
+SF_DS = float(os.environ.get("BENCH_NDS_SF", "1"))
 HERE = os.path.dirname(os.path.abspath(__file__))
 DATA_ROOT = os.environ.get("BENCH_DATA", os.path.join(HERE, ".bench_data"))
 # which legs run (comma list); the NDS-H leg runs first so a budget
@@ -49,6 +51,9 @@ DATA_ROOT = os.environ.get("BENCH_DATA", os.path.join(HERE, ".bench_data"))
 LEGS = os.environ.get("BENCH_LEGS", "nds_h,nds").split(",")
 
 # banked per-query results: (leg, qname) -> {"device_s": .., "cpu_s": ..}
+# qname is a string: "7", or "14_part1"/"14_part2" for the four
+# two-statement TPC-DS templates (103 executable statements per stream,
+# reference `nds/nds_gen_query_stream.py:91-103` + `nds_power.py:50-77`)
 BANK: dict[tuple, dict] = {}
 LEG_TOTALS: dict[str, int] = {}  # leg -> queries_total
 _done = False
@@ -185,62 +190,127 @@ def _dev_bank_path(leg: str) -> str:
     return os.path.join(DATA_ROOT, f"device_times_{leg}_sf{sf:g}.json")
 
 
-def _save_dev_bank(leg: str) -> None:
+def _rows_fingerprint(tables) -> dict:
+    return {t: tb.nrows for t, tb in tables.items()}
+
+
+_BANK_DEVICE_TIMES = True  # cleared when the timed leg runs off-TPU
+
+
+def _purge_presplit(times: dict) -> dict:
+    """Round-4 banks timed the two-statement templates as one combined
+    key ('14'); merging part keys next to it would double-count the
+    template in a later stale emit — the split times win."""
+    for base in [k for k in times
+                 if "_part" not in k and f"{k}_part1" in times]:
+        del times[base]
+    return times
+
+
+def _save_dev_bank(leg: str, rows: dict) -> None:
+    if not _BANK_DEVICE_TIMES:
+        return  # never bank CPU wall-clocks as device_s (ADVICE r4)
     path = _dev_bank_path(leg)
     # merge with what's on disk: a partial run must refine, never
     # destroy, the last complete run's banked times (the stale
     # fallback's whole value)
     try:
         with open(path) as f:
-            times = json.load(f)
+            bank = json.load(f)
+        if "times" not in bank:  # legacy flat {qname: s} format
+            bank = {"rows": None, "times": bank}
     except (OSError, ValueError):
-        times = {}
-    times.update({str(qn): r["device_s"] for (lg, qn), r in BANK.items()
-                  if lg == leg and "device_s" in r})
+        bank = {"rows": None, "times": {}}
+    if bank["rows"] is not None and bank["rows"] != rows:
+        bank = {"rows": None, "times": {}}  # data changed: restart bank
+    bank["rows"] = rows
+    bank["times"].update(
+        {qn: r["device_s"] for (lg, qn), r in BANK.items()
+         if lg == leg and "device_s" in r})
+    _purge_presplit(bank["times"])
     with open(path + ".tmp", "w") as f:
-        json.dump(times, f)
+        json.dump(bank, f)
     os.replace(path + ".tmp", path)
 
 
-def _device_reachable(timeout_s: int = 120) -> bool:
-    """jax.devices() blocks forever on a dead tunnel; probe in a
-    subprocess with a hard timeout (same pattern as __graft_entry__)."""
+def _probe_backend(timeout_s: int = 120) -> str:
+    """Active jax backend ('tpu'/'cpu'/...) or '' when unreachable.
+    jax.devices() blocks forever on a dead tunnel, and a failed TPU
+    plugin silently falls back to CPU (ADVICE r4) — so probe in a
+    subprocess with a hard timeout AND verify the backend kind, never
+    just device count."""
     import subprocess
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
+             "import jax; assert jax.devices(); "
+             "print(jax.default_backend())"],
             capture_output=True, text=True, timeout=timeout_s)
-        return int(proc.stdout.strip().splitlines()[-1]) >= 1
+        return proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
     except Exception:  # noqa: BLE001
-        return False
+        return ""
+
+
+def _load_bank_pair(leg: str, dev_path: str, cpu_path: str) -> int:
+    """Pair one device bank with its cpu bank into BANK; returns pairs
+    added. Fingerprint discipline (ADVICE r4): when both banks carry a
+    rows fingerprint they must match; a legacy device bank without one
+    pairs only against same-SF cpu times (same path key) and is
+    labeled by the caller."""
+    try:
+        with open(dev_path) as f:
+            dev_bank = json.load(f)
+        if "times" not in dev_bank:
+            dev_bank = {"rows": None, "times": dev_bank}
+    except (OSError, ValueError):
+        return 0
+    try:
+        with open(cpu_path) as f:
+            cpu_bank = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if dev_bank["rows"] is not None \
+            and cpu_bank.get("rows") not in (None, dev_bank["rows"]):
+        return 0  # regenerated data: refuse the mismatched ratio
+    added = 0
+    cpu_times = _purge_presplit(dict(cpu_bank.get("times", {})))
+    _purge_presplit(dev_bank["times"])
+    for qn, ds in dev_bank["times"].items():
+        if qn in cpu_times:
+            BANK[(leg, qn)] = {"device_s": ds, "cpu_s": cpu_times[qn]}
+            added += 1
+    return added
 
 
 def _emit_stale_from_banks() -> bool:
     """Load banked device+cpu times and emit the combined line with an
     explicit staleness marker. Returns False if no banked device leg
-    exists (nothing honest to report)."""
+    exists (nothing honest to report). Falls back to banks at OTHER
+    scale factors (earlier rounds' runs) when the configured SF has
+    none, relabeling the metric accordingly."""
+    import glob
     any_pairs = False
+    fallback_sf = {}
     for leg in LEGS:
-        try:
-            with open(_dev_bank_path(leg)) as f:
-                dev_times = json.load(f)
-        except (OSError, ValueError):
-            continue
-        try:
-            with open(_cpu_bank_path(leg)) as f:
-                cpu_times = json.load(f).get("times", {})
-        except (OSError, ValueError):
-            cpu_times = {}
-        for qn, ds in dev_times.items():
-            if qn in cpu_times:
-                BANK[(leg, int(qn))] = {"device_s": ds,
-                                        "cpu_s": cpu_times[qn]}
-                any_pairs = True
+        n = _load_bank_pair(leg, _dev_bank_path(leg), _cpu_bank_path(leg))
+        if n == 0:
+            # any completed real-chip run at another SF beats silence
+            pat = os.path.join(DATA_ROOT, f"device_times_{leg}_sf*.json")
+            for dev_path in sorted(glob.glob(pat), reverse=True):
+                sf = os.path.basename(dev_path)[
+                    len(f"device_times_{leg}_sf"):-len(".json")]
+                cpu_path = os.path.join(
+                    DATA_ROOT, f"cpu_times_{leg}_sf{sf}.json")
+                if _load_bank_pair(leg, dev_path, cpu_path):
+                    fallback_sf[leg] = sf
+                    break
+        any_pairs = any_pairs or any(k[0] == leg for k in BANK)
     if not any_pairs:
         return False
     line = _combined_dict()
     line["stale_device_times"] = True
+    if fallback_sf:
+        line["stale_fallback_sf"] = fallback_sf
     line["note"] = ("TPU unreachable at bench time; values are the "
                     "last completed real-chip run's banked per-query "
                     "times")
@@ -268,7 +338,7 @@ def _save_cpu_bank(leg: str, tables, times: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"rows": {t: tb.nrows for t, tb in tables.items()},
-                   "times": times}, f)
+                   "times": _purge_presplit(dict(times))}, f)
     os.replace(tmp, path)
 
 
@@ -296,29 +366,59 @@ def _cleanup_views(session, stmts: list[str]) -> None:
                 pass
 
 
+def _leg_units(leg: str) -> list:
+    """[(qname, [stmt, ...]), ...] — one unit per TIMED query. NDS
+    two-statement templates contribute one unit per statement
+    (query14_part1/query14_part2 timed separately, the reference's
+    `nds_power.py:50-77` contract → 103 NDS units); NDS-H keeps one
+    unit per template with q15's create-view/select/drop statements
+    timed together."""
+    units = []
+
+    def _render(qn, streams):
+        # a broken template must cost one unit, not the whole bench
+        # (this runs at startup, before any metric can be emitted)
+        try:
+            return _statements(leg, qn, streams.render_query(qn))
+        except Exception as exc:  # noqa: BLE001
+            print(f"[bench] {leg} q{qn}: template render failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr,
+                  flush=True)
+            return None
+
+    if leg == "nds_h":
+        from nds_tpu.nds_h import streams
+        for qn in range(1, 23):
+            units.append((str(qn), _render(qn, streams)))
+        return units
+    from nds_tpu.nds import streams
+    qids = streams.available_templates()
+    # budget insurance: the handful of giant-program templates
+    # (multi-hour XLA compiles when the persistent cache is cold)
+    # run LAST so a budget kill mid-compile still banks the other
+    # queries. Pure ordering — every template still runs, and
+    # with a warm cache the order is irrelevant.
+    defer = {int(x) for x in os.environ.get(
+        "BENCH_DEFER", "39,59,67,78").split(",") if x}
+    for qn in ([q for q in qids if q not in defer]
+               + [q for q in qids if q in defer]):
+        stmts = _render(qn, streams)
+        if stmts is None or len(stmts) == 1:
+            units.append((str(qn), stmts))
+        else:
+            for i, s in enumerate(stmts, 1):
+                units.append((f"{qn}_part{i}", [s]))
+    return units
+
+
 def _run_leg(leg: str) -> None:
     from nds_tpu.engine.device_exec import make_device_factory
     from nds_tpu.engine.session import Session
 
-    if leg == "nds_h":
-        from nds_tpu.nds_h import streams
-        qids = list(range(1, 23))
-        mk = Session.for_nds_h
-    else:
-        from nds_tpu.nds import streams
-        qids = streams.available_templates()
-        mk = Session.for_nds
-        # budget insurance: the handful of giant-program templates
-        # (multi-hour XLA compiles when the persistent cache is cold)
-        # run LAST so a budget kill mid-compile still banks the other
-        # 95 queries. Pure ordering — every template still runs, and
-        # with a warm cache the order is irrelevant.
-        defer = {int(x) for x in os.environ.get(
-            "BENCH_DEFER", "39,59,67,78").split(",") if x}
-        qids = ([q for q in qids if q not in defer]
-                + [q for q in qids if q in defer])
-
+    mk = Session.for_nds_h if leg == "nds_h" else Session.for_nds
+    units = _leg_units(leg)
     tables = _load_or_gen(leg)
+    rows = _rows_fingerprint(tables)
     dev = mk(make_device_factory())
     cpu = mk()
     for t in tables.values():
@@ -330,12 +430,12 @@ def _run_leg(leg: str) -> None:
         print(f"[bench] {leg}: {len(cpu_bank)} banked cpu-oracle times "
               f"from {_cpu_bank_path(leg)}", file=sys.stderr, flush=True)
 
-    for qn in qids:
+    for qn, stmts in units:
+        if stmts is None:  # template failed to render at startup
+            continue
         # one broken query must not cost the rest of the run (the
         # reference's --allow_failure mode, `nds/nds_power.py:391-393`)
         try:
-            sql = streams.render_query(qn)
-            stmts = _statements(leg, qn, sql)
             # untimed warmup: AOT compile + one execution per statement.
             # The remote compile service drops connections under long
             # compiles ("response body closed" / "Unexpected EOF") —
@@ -368,16 +468,16 @@ def _run_leg(leg: str) -> None:
                           file=sys.stderr, flush=True)
                     _cleanup_views(dev, stmts)
             BANK.setdefault((leg, qn), {})["device_s"] = dev_s
-            _save_dev_bank(leg)
+            _save_dev_bank(leg, rows)
             # engine-side perf accounting (compile/execute/materialize)
             dev_ex = dev._executor_factory(dev.tables)
             tm = dict(dev_ex.last_timings)
-            banked = cpu_bank.get(str(qn))
+            banked = cpu_bank.get(qn)
             if banked is not None:
                 cpu_s = float(banked)
             else:
                 cpu_s = _run_query(cpu, stmts)
-                cpu_bank[str(qn)] = cpu_s
+                cpu_bank[qn] = cpu_s
                 _save_cpu_bank(leg, tables, cpu_bank)
             BANK[(leg, qn)]["cpu_s"] = cpu_s
         except Exception as exc:  # noqa: BLE001
@@ -405,24 +505,31 @@ def main() -> None:
     # totals for EVERY leg up front — and before the (multi-second,
     # kill-prone) TPU init below: a kill at any point must still count
     # every leg's queries in queries_total (else a 22/22 nds_h-only
-    # partial reads as a complete 121-query run)
+    # partial reads as a complete 125-unit run). NDS counts 103 units
+    # (the four two-statement templates split into parts).
     for leg in LEGS:
-        if leg == "nds_h":
-            LEG_TOTALS[leg] = 22
-        else:
-            from nds_tpu.nds import streams as nds_streams
-            LEG_TOTALS[leg] = len(nds_streams.available_templates())
+        LEG_TOTALS[leg] = len(_leg_units(leg))
 
-    # the probe only matters when a stale emit is possible: without a
-    # banked device leg there is nothing to fall back to, and a healthy
-    # tunnel shouldn't pay a second serial jax init
-    if any(os.path.exists(_dev_bank_path(leg)) for leg in LEGS) \
-            and not _device_reachable():
-        print("[bench] TPU unreachable (tunnel down) — emitting banked "
-              "metric from the last completed real-chip run",
+    # the probe guards two failure modes: a dead tunnel (jax init hangs
+    # forever) and a failed TPU plugin silently falling back to CPU
+    # (which would bank CPU wall-clocks as device_s — ADVICE r4)
+    global _BANK_DEVICE_TIMES
+    backend = _probe_backend()
+    want = os.environ.get("BENCH_BACKEND", "tpu")
+    _BANK_DEVICE_TIMES = backend == "tpu" == want
+    if backend != want:
+        print(f"[bench] device backend {backend or 'UNREACHABLE'!r} != "
+              f"{want!r} (tunnel down or plugin fell back) — emitting "
+              "banked metric from the last completed real-chip run",
               file=sys.stderr, flush=True)
         if _emit_stale_from_banks():
             return
+        print("[bench] no banked real-chip run available either — "
+              "no honest metric to emit", file=sys.stderr, flush=True)
+        line = _combined_dict()
+        line["device_unreachable"] = True
+        print(json.dumps(line), flush=True)
+        return
 
     from nds_tpu.utils.xla_cache import enable as enable_xla_cache
     cache_dir = enable_xla_cache()

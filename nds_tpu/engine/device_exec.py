@@ -112,23 +112,53 @@ def _pred_sig(e) -> str:
     return repr(e)
 
 
+def _ir_children(e):
+    """Every IR node reachable one step below e, descending through
+    arbitrarily nested lists/tuples (CaseIR.whens is a list of (IR, IR)
+    TUPLES — a flat isinstance walk silently skips everything inside a
+    CASE arm)."""
+    import dataclasses
+    for f in dataclasses.fields(e):
+        stack = [getattr(e, f.name)]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, (list, tuple)):
+                stack.extend(v)
+            elif isinstance(v, ir.IR):
+                yield v
+
+
 def _touches_float(e) -> bool:
     """True if evaluating e involves float compute anywhere (FloatType
     values or division, which routes decimals through floats)."""
-    import dataclasses
     if isinstance(e, ir.IR):
         if isinstance(getattr(e, "dtype", None), FloatType):
             return True
         if isinstance(e, ir.Arith) and e.op == "/":
             return True
-        for f in dataclasses.fields(e):
-            v = getattr(e, f.name)
-            if isinstance(v, (list, tuple)):
-                if any(_touches_float(x) for x in v):
-                    return True
-            elif _touches_float(v):
-                return True
+        return any(_touches_float(c) for c in _ir_children(e))
     return False
+
+
+_EXACT_FLOAT_NODES = (
+    ir.ColRef, ir.Lit, ir.Arith, ir.Cmp, ir.BoolOp, ir.Not, ir.Neg,
+    ir.CaseIR, ir.LikeIR, ir.InListIR, ir.IsNullIR, ir.ExtractIR,
+    ir.SubstrIR, ir.StrMapIR, ir.ConcatIR, ir.CastIR)
+
+
+def _float_exact_safe(e) -> bool:
+    """Host f64 reduction of a float-touching predicate is only sound
+    when every node in it evaluates bit-identically between numpy and
+    the device f64 path. IEEE +,-,*,/ comparisons and the
+    string/date/case nodes above are exact on both; anything NOT in
+    the whitelist (a future transcendental, say) must refuse host
+    reduction rather than silently drop rows the device re-filter can
+    never resurrect (advisor finding, round 4)."""
+    if isinstance(e, ir.IR):
+        if not isinstance(e, _EXACT_FLOAT_NODES):
+            return False
+        return all(_float_exact_safe(c) for c in _ir_children(e))
+    return True
 
 
 class _ReducedScan:
@@ -548,9 +578,12 @@ class DeviceExecutor:
             # float predicate can legitimately flip near a boundary
             # between host float64 and device float32 — a row the host
             # drops is gone for good, so float-touching predicates only
-            # filter on device there. Exact f64 mode matches numpy
-            # bit-for-bit (IEEE ops) and reduces on every predicate.
-            if self.float_dtype is not None and _touches_float(pred):
+            # filter on device there. Exact f64 mode reduces only on
+            # predicates whose every op is IEEE-exact on both sides
+            # (_float_exact_safe; all of today's ops qualify).
+            if _touches_float(pred) and (
+                    self.float_dtype is not None
+                    or not _float_exact_safe(pred)):
                 continue
             try:
                 m, mv = helper.eval(pred, ctx)

@@ -157,3 +157,4 @@ where this_year.i_brand_id = last_year.i_brand_id
   and this_year.i_category_id = last_year.i_category_id
 order by ty_channel, ty_brand, ty_class, ty_category
 limit 100
+;

@@ -89,3 +89,4 @@ from (select c_last_name, c_first_name,
       group by c_last_name, c_first_name) y
 order by c_last_name, c_first_name, sales
 limit 100
+;

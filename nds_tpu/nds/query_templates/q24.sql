@@ -46,3 +46,4 @@ where i_color = '{c2}'
 group by c_last_name, c_first_name, s_store_name
 having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)
 order by c_last_name, c_first_name, s_store_name
+;

@@ -49,3 +49,4 @@ where inv1.i_item_sk = inv2.i_item_sk
   and inv2.d_moy = {month} + 1
   and inv1.cov > 1.5
 order by wsk1, isk1, dmoy1, mean1, cov1, dmoy2, mean2, cov2
+;

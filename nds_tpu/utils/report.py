@@ -87,29 +87,40 @@ class BenchReport:
         conf = dict(self._engine_info)
         try:
             import jax
-
+            self.summary["env"]["engineVersion"] = f"jax-{jax.__version__}"
+        except Exception:  # jax optional for harness-only paths
+            jax = None
+            self.summary["env"]["engineVersion"] = "cpu-harness"
+        if jax is not None:
             # NEVER initialize backends from the reporter:
             # jax.default_backend()/devices() force platform discovery,
             # and on a remote-attached chip (axon) that blocks
             # indefinitely when the tunnel is down — which froze even
             # pure-CPU power runs. Only report a backend that is
             # ALREADY live; otherwise record the configured platform.
-            from jax._src import xla_bridge as _xb
-            if getattr(_xb, "_backends", None):
-                # discovery already completed: the canonical accessors
-                # are cached and non-blocking now, and report the
-                # PRIORITY backend (not registration order)
-                conf.setdefault("backend", jax.default_backend())
-                conf.setdefault("device_count", jax.device_count())
-                conf.setdefault(
-                    "devices", [str(d) for d in jax.devices()][:8])
-            else:
-                conf.setdefault(
-                    "backend",
-                    f"configured:{jax.config.jax_platforms or 'auto'}")
-            self.summary["env"]["engineVersion"] = f"jax-{jax.__version__}"
-        except Exception:  # jax optional for harness-only paths
-            self.summary["env"]["engineVersion"] = "cpu-harness"
+            # The live-check peeks at a PRIVATE jax symbol, so it gets
+            # its own try: if a jax upgrade moves it, we still record
+            # the jax version + configured platform (advisor, round 4).
+            try:
+                from jax._src import xla_bridge as _xb
+                if getattr(_xb, "_backends", None):
+                    # discovery already completed: the canonical
+                    # accessors are cached and non-blocking now, and
+                    # report the PRIORITY backend (not registration
+                    # order)
+                    conf.setdefault("backend", jax.default_backend())
+                    conf.setdefault("device_count", jax.device_count())
+                    conf.setdefault(
+                        "devices", [str(d) for d in jax.devices()][:8])
+                else:
+                    raise LookupError("backends not initialized")
+            except Exception:
+                try:
+                    platforms = jax.config.jax_platforms
+                except Exception:
+                    platforms = None
+                conf.setdefault("backend",
+                                f"configured:{platforms or 'auto'}")
         self.summary["env"]["engineConf"] = {str(k): str(v) for k, v in conf.items()}
 
     def report_on(self, fn: Callable, *args):

@@ -297,6 +297,38 @@ class TestStreamRebinding:
                 sess.plan(stmt)
 
 
+class TestStreamStatementParity:
+    def test_stream_carries_103_statements(self, tmp_path):
+        """The reference runs 103 executable statements per stream, not
+        99: templates 14/23/24/39 are two-statement and split into
+        _part1/_part2 (`nds/nds_gen_query_stream.py:91-103`,
+        `nds/nds_power.py:50-77`)."""
+        sdir = str(tmp_path / "s")
+        paths = streams.generate_query_streams(sdir, 1, rng_seed=31)
+        qd = streams.parse_query_stream(paths[0])
+        assert len(qd) == 103
+        for qn in (14, 23, 24, 39):
+            assert f"query{qn}_part1" in qd and f"query{qn}_part2" in qd
+            assert f"query{qn}" not in qd
+            # the two parts are distinct statements, not a re-split of one
+            assert qd[f"query{qn}_part1"] != qd[f"query{qn}_part2"]
+        # every other template contributes exactly one statement
+        singles = [k for k in qd if "_part" not in k]
+        assert len(singles) == 95
+
+    def test_both_parts_plan(self):
+        """Both statements of each two-part template must get through
+        the frontend (planner), not just the first."""
+        from nds_tpu.engine.session import Session
+        sess = Session.for_nds()
+        for qn in (14, 23, 24, 39):
+            stmts = [s for s in streams.render_query(qn).split(";")
+                     if s.strip()]
+            assert len(stmts) == 2
+            for stmt in stmts:
+                sess.plan(stmt)
+
+
 class TestThroughputInProcess:
     def test_one_chip_time_sharing(self, pipeline, tmp_path):
         """The single-process multi-stream mode: one warehouse load, one
