@@ -1,0 +1,47 @@
+"""Pre-bank the CPU-oracle denominator for bench.py's legs.
+
+Run with the tunnel down (pure CPU): generates/loads SF data, times the
+CPU oracle per unit, and saves incrementally to bench's cpu bank format
+so the driver's device run only pays the device leg.
+
+Usage: NDS_TPU_PLATFORM=cpu python .scratch/bank_cpu.py nds_h nds
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("NDS_TPU_PLATFORM", "cpu")  # never touch the tunnel
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+from nds_tpu.engine.session import Session  # noqa: E402
+
+for leg in sys.argv[1:]:
+    tables = bench._load_or_gen(leg)
+    units = bench._leg_units(leg)
+    mk = Session.for_nds_h if leg == "nds_h" else Session.for_nds
+    cpu = mk()
+    for t in tables.values():
+        cpu.register_table(t)
+    times = bench._load_cpu_bank(leg, tables)
+    print(f"[bank_cpu] {leg}: {len(times)} already banked, "
+          f"{len(units)} units total", flush=True)
+    for qn, stmts in units:
+        if stmts is None or qn in times:
+            continue
+        try:
+            t0 = time.perf_counter()
+            for s in stmts:
+                cpu.sql(s)
+            times[qn] = time.perf_counter() - t0
+        except Exception as exc:  # noqa: BLE001
+            print(f"[bank_cpu] {leg} q{qn}: FAILED "
+                  f"{type(exc).__name__}: {exc}", flush=True)
+            continue
+        bench._save_cpu_bank(leg, tables, times)
+        print(f"[bank_cpu] {leg} q{qn}: {times[qn]*1000:.0f} ms", flush=True)
+    print(f"[bank_cpu] {leg} done: {len(times)}/{len(units)}", flush=True)
+
+open(os.path.join(os.path.dirname(__file__), "cpu_bank_done"), "w").write(
+    str(time.time()))
+print("[bank_cpu] all legs done", flush=True)

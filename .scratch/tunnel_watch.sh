@@ -1,11 +1,25 @@
 #!/bin/bash
-# Probe the TPU tunnel every 5 min; when it answers, relaunch bench.py
-# (banked cpu times + persistent XLA cache make the restart cheap).
+# Round-5 watchdog: probe the TPU tunnel every 5 min; when it answers,
+# launch bench.py (SF1 legs) plus ONE reverse-order compile warmer for
+# the NDS leg (2 concurrent compile clients max — 3 wedged the remote
+# compile service in round 4). Waits for the CPU-oracle banking job to
+# finish first so the timed legs never share the single core.
+cd /root/repo
 while true; do
-  if timeout 90 python -c "import jax; assert jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u) tunnel UP - starting bench" >> .scratch/tunnel_watch.log
-    nohup python bench.py > .scratch/bench_r4_run2.log 2>&1
+  if timeout 90 python -c "import jax; assert len(jax.devices())>=1 and jax.default_backend()!='cpu'" >/dev/null 2>&1; then
+    echo "$(date -u) tunnel UP" >> .scratch/tunnel_watch.log
+    for i in $(seq 90); do
+      [ -f .scratch/cpu_bank_done ] && break
+      pgrep -f bank_cpu.py >/dev/null || break
+      sleep 60
+    done
+    echo "$(date -u) starting bench + warmer" >> .scratch/tunnel_watch.log
+    nohup python .scratch/warm_nds.py nds 0 99 reverse \
+        > .scratch/warm_r5.log 2>&1 &
+    WARMER=$!
+    nohup python bench.py > .scratch/bench_r5_run.log 2>&1
     echo "$(date -u) bench exited $?" >> .scratch/tunnel_watch.log
+    kill $WARMER 2>/dev/null
     exit 0
   fi
   echo "$(date -u) tunnel down" >> .scratch/tunnel_watch.log

@@ -32,13 +32,15 @@ if leg == "nds":
     from nds_tpu.nds.schema import get_schemas
     qids = streams.available_templates()
     mk = Session.for_nds
-    data_dir = "/root/repo/.bench_data/nds_sf0.1"
+    data_dir = os.environ.get(
+        "WARM_DATA", "/root/repo/.bench_data/nds_sf1")
 else:
     from nds_tpu.nds_h import streams
     from nds_tpu.nds_h.schema import get_schemas
     qids = list(range(1, 23))
     mk = Session.for_nds_h
-    data_dir = "/root/repo/.bench_data/nds_h_sf0.3"
+    data_dir = os.environ.get(
+        "WARM_DATA", "/root/repo/.bench_data/nds_h_sf1")
 
 tables = table_cache.load_tables(data_dir, get_schemas())
 assert tables is not None, data_dir
