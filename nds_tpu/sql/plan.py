@@ -41,6 +41,20 @@ class DerivedScan(Node):
 
 
 @dataclass
+class StagedScan(Node):
+    """Scan of a host-staged intermediate (plan splitting,
+    engine/staging.py): reads the temp table behind ``child`` (a plain
+    Scan with mangled column names) and re-exposes each column under its
+    ORIGINAL (binding, name) address so ancestor nodes compile
+    unchanged. Created by the executor, never by the planner."""
+    child: Scan = None
+    # [(orig_binding, orig_name, mangled_name, dtype)]
+    cols: list = field(default_factory=list)
+    binding: str = ""
+    output: list = field(default_factory=list)
+
+
+@dataclass
 class Filter(Node):
     child: Node = None
     predicate: ir.IR = None

@@ -15,8 +15,15 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective_call_terminate" not in flags:
+    # virtual devices are threads sharing the host's cores: on a small
+    # box the 8 per-device threads serialize, and a heavy pre-collective
+    # section can overrun XLA CPU's default 40 s rendezvous termination
+    # (observed on q72's exchange at 1 core: "only 2 of them arrived")
+    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+              " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = flags
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 # the environment's sitecustomize can override jax_platforms back to the
