@@ -271,12 +271,23 @@ class DistributedExecutor(dx.DeviceExecutor):
 
 
 class _DistTrace(dx._Trace):
-    def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float):
+    def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float,
+                 xslacks: dict | None = None):
         super().__init__(ex, bufs, slack)
         self.n_dev = ex.n_dev
         self.axes = ex.axes
+        # per-exchange slack overrides (exchange index -> slack): an
+        # overflow retry grows ONLY the overflowing exchange's buckets.
+        # The old whole-program slack doubling doubled every exchange
+        # AND every M:N capacity — on the widest plans that was the
+        # difference between a bounded retry and a 130 GB recompile
+        self.xslacks = xslacks or {}
+        self._xchg_n = 0
+        self._xovers: list = []
 
     def total_overflow(self):
+        """Join-expansion overflow total (exchanges report separately
+        via exchange_overflows)."""
         if not self._overflows:
             return jnp.zeros((), jnp.int64)
         tot = self._overflows[0].astype(jnp.int64)
@@ -284,6 +295,14 @@ class _DistTrace(dx._Trace):
             tot = tot + o.astype(jnp.int64)
         # every device sees every exchange; max across devices is enough
         return lax.pmax(tot, self.axes)
+
+    def exchange_overflows(self):
+        """Per-exchange overflow counts, device-maxed; static length
+        per plan (the trace visits exchanges deterministically)."""
+        if not self._xovers:
+            return jnp.zeros((0,), jnp.int64)
+        vec = jnp.stack([o.astype(jnp.int64) for o in self._xovers])
+        return lax.pmax(vec, self.axes)
 
     # ------------------------------------------------------------- helpers
 
