@@ -469,9 +469,11 @@ def _run_leg(leg: str) -> None:
                     _cleanup_views(dev, stmts)
             BANK.setdefault((leg, qn), {})["device_s"] = dev_s
             _save_dev_bank(leg, rows)
-            # engine-side perf accounting (compile/execute/materialize)
+            # engine-side perf accounting (compile/execute/materialize),
+            # read through the span-fed accessor (nds_tpu/obs)
+            from nds_tpu import obs
             dev_ex = dev._executor_factory(dev.tables)
-            tm = dict(dev_ex.last_timings)
+            tm = obs.query_timings(dev_ex)
             banked = cpu_bank.get(qn)
             if banked is not None:
                 cpu_s = float(banked)

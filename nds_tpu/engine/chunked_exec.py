@@ -41,6 +41,8 @@ from nds_tpu.engine.types import (
     INT64, DecimalType, FloatType, Schema, StringType,
 )
 from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs.trace import get_tracer
 from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
 
@@ -174,6 +176,26 @@ class _PartialAggExecutor(_PhaseBExecutor):
         return jax.jit(fn), side
 
 
+class _ForwardResult:
+    """Async handle that forwards the phase-B sub-executor's finalized
+    timings + query span back onto the outer ChunkedExecutor when the
+    caller blocks on result()."""
+
+    __slots__ = ("outer", "sub", "inner")
+
+    def __init__(self, outer, sub, inner):
+        self.outer = outer
+        self.sub = sub
+        self.inner = inner
+
+    def result(self):
+        out = self.inner.result()
+        self.outer.last_timings = self.sub.last_timings
+        self.outer.last_query_span = getattr(
+            self.sub, "last_query_span", None)
+        return out
+
+
 class ChunkedExecutor(dx.DeviceExecutor):
     """DeviceExecutor that streams oversized tables through the chip."""
 
@@ -204,6 +226,11 @@ class ChunkedExecutor(dx.DeviceExecutor):
         scans = self._streamed_scans(planned)
         if not scans:
             return super().execute_async(planned, key)
+        # a failed streamed query must never inherit the previous
+        # query's span OR timings (same reset contract as the base
+        # executor; last_timings rebinds only after phase A succeeds)
+        self.last_query_span = None
+        self.last_timings = {}
         if key not in self._reduced:
             reduced = {}
             for table, table_scans in scans.items():
@@ -240,7 +267,9 @@ class ChunkedExecutor(dx.DeviceExecutor):
         sub = self._reduced[key]
         res = sub.execute_async(planned, key)
         self.last_timings = sub.last_timings
-        return res
+        # the sub-executor's span/timings finalize at result(): forward
+        # them so obs.query_timings(chunked_executor) sees the query
+        return _ForwardResult(self, sub, res)
 
     def _streamed_scans(self, planned: P.PlannedQuery) -> dict:
         """{table: [Scan, ...]} for streamed tables in this plan."""
@@ -308,8 +337,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
             column_names=[])
         plan_local = {t for t, r in reduced.items()
                       if r is not self.tables[t]} | {table}
-        parts = self._run_partial_chunks(base, reduced[table], table,
-                                         planned_a, plan_local)
+        with get_tracer().span("chunk.partial_agg", table=table):
+            parts = self._run_partial_chunks(base, reduced[table],
+                                             table, planned_a,
+                                             plan_local)
         ptable = self._partials_host_table(agg2, parts)
         pb = "__pa_scan__"
         scan_p = P.Scan(table=ptable.name, binding=pb,
@@ -394,6 +425,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
         n = big.nrows
         C = min(self.chunk_rows, max(n, 1))
         spans = [(s, min(s + C, n)) for s in range(0, n, C)]
+        obs_metrics.counter("chunk_scans_total").inc(len(spans))
         by_size: dict[int, list] = {}
         for span in spans:
             by_size.setdefault(span[1] - span[0], []).append(span)
@@ -503,7 +535,9 @@ class ChunkedExecutor(dx.DeviceExecutor):
         if hit is not None:
             return hit
         need_cols = sorted({name for s in scans for name, _ in s.output})
-        keep = self._chunk_keep_mask(table, scans, need_cols)
+        with get_tracer().span("chunk.reduce", table=table,
+                               rows=t.nrows):
+            keep = self._chunk_keep_mask(table, scans, need_cols)
         if keep.all():
             # zero reduction (filterless scan / fallback): the original
             # table IS the result — no multi-GB host copy
@@ -568,6 +602,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
             jitted = jax.jit(fn)
             keep_np = np.empty(n, dtype=bool)
             for start in range(0, n, C):
+                obs_metrics.counter("chunk_scans_total").inc()
                 stop = min(start + C, n)
                 bufs = {}
                 for name in need_cols:
@@ -596,6 +631,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return keep_np
         except Exception as exc:  # noqa: BLE001 - conservative fallback
             from nds_tpu.utils.report import TaskFailureCollector
+            obs_metrics.counter("chunk_fallbacks_total").inc()
             TaskFailureCollector.notify(
                 f"chunked scan fell back to full rows for {table}: "
                 f"{type(exc).__name__}: {exc}")

@@ -60,6 +60,8 @@ from nds_tpu.engine.types import (  # noqa: E402
     BoolType, DateType, DecimalType, DType, FloatType, IntType, StringType,
 )
 from nds_tpu.io.host_table import HostTable  # noqa: E402
+from nds_tpu.obs import metrics as obs_metrics  # noqa: E402
+from nds_tpu.obs.trace import get_tracer  # noqa: E402
 from nds_tpu.sql import ir  # noqa: E402
 from nds_tpu.sql import plan as P  # noqa: E402
 
@@ -361,10 +363,17 @@ class DeviceExecutor:
         self._scan_views: dict[tuple, object] = {}
         # perf accounting for the last execute(): compile/execute/
         # materialize wall-clock ms (the breakdown the reference leaves to
-        # the Spark UI; here it feeds the JSON summaries directly)
+        # the Spark UI; here it feeds the JSON summaries directly).
+        # last_query_span is the span-tree form of the same bill
+        # (obs.query_timings reads it; last_timings stays as the legacy
+        # scrape surface)
         self.last_timings: dict[str, float] = {}
+        self.last_query_span = None
         # host-staged plan splitting (engine/staging.py): key -> the
-        # once-computed ([(sub_planned, temp_name), ...], main_planned)
+        # once-computed (orig_planned, [(sub_planned, temp_name), ...],
+        # main_planned). orig_planned pins the caller's plan object:
+        # the key is its id(), and a recycled address must never serve
+        # another query's staged split (advisor finding, round 5)
         self._stage_plans: dict[object, tuple] = {}
         self._stage_seq = 0                  # collision-free temp names
         self._stage_fps: dict[str, str] = {}  # temp -> content md5
@@ -424,6 +433,21 @@ class DeviceExecutor:
             return planned
         from nds_tpu.engine import staging
         plans = self._stage_plans.get(key)
+        if plans is not None and plans[2] is planned:
+            # overflow-retry re-dispatch of the staged MAIN plan
+            # (_finish retries with `planned`, which for a staged query
+            # IS the cached main): the temps are registered and the
+            # sub-program bill is already parked in _stage_timings —
+            # re-running the subs would only waste the retry
+            return planned
+        if plans is not None and plans[0] is not planned:
+            # stale entry: id() recycling (the pinning ref was evicted)
+            # or the caller rebound this key to a new plan — either way
+            # the cached split belongs to ANOTHER plan object. Its
+            # compiled programs (main AND recursive sub-program keys)
+            # are just as stale as the split itself
+            self._evict_query_state(key)
+            plans = None
         if plans is None:
             subs, main = [], planned
             while staging.plan_weight(main) > self.STAGE_WEIGHT:
@@ -435,13 +459,15 @@ class DeviceExecutor:
                 temp = f"__stage_{self._stage_seq}"
                 sub, main = staging.build_stage(main, cut, temp)
                 subs.append((sub, temp))
-            plans = (subs, main)
+            plans = (planned, subs, main)
             self._stage_plans[key] = plans
-        subs, main = plans
+        _orig, subs, main = plans
         agg = {}
+        tracer = get_tracer()
         for i, (sub, temp) in enumerate(subs):
-            # recursive: an oversized sub-program splits again here
-            rt = self.execute(sub, key=(key, "__stage__", i))
+            with tracer.span("stage.sub", temp=temp, index=i):
+                # recursive: an oversized sub-program splits again here
+                rt = self.execute(sub, key=(key, "__stage__", i))
             for k, v in self.last_timings.items():
                 if k in ("compile_ms", "execute_ms", "materialize_ms",
                          "bytes_scanned"):
@@ -451,7 +477,49 @@ class DeviceExecutor:
         if subs:
             agg["staged_programs"] = len(subs)
             self._stage_timings[key] = agg
+            obs_metrics.counter("staged_subprograms_total").inc(len(subs))
         return main
+
+    @staticmethod
+    def _stage_key_derives_from(k: object, base: object) -> bool:
+        """True when k is a recursive staged-sub-program key rooted at
+        base ((base, "__stage__", i) and deeper)."""
+        while isinstance(k, tuple) and len(k) == 3 and k[1] == "__stage__":
+            k = k[0]
+            if k == base:
+                return True
+        return False
+
+    def _unregister_staged(self, temp: str) -> None:
+        """Free everything _register_staged created for a temp table:
+        the host table, its fingerprint, and its per-table caches
+        (device buffers, bounds, scan views)."""
+        self.tables.pop(temp, None)
+        self._stage_fps.pop(temp, None)
+        pref = temp + "."
+        for k in [k for k in self._buffers if k.startswith(pref)]:
+            del self._buffers[k]
+        for k in [k for k in self._bounds if k[0] == temp]:
+            del self._bounds[k]
+        for k in [k for k in self._scan_views if k[0] == temp]:
+            del self._scan_views[k]
+
+    def _evict_query_state(self, key: object) -> None:
+        """Drop the staging state tied to a compile-cache key being
+        evicted — including the recursive sub-program entries keyed off
+        it — so _stage_plans/_stage_timings/_compiled never hold a
+        stale split for a plan whose pinning ref is gone (and never
+        grow unboundedly across a long run). A re-split after eviction
+        mints FRESH temp names (_stage_seq), so the evicted split's
+        temp tables and their host/device caches must free here or
+        eviction+rerun cycles leak every old intermediate."""
+        for d in (self._stage_plans, self._stage_timings, self._compiled):
+            for k in [key] + [k for k in d
+                              if self._stage_key_derives_from(k, key)]:
+                entry = d.pop(k, None)
+                if d is self._stage_plans and entry is not None:
+                    for _sub, temp in entry[1]:
+                        self._unregister_staged(temp)
 
     def _merge_stage_timings(self, timings: dict,
                              key: object = None) -> None:
@@ -484,38 +552,72 @@ class DeviceExecutor:
         analog of spark.rapids.sql.concurrentGpuTasks,
         `nds/power_run_gpu.template:38`) and overlap device execution
         with host-side materialization of earlier results."""
-        import time as _time
         key = key if key is not None else id(planned)
         orig = planned
-        planned = self._staged_effective(planned, key)
-        timings = {"compile_ms": 0.0}
-        self.last_timings = timings
-        # the cache entry holds a strong ref to the plan: id()-keyed
-        # entries must keep THE CALLER'S plan object alive (its id is
-        # the key — a recycled address could serve another query's
-        # compiled program), plus the staged main plan actually compiled
-        entry = self._compiled.setdefault(
-            key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
-        if "compiled" not in entry:
-            t0 = _time.perf_counter()
-            jitted, side = self._compile(planned, entry["slack"])
+        tracer = get_tracer()
+        # a failed query must never inherit the previous query's span
+        # (query_timings would serve stale numbers into its summary)
+        self.last_query_span = None
+        # explicitly-owned query span: the async half (_finish) may run
+        # after other queries dispatched, so stack discipline can't own it
+        qspan = tracer.begin("device.execute",
+                             executor=type(self).__name__)
+        try:
+            return self._dispatch_traced(planned, orig, key, tracer,
+                                         qspan)
+        except BaseException as exc:
+            # nested staged sub-programs set last_query_span on THEIR
+            # success; a failing main program must not leave a sub's
+            # span masquerading as the whole query's
+            self.last_query_span = None
+            if qspan and qspan.t1 is None:
+                qspan.set(error=f"{type(exc).__name__}: {exc}").end()
+            raise
+
+    def _dispatch_traced(self, planned, orig, key, tracer, qspan):
+        import time as _time
+        with tracer.attach(qspan):
+            planned = self._staged_effective(planned, key)
+            timings = {"compile_ms": 0.0}
+            self.last_timings = timings
+            # the cache entry holds a strong ref to the plan: id()-keyed
+            # entries must keep THE CALLER'S plan object alive (its id is
+            # the key — a recycled address could serve another query's
+            # compiled program), plus the staged main plan actually
+            # compiled
+            entry = self._compiled.setdefault(
+                key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
+            if "compiled" not in entry:
+                t0 = _time.perf_counter()
+                with tracer.span("device.compile", slack=entry["slack"]):
+                    jitted, side = self._compile(planned, entry["slack"])
+                    bufs = self._collect_buffers(planned)
+                    # AOT-compile now so compile cost is attributed
+                    # separately from steady-state execution
+                    entry["compiled"] = jitted.lower(bufs).compile()
+                entry["side"] = side
+                timings["compile_ms"] += (
+                    _time.perf_counter() - t0) * 1000
+                # overflow retries recompile the SAME query: count them
+                # apart from first compiles (distributed executor
+                # semantics, README counter contract)
+                obs_metrics.counter(
+                    "recompiles_total" if entry.pop("recompile", False)
+                    else "compiles_total").inc()
             bufs = self._collect_buffers(planned)
-            # AOT-compile now so compile cost is attributed
-            # separately from steady-state execution
-            entry["compiled"] = jitted.lower(bufs).compile()
-            entry["side"] = side
-            timings["compile_ms"] += (_time.perf_counter() - t0) * 1000
-        bufs = self._collect_buffers(planned)
-        # bytes the query reads from HBM-resident scan buffers: the
-        # roofline denominator (achieved GB/s lands in scan_gbps at
-        # _finish) so wins/losses are judged against memory bandwidth,
-        # not only against a host CPU
-        timings["bytes_scanned"] = float(
-            sum(b.nbytes for b in bufs.values()))
-        t1 = _time.perf_counter()
-        row, outs, overflow = entry["compiled"](bufs)
+            # bytes the query reads from HBM-resident scan buffers: the
+            # roofline denominator (achieved GB/s lands in scan_gbps at
+            # _finish) so wins/losses are judged against memory
+            # bandwidth, not only against a host CPU
+            timings["bytes_scanned"] = float(
+                sum(b.nbytes for b in bufs.values()))
+            obs_metrics.counter("device_executions_total").inc()
+            obs_metrics.counter("bytes_scanned_total").inc(
+                timings["bytes_scanned"])
+            t1 = _time.perf_counter()
+            row, outs, overflow = entry["compiled"](bufs)
         return _AsyncResult(self, planned, key, entry, timings, t1,
-                            (row, outs, overflow))
+                            (row, outs, overflow), qspan)
 
     # capacity at or above which results compact ON DEVICE before the
     # host transfer: a masked full-capacity result of a 576k-slot query
@@ -558,14 +660,47 @@ class DeviceExecutor:
             self._compiled[key] = cf
         return cf
 
+    def _finalize_timings(self, timings: dict, key: object) -> None:
+        """Shared tail of every executor's timing bill: roofline
+        derivation (achieved scan bandwidth vs the active backend's
+        peak memory bandwidth — the denominator that turns "N GB/s"
+        into "is it actually fast", VERDICT r4 weak #6), staged
+        sub-program fold, and the last_timings publication."""
+        bs = timings.get("bytes_scanned", 0.0)
+        if bs and timings.get("execute_ms", 0) > 0:
+            timings["scan_gbps"] = (
+                bs / (timings["execute_ms"] / 1000) / 1e9)
+            peak = _peak_mem_gbps()
+            if peak:
+                timings["roofline_frac"] = round(
+                    timings["scan_gbps"] / peak, 4)
+                timings["roofline_peak_gbps"] = peak
+        self._merge_stage_timings(timings, key)
+        self.last_timings = timings
+
     def _finish(self, planned, key, entry, timings, t1, devs,
-                attempt: int = 0):
+                attempt: int = 0, span=None):
         """Blocking half of execute_async: one device->host round trip
         for execution + result (a separate block_until_ready +
         int(overflow) + device_get costs 2-3 tunnel RTTs per query on
         remote-attached TPUs), then overflow-retry with doubled slack.
         Large-capacity results compact on device first (see
         COMPACT_MIN_ROWS)."""
+        tracer = get_tracer()
+        try:
+            return self._finish_traced(planned, key, entry, timings,
+                                       t1, devs, attempt, span, tracer)
+        except BaseException as exc:
+            # failed queries still close their span (with the error
+            # attached) so trace durations stay truthful; and a staged
+            # sub's span must not survive as the failed query's
+            self.last_query_span = None
+            if span and span.t1 is None:
+                span.set(error=f"{type(exc).__name__}: {exc}").end()
+            raise
+
+    def _finish_traced(self, planned, key, entry, timings, t1, devs,
+                       attempt, span, tracer):
         import time as _time
         row_d, outs_d, overflow_d = devs
         n = row_d.shape[0]
@@ -588,27 +723,23 @@ class DeviceExecutor:
             row_h, outs_h, overflow_h = jax.device_get(devs)
         t2 = _time.perf_counter()
         if int(overflow_h) == 0:
-            out = self._materialize(planned, row_h, outs_h, entry["side"])
+            # the execute bracket closed at t2 (device_get blocks until
+            # ready); record it as a span with the measured endpoints
+            tracer.begin("device.run", parent=span, t0=t1).end(t=t2)
+            with tracer.attach(span), tracer.span("device.materialize"):
+                out = self._materialize(planned, row_h, outs_h,
+                                        entry["side"])
             t3 = _time.perf_counter()
             timings["execute_ms"] = (t2 - t1) * 1000
             timings["materialize_ms"] = (t3 - t2) * 1000
-            bs = timings.get("bytes_scanned", 0.0)
-            if bs and timings["execute_ms"] > 0:
-                timings["scan_gbps"] = (
-                    bs / (timings["execute_ms"] / 1000) / 1e9)
-                peak = _peak_mem_gbps()
-                if peak:
-                    # roofline: achieved scan bandwidth as a fraction
-                    # of the active backend's peak memory bandwidth —
-                    # the denominator that turns "N GB/s" into "is it
-                    # actually fast" (VERDICT r4 weak #6)
-                    timings["roofline_frac"] = round(
-                        timings["scan_gbps"] / peak, 4)
-                    timings["roofline_peak_gbps"] = peak
-            self._merge_stage_timings(timings, key)
-            self.last_timings = timings
+            self._finalize_timings(timings, key)
+            if span:
+                span.set(timings=dict(timings)).end()
+                self.last_query_span = span
             return out
         if attempt >= 3:
+            if span:
+                span.set(error="join expansion overflow").end()
             raise DeviceExecError("join expansion overflow after retries")
         # M:N join capacity exceeded: recompile with doubled slack
         # (recovered task-level failure -> listener chain, the
@@ -617,13 +748,17 @@ class DeviceExecutor:
         TaskFailureCollector.notify(
             f"join expansion overflow: retry with slack "
             f"{entry['slack'] * 2}")
+        obs_metrics.counter("slack_retries_total").inc()
         entry.pop("compiled", None)
+        entry["recompile"] = True
         entry["slack"] *= 2
+        if span:
+            span.set(overflow_retry=True, slack=entry["slack"]).end()
         nxt = self.execute_async(planned, key)
         # engineTimings must report the FULL compile bill across retries
         nxt.timings["compile_ms"] += timings.get("compile_ms", 0.0)
         return self._finish(planned, key, nxt.entry, nxt.timings, nxt.t1,
-                            nxt.devs, attempt + 1)
+                            nxt.devs, attempt + 1, span=nxt.span)
 
     def _compile(self, planned: P.PlannedQuery,
                  slack: float = DEFAULT_SLACK):
@@ -850,9 +985,11 @@ class _AsyncResult:
     """Handle for an in-flight query: dispatch happened, completion and
     materialization wait until result()."""
 
-    __slots__ = ("ex", "planned", "key", "entry", "timings", "t1", "devs")
+    __slots__ = ("ex", "planned", "key", "entry", "timings", "t1",
+                 "devs", "span")
 
-    def __init__(self, ex, planned, key, entry, timings, t1, devs):
+    def __init__(self, ex, planned, key, entry, timings, t1, devs,
+                 span=None):
         self.ex = ex
         self.planned = planned
         self.key = key
@@ -860,10 +997,12 @@ class _AsyncResult:
         self.timings = timings
         self.t1 = t1
         self.devs = devs
+        self.span = span
 
     def result(self):
         return self.ex._finish(self.planned, self.key, self.entry,
-                               self.timings, self.t1, self.devs)
+                               self.timings, self.t1, self.devs,
+                               span=self.span)
 
 
 class _Trace:

@@ -75,7 +75,10 @@ class Session:
         self.tables[table.name] = table
 
     def plan(self, sql_text: str):
-        return self.plan_ast(parse(sql_text))
+        from nds_tpu.obs.trace import get_tracer
+        with get_tracer().span("sql.parse", chars=len(sql_text)):
+            stmt = parse(sql_text)
+        return self.plan_ast(stmt)
 
     def plan_ast(self, stmt):
         planner = Planner(self.catalog, self.views)

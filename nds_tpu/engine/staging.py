@@ -32,6 +32,7 @@ import numpy as np
 
 from nds_tpu.engine.types import BoolType, Schema
 from nds_tpu.io.host_table import HostTable, from_arrays
+from nds_tpu.obs.trace import get_tracer
 from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
 
@@ -180,6 +181,12 @@ def build_stage(planned: P.PlannedQuery, cut: P.Node, temp_name: str):
     StagedScan of `temp_name` that restores original (binding, name)
     addresses. Scalar subplans are carried into the sub program so
     ScalarRef indices keep their meaning."""
+    with get_tracer().span("stage.split", temp=temp_name,
+                           cut_weight=_subtree_weight(cut)):
+        return _build_stage(planned, cut, temp_name)
+
+
+def _build_stage(planned: P.PlannedQuery, cut: P.Node, temp_name: str):
     live = _live_cols(planned, cut)
     if not live:
         raise ValueError("cut subtree has no live outputs")

@@ -1,0 +1,30 @@
+"""Unified observability layer: span tracing + metrics.
+
+``nds_tpu.obs.trace`` — nestable wall-clock spans with Chrome-trace
+JSONL export (``NDS_TPU_TRACE=path``); ``nds_tpu.obs.metrics`` — the
+global counter/gauge/histogram registry.  ``query_timings`` is the
+span-fed replacement for scraping ``executor.last_timings`` by hand.
+"""
+
+from __future__ import annotations
+
+from nds_tpu.obs import metrics, trace
+from nds_tpu.obs.trace import get_tracer
+
+__all__ = ["metrics", "trace", "get_tracer", "query_timings"]
+
+
+def query_timings(executor) -> dict:
+    """Timing breakdown of the executor's last query, fed by its query
+    span (``executor.last_query_span``).  Falls back to the legacy
+    ``last_timings`` dict for executors that predate spans (or when
+    tracing is disabled), so callers see the same key vocabulary either
+    way: compile_ms / execute_ms / materialize_ms / bytes_scanned /
+    scan_gbps / roofline_frac / roofline_peak_gbps / staged_programs.
+    Executors without timings (the CPU oracle) yield {}."""
+    root = getattr(executor, "last_query_span", None)
+    if root:
+        t = trace.timings_from_span(root)
+        if t:
+            return t
+    return dict(getattr(executor, "last_timings", None) or {})

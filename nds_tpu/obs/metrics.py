@@ -1,0 +1,187 @@
+"""Engine-wide metrics registry: counters, gauges, histograms.
+
+The reference gets its operational counters (task retries, shuffle
+spills, bytes read) from Spark's metrics system for free; this is the
+in-process equivalent for the TPU engine.  One global registry, named
+instruments created on first use, thread-safe behind a single lock
+(instrument updates are query-granularity events, never per-row, so
+one lock is cheaper than per-instrument locking everywhere).
+
+Metric names in use across the stack (documented in README
+"Observability"):
+
+- ``queries_total`` / ``query_failures_total`` / ``query_seconds`` —
+  power loop (utils/power_core.py)
+- ``plans_total`` — SQL planner
+- ``device_executions_total`` / ``compiles_total`` /
+  ``recompiles_total`` / ``slack_retries_total`` /
+  ``bytes_scanned_total`` — device executors
+- ``staged_subprograms_total`` — host-staged plan splitting
+- ``exchanges_traced_total`` / ``exchange_overflow_retries_total`` /
+  ``exchange_overflow_rows_total`` — distributed exchange
+- ``chunk_scans_total`` / ``chunk_fallbacks_total`` — out-of-core
+  executor
+- ``task_failures_total`` — TaskFailureCollector bridge
+  (utils/report.py)
+
+Per-query deltas (``delta(before, after)``) land in each BenchReport
+JSON under ``metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    """Monotonic accumulator (floats allowed: bytes_scanned_total)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.value = 0
+        self._lock = lock
+
+    def inc(self, n: int | float = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins value (e.g. live compile-cache entries)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.value = 0
+        self._lock = lock
+
+    def set(self, v: int | float) -> None:
+        with self._lock:
+            self.value = v
+
+
+class Histogram:
+    """count/sum/min/max summary — enough for latency distributions at
+    query granularity without bucket-boundary bikeshedding."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def summary(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(
+                    name, Counter(name, self._lock))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name, self._lock))
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(
+                    name, Histogram(name, self._lock))
+        return h
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy: {"counters": {...}, "gauges": {...},
+        "histograms": {name: {count,sum,min,max}}}."""
+        with self._lock:
+            return {
+                "counters": {n: c.value
+                             for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "histograms": {n: h.summary()
+                               for n, h in self._histograms.items()},
+            }
+
+    def reset(self) -> None:
+        """Drop every instrument (tests)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+def delta(before: dict, after: dict) -> dict:
+    """What changed between two snapshots, for per-query attribution:
+    counter increments, histogram count/sum increments, current gauge
+    values. Unchanged instruments are omitted."""
+    out: dict = {}
+    counters = {}
+    for name, v in after.get("counters", {}).items():
+        d = v - before.get("counters", {}).get(name, 0)
+        if d:
+            counters[name] = d
+    if counters:
+        out["counters"] = counters
+    gauges = {
+        name: v for name, v in after.get("gauges", {}).items()
+        if before.get("gauges", {}).get(name) != v}
+    if gauges:
+        out["gauges"] = gauges
+    hists = {}
+    for name, h in after.get("histograms", {}).items():
+        b = before.get("histograms", {}).get(
+            name, {"count": 0, "sum": 0.0})
+        dc = h["count"] - b["count"]
+        if dc:
+            hists[name] = {"count": dc, "sum": h["sum"] - b["sum"]}
+    if hists:
+        out["histograms"] = hists
+    return out
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()

@@ -1,0 +1,360 @@
+"""Span-based query tracer: where the time goes, per query, per phase.
+
+The reference harness delegates all timing depth to the Spark UI /
+event logs; this engine's only accounting used to be a mutable
+``last_timings`` dict scraped off the executor after the fact.  This
+module is the replacement contract: every pipeline phase (parse, plan,
+compile, execute, materialize, staged sub-programs, chunk scans) runs
+inside a *span* — a named wall-clock bracket with attributes, nestable
+into a per-query tree.  Spans bracket ``block_until_ready`` boundaries
+upstream (the utils/report.py contract), so async dispatch cannot hide
+work.
+
+Design constraints, in order:
+
+- **Zero-cost when disabled.** ``NDS_TPU_OBS=0`` makes ``span()`` /
+  ``begin()`` return one shared no-op object; no allocation, no clock
+  read, no lock.
+- **Thread/executor-safe.** The "current span" is thread-local; async
+  executors carry their span explicitly (``begin`` + ``attach``)
+  instead of relying on stack discipline that interleaved queries
+  would break.
+- **Export is a side effect of finishing a root.** When a root span
+  (no parent) ends, its whole tree appends to the Chrome trace-event
+  JSONL named by ``NDS_TPU_TRACE`` (one JSON object per line, "X"
+  complete events — Perfetto-loadable after wrapping in ``[...]``, see
+  README "Observability"), and the root is retained on
+  ``Tracer.last_roots`` for the BenchReport JSON.
+
+The span taxonomy and the event schema are documented in the README
+and enforced by ``tools/check_trace_schema.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+TRACE_ENV = "NDS_TPU_TRACE"
+_OBS_ENV = "NDS_TPU_OBS"
+
+# perf_counter -> epoch calibration, done once: Chrome trace "ts" wants
+# one consistent microsecond timeline, perf_counter wants to be the
+# only clock spans ever read
+_EPOCH_OFFSET = time.time() - time.perf_counter()
+
+_EXPORT_LOCK = threading.Lock()
+
+# begin() default-parent sentinel: "whatever span is current on this
+# thread" (None must stay expressible as "force a root")
+_CURRENT = object()
+
+
+class Span:
+    """One named wall-clock bracket. Usable as a context manager (sync
+    code: nests via the tracer's thread-local stack) or via explicit
+    ``begin``/``end`` (async executors that outlive their dispatch
+    thread turn)."""
+
+    __slots__ = ("name", "attrs", "parent", "children", "t0", "t1",
+                 "tid", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, parent: "Span | None",
+                 attrs: dict, t0: float | None = None):
+        self.name = name
+        self.attrs = attrs
+        self.parent = parent
+        self.children: list[Span] = []
+        self.t0 = time.perf_counter() if t0 is None else t0
+        self.t1: float | None = None
+        self.tid = threading.get_ident()
+        self._tracer = tracer
+        if parent is not None:
+            parent.children.append(self)
+
+    def __bool__(self) -> bool:
+        return True
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def end(self, t: float | None = None) -> "Span":
+        """Close the bracket (idempotent). ``t`` overrides the end
+        timestamp for phases whose start/stop were measured by the
+        caller's own perf_counter reads."""
+        if self.t1 is None:
+            self.t1 = time.perf_counter() if t is None else t
+            if self.parent is None:
+                self._tracer._finish_root(self)
+        return self
+
+    @property
+    def dur_ms(self) -> float:
+        end = self.t1 if self.t1 is not None else time.perf_counter()
+        return (end - self.t0) * 1000.0
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def find(self, name: str) -> "list[Span]":
+        return [s for s in self.walk() if s.name == name]
+
+    # ------------------------------------------------------- conversions
+
+    def to_dict(self) -> dict:
+        """JSON-ready tree for the BenchReport ``spans`` field."""
+        return {
+            "name": self.name,
+            "dur_ms": round(self.dur_ms, 3),
+            "attrs": _json_safe(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def to_events(self, pid: int | None = None) -> list[dict]:
+        """Chrome trace-event dicts ("X" complete events) for this span
+        and every descendant."""
+        pid = os.getpid() if pid is None else pid
+        out = []
+        for s in self.walk():
+            out.append({
+                "name": s.name,
+                "cat": s.name.split(".", 1)[0],
+                "ph": "X",
+                "ts": (s.t0 + _EPOCH_OFFSET) * 1e6,
+                "dur": s.dur_ms * 1000.0,
+                "pid": pid,
+                "tid": s.tid,
+                "args": _json_safe(s.attrs),
+            })
+        return out
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._tracer._pop(self)
+        if exc is not None:
+            self.attrs.setdefault("error", f"{type(exc).__name__}: {exc}")
+        self.end()
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the entire disabled-mode cost is one
+    attribute load and a falsy check."""
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def end(self, t=None) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def _json_safe(obj):
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+class _Attach:
+    """Context manager that makes an explicitly-owned span the
+    thread-local current span WITHOUT ending it on exit (the async
+    executors' bridge between begin/end ownership and ``with span``
+    nesting for everything called underneath)."""
+
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "Tracer", span):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self):
+        if self._span:
+            self._tracer._push(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._span:
+            self._tracer._pop(self._span)
+
+
+class Tracer:
+    """Owns the thread-local span stack, finished-root retention, and
+    the Chrome-trace export."""
+
+    MAX_ROOTS = 64
+
+    def __init__(self, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get(_OBS_ENV, "1") != "0"
+        self.enabled = enabled
+        self._tls = threading.local()
+        # finished root spans, oldest first (bounded: a 99-query power
+        # run must not retain every tree forever)
+        self.last_roots: deque = deque(maxlen=self.MAX_ROOTS)
+        # defer_exports=True parks finished roots on _pending instead
+        # of writing them inline: the power loop's root spans end
+        # INSIDE the timed bracket, and even a ~ms export skews the
+        # span-vs-TimeLog agreement; the loop flushes after the bracket
+        self.defer_exports = False
+        self._pending: list = []
+
+    # ------------------------------------------------------------- stack
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        if span in st:
+            # tolerate mismatched exits: drop through to the span
+            while st and st.pop() is not span:
+                pass
+
+    def current(self) -> "Span | None":
+        st = self._stack()
+        return st[-1] if st else None
+
+    # --------------------------------------------------------------- API
+
+    def span(self, name: str, **attrs):
+        """Context-managed span, parented to the thread's current
+        span."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return Span(self, name, self.current(), attrs)
+
+    def begin(self, name: str, parent: "Span | None | object" = _CURRENT,
+              t0: float | None = None, **attrs):
+        """Explicitly-owned span (caller must ``end()`` it). ``parent``
+        defaults to the thread's current span; pass ``None`` to force a
+        root."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is _CURRENT:
+            parent = self.current()
+        elif isinstance(parent, _NoopSpan):
+            parent = None
+        return Span(self, name, parent, attrs, t0=t0)
+
+    def attach(self, span) -> _Attach:
+        """Make an owned span current for a ``with`` block (no end on
+        exit). Accepts the no-op span and does nothing."""
+        return _Attach(self, span if isinstance(span, Span) else None)
+
+    # ------------------------------------------------------------ export
+
+    def _finish_root(self, root: Span) -> None:
+        self.last_roots.append(root)
+        path = os.environ.get(TRACE_ENV)
+        if not path:
+            return
+        if self.defer_exports:
+            self._pending.append((root, path))
+            return
+        try:
+            export_chrome(root, path)
+        except OSError:  # tracing must never fail the query
+            pass
+
+    def flush_exports(self) -> None:
+        """Write every parked root tree (defer_exports mode)."""
+        pending, self._pending = self._pending, []
+        for root, path in pending:
+            try:
+                export_chrome(root, path)
+            except OSError:
+                pass
+
+
+# held-open export handles, one per trace path: the export runs inside
+# the power loop's per-query timing bracket (root spans end there), and
+# an open/close pair per query on a slow filesystem costs multiple ms —
+# visible skew between span totals and the TimeLog CSV. Flushed per
+# tree so readers always see complete trees; the OS closes at exit.
+_EXPORT_FILES: dict = {}
+
+
+def export_chrome(root: Span, path: str) -> None:
+    """Append one JSONL line per span in ``root``'s tree to ``path``."""
+    events = root.to_events()
+    with _EXPORT_LOCK:
+        f = _EXPORT_FILES.get(path)
+        if f is None or f.closed:
+            f = _EXPORT_FILES[path] = open(path, "a")
+            if len(_EXPORT_FILES) > 8:  # bound leaked handles (tests)
+                old = next(iter(_EXPORT_FILES))
+                if old != path:
+                    _EXPORT_FILES.pop(old).close()
+        f.write("".join(json.dumps(ev) + "\n" for ev in events))
+        f.flush()
+
+
+# timing keys the per-phase spans map onto (the legacy last_timings
+# vocabulary — TimeLog/engineTimings consumers parse these names)
+PHASE_TIMING_KEYS = {
+    "device.compile": "compile_ms",
+    "device.run": "execute_ms",
+    "device.materialize": "materialize_ms",
+}
+
+
+def timings_from_span(root) -> dict:
+    """last_timings-shaped dict from a query span tree: the executor
+    attaches the authoritative dict as the root's ``timings`` attr
+    (retry folding, staged-bill merge and roofline derivation live in
+    the executor); absent that, phase child durations are summed under
+    the legacy key names."""
+    if not isinstance(root, Span):
+        return {}
+    t = root.attrs.get("timings")
+    if isinstance(t, dict):
+        return dict(t)
+    out: dict = {}
+    for s in root.walk():
+        key = PHASE_TIMING_KEYS.get(s.name)
+        if key:
+            out[key] = out.get(key, 0.0) + s.dur_ms
+    return out
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def set_enabled(enabled: bool) -> None:
+    """Test/CLI hook: flip the global tracer without rebuilding it."""
+    _TRACER.enabled = enabled

@@ -35,6 +35,8 @@ from jax.sharding import PartitionSpec as P_
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
 from nds_tpu.io.host_table import HostTable
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs.trace import get_tracer
 from nds_tpu.parallel.exchange import exchange, exchange_hierarchical
 from nds_tpu.parallel.mesh import (
     DATA_AXIS, HOST_AXIS, make_mesh, pad_to_multiple,
@@ -226,12 +228,47 @@ class DistributedExecutor(dx.DeviceExecutor):
     STAGE_WEIGHT = int(os.environ.get("NDS_TPU_STAGE_DIST", "24"))
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
+        """Multichip execute with the SAME timing contract as the
+        single-chip executor: compile/execute/materialize wall-clock,
+        bytes_scanned and the roofline fields land in last_timings and
+        the query span, and the staged sub-program bill folds in after
+        materialize (the round-5 advisor finding: multichip queries
+        silently dropped their bill)."""
         key = key if key is not None else id(planned)
         orig = planned
+        tracer = get_tracer()
+        # a failed query must never inherit the previous query's span
+        self.last_query_span = None
+        qspan = tracer.begin("device.execute",
+                             executor=type(self).__name__,
+                             devices=self.n_dev)
+        with tracer.attach(qspan):
+            try:
+                out, timings = self._execute_traced(planned, orig, key,
+                                                    tracer)
+            except BaseException as exc:
+                # a staged sub's span must not survive as the failed
+                # query's (subs set last_query_span on their success)
+                self.last_query_span = None
+                qspan.set(error=f"{type(exc).__name__}: {exc}").end()
+                raise
+        qspan.set(timings=dict(timings)).end()
+        self.last_query_span = qspan or None
+        return out
+
+    def _execute_traced(self, planned, orig, key, tracer):
+        import time as _time
         planned = self._staged_effective(planned, key)
+        timings = {"compile_ms": 0.0}
+        self.last_timings = timings
         if key not in self._compiled:
             while len(self._compiled) >= self.MAX_COMPILED:
-                self._compiled.pop(next(iter(self._compiled)))
+                old = next(iter(self._compiled))
+                self._compiled.pop(old)
+                # staged-plan state pins its plan through _compiled's
+                # strong ref; evict them together or a recycled id()
+                # can serve another query's staged split
+                self._evict_query_state(old)
             # strong refs: the CALLER'S plan pins the id()-key, the
             # staged main plan is what actually compiled (base executor
             # rationale)
@@ -252,42 +289,71 @@ class DistributedExecutor(dx.DeviceExecutor):
                 state.pop("jitted", None)
                 import gc
                 gc.collect()
-                state["jitted"], state["sk"], state["rk"] = build(slack)
+                t0 = _time.perf_counter()
+                with tracer.span("device.compile", slack=slack):
+                    jitted, state["sk"], state["rk"] = build(slack)
+                    bufs = self._collect_buffers(planned)
+                    # AOT-compile (single-chip contract): compile cost
+                    # must be attributed separately from the execute
+                    # bracket, not hidden in the first timed call
+                    state["jitted"] = jitted.lower(
+                        {k: bufs[k] for k in state["sk"]},
+                        {k: bufs[k] for k in state["rk"]}).compile()
                 state["slack"] = slack
+                timings["compile_ms"] += (
+                    _time.perf_counter() - t0) * 1000
+                obs_metrics.counter(
+                    "compiles_total" if attempt == 0
+                    else "recompiles_total").inc()
             bufs = self._collect_buffers(planned)
             shard_bufs = {k: bufs[k] for k in state["sk"]}
             repl_bufs = {k: bufs[k] for k in state["rk"]}
+            timings["bytes_scanned"] = float(
+                sum(b.nbytes for b in bufs.values()))
+            obs_metrics.counter("device_executions_total").inc()
+            obs_metrics.counter("bytes_scanned_total").inc(
+                timings["bytes_scanned"])
+            t1 = _time.perf_counter()
             row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
             # one batched device->host round trip (see DeviceExecutor)
             row_h, outs_h, overflow_h = jax.device_get(
                 (row, outs, overflow))
+            t2 = _time.perf_counter()
             if int(overflow_h) == 0:
-                return self._materialize(planned, row_h, outs_h, side)
+                tracer.begin("device.run", t0=t1).end(t=t2)
+                with tracer.span("device.materialize"):
+                    out = self._materialize(planned, row_h, outs_h,
+                                            side)
+                t3 = _time.perf_counter()
+                timings["execute_ms"] = (t2 - t1) * 1000
+                timings["materialize_ms"] = (t3 - t2) * 1000
+                self._finalize_timings(timings, key)
+                return out, timings
+            n_over = int(overflow_h)
             TaskFailureCollector.notify(
-                f"exchange overflow ({int(overflow)} rows) at slack="
+                f"exchange overflow ({n_over} rows) at slack="
                 f"{slack}; retrying with slack={slack * 2}")
+            obs_metrics.counter("exchange_overflow_retries_total").inc()
+            obs_metrics.counter("exchange_overflow_rows_total").inc(
+                n_over)
+            obs_metrics.counter("slack_retries_total").inc()
             slack = slack * 2
         raise DeviceExecError("exchange overflow persisted after retries")
 
 
 class _DistTrace(dx._Trace):
-    def __init__(self, ex: DistributedExecutor, bufs: dict, slack: float,
-                 xslacks: dict | None = None):
+    def __init__(self, ex: DistributedExecutor, bufs: dict,
+                 slack: float):
         super().__init__(ex, bufs, slack)
         self.n_dev = ex.n_dev
         self.axes = ex.axes
-        # per-exchange slack overrides (exchange index -> slack): an
-        # overflow retry grows ONLY the overflowing exchange's buckets.
-        # The old whole-program slack doubling doubled every exchange
-        # AND every M:N capacity — on the widest plans that was the
-        # difference between a bounded retry and a 130 GB recompile
-        self.xslacks = xslacks or {}
-        self._xchg_n = 0
-        self._xovers: list = []
 
     def total_overflow(self):
-        """Join-expansion overflow total (exchanges report separately
-        via exchange_overflows)."""
+        """Join-expansion + exchange overflow total (both append to
+        _overflows; the executor's retry loop doubles whole-program
+        slack and surfaces the event through the
+        exchange_overflow_retries_total / exchange_overflow_rows_total
+        metrics counters)."""
         if not self._overflows:
             return jnp.zeros((), jnp.int64)
         tot = self._overflows[0].astype(jnp.int64)
@@ -295,14 +361,6 @@ class _DistTrace(dx._Trace):
             tot = tot + o.astype(jnp.int64)
         # every device sees every exchange; max across devices is enough
         return lax.pmax(tot, self.axes)
-
-    def exchange_overflows(self):
-        """Per-exchange overflow counts, device-maxed; static length
-        per plan (the trace visits exchanges deterministically)."""
-        if not self._xovers:
-            return jnp.zeros((0,), jnp.int64)
-        vec = jnp.stack([o.astype(jnp.int64) for o in self._xovers])
-        return lax.pmax(vec, self.axes)
 
     # ------------------------------------------------------------- helpers
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -55,6 +56,10 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     overrides the per-peer capacity — hierarchical stage 2 sizes it
     from the LOGICAL row count, not the stage-1 padded length."""
     n = dest.shape[0]
+    # trace-time count: how many exchange ops the compiled programs
+    # contain (runtime executions multiply by program runs; in-program
+    # counting would cost a collective per query for a vanity number)
+    obs_metrics.counter("exchanges_traced_total").inc()
     if bucket is None:
         bucket = max(1, int(-(-n * slack // n_dev)))
     # dead rows get a sentinel dest PAST every real bucket so they never

@@ -182,6 +182,13 @@ class Planner:
     def plan_statement(self, stmt) -> "P.PlannedQuery | tuple":
         """Select -> PlannedQuery; CreateView/DropView -> ('view', ...) action
         the session applies (q15 flow, `nds-h/nds_h_power.py:78-82`)."""
+        from nds_tpu.obs import metrics as obs_metrics
+        from nds_tpu.obs.trace import get_tracer
+        obs_metrics.counter("plans_total").inc()
+        with get_tracer().span("sql.plan", stmt=type(stmt).__name__):
+            return self._plan_statement(stmt)
+
+    def _plan_statement(self, stmt) -> "P.PlannedQuery | tuple":
         if isinstance(stmt, ast.CreateView):
             q = self.plan_select(stmt.query, None, {})
             node = q if isinstance(q, P.Node) else q

@@ -10,6 +10,12 @@ JSON summaries and the CSV time log, the ``--allow_failure`` contract
 (`nds/nds_power.py:391-393`), warmup handling, and EngineConfig-driven
 session construction (template < property file precedence,
 `nds/spark-submit-template:24-33` + `nds_power.py:324-330`).
+
+Observability: each query runs inside a root span (nds_tpu/obs) whose
+tree — engine compile/execute/materialize and staged sub-programs
+included — is attached to the JSON summary (``spans``) together with
+the per-query metrics delta (``metrics``); ``NDS_TPU_TRACE=path``
+additionally appends every tree to a Chrome trace-event JSONL.
 """
 
 from __future__ import annotations
@@ -18,7 +24,10 @@ import os
 import time
 from dataclasses import dataclass, field
 
+from nds_tpu import obs
 from nds_tpu.engine.session import Session
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs.trace import get_tracer
 from nds_tpu.utils.config import EngineConfig
 from nds_tpu.utils.report import BenchReport
 from nds_tpu.utils.timelog import TimeLog
@@ -218,35 +227,84 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     power_start = time.perf_counter()
     for qname, sql in queries.items():
         if warmup and not qname.startswith(suite.warmup_skip_prefixes):
-            for _ in range(warmup):
-                try:
-                    run_one_query(session, sql)
-                except Exception:
-                    break
+            # span recording off during warmup: untimed passes would
+            # otherwise append orphan root trees to the Chrome trace,
+            # uncorrelated with any CSV row
+            wtracer = get_tracer()
+            was_enabled = wtracer.enabled
+            wtracer.enabled = False
+            try:
+                for _ in range(warmup):
+                    try:
+                        run_one_query(session, sql)
+                    except Exception:
+                        break
+            finally:
+                wtracer.enabled = was_enabled
         report = BenchReport(qname, config.as_dict())
         out_pref = output_prefix if primary else None
-        if profiler_cm:
-            import jax
-            with jax.profiler.TraceAnnotation(qname):
-                summary = report.report_on(run_one_query, session, sql,
-                                           qname, out_pref)
-        else:
-            summary = report.report_on(run_one_query, session, sql,
-                                       qname, out_pref)
+        # a query that fails BEFORE reaching the executor (parse/plan
+        # errors) must not inherit the previous query's span/timings
+        # into its summary — the in-executor resets only cover queries
+        # that dispatch
+        pre_ex = session._executor_factory(session.tables)
+        pre_ex.last_query_span = None
+        pre_ex.last_timings = {}
+        # per-query root span: brackets EXACTLY what queryTimes/TimeLog
+        # brackets (fn inside report_on), so span totals and the CSV
+        # agree; the engine's parse/plan/compile/execute spans nest
+        # underneath and the whole tree lands in the JSON summary
+        tracer = get_tracer()
+        qhold: dict = {}
+        metrics_before = obs_metrics.snapshot()
+
+        def traced_query(session, sql, _q=qname, _o=out_pref,
+                         _h=qhold):
+            with tracer.span("query", query=_q, suite=suite.name,
+                             backend=backend) as sp:
+                _h["span"] = sp
+                return run_one_query(session, sql, _q, _o)
+
+        # exports park during the bracket (even a ~ms inline write
+        # would skew span totals vs the TimeLog row) and flush after
+        tracer.defer_exports = True
+        try:
+            if profiler_cm:
+                import jax
+                with jax.profiler.TraceAnnotation(qname):
+                    summary = report.report_on(traced_query, session,
+                                               sql)
+            else:
+                summary = report.report_on(traced_query, session, sql)
+        finally:
+            tracer.defer_exports = False
+            tracer.flush_exports()
         # engine-side perf accounting: compile vs execute vs
-        # device->host materialization (device backends expose
-        # last_timings; the CPU oracle has none)
+        # device->host materialization, fed by the query span tree
+        # (obs.query_timings falls back to legacy last_timings; the
+        # CPU oracle has neither)
         executor = session._executor_factory(session.tables)
-        timings = getattr(executor, "last_timings", None)
+        timings = obs.query_timings(executor)
         if timings:
             summary["engineTimings"] = {k: round(v, 3)
                                         for k, v in timings.items()}
+        qspan = qhold.get("span")
+        if qspan:
+            summary["spans"] = qspan.to_dict()
         elapsed_ms = summary["queryTimes"][-1]
+        obs_metrics.counter("queries_total").inc()
+        obs_metrics.histogram("query_seconds").observe(
+            elapsed_ms / 1000.0)
+        if not report.is_success():
+            failures += 1
+            obs_metrics.counter("query_failures_total").inc()
+        mdelta = obs_metrics.delta(metrics_before,
+                                   obs_metrics.snapshot())
+        if mdelta:
+            summary["metrics"] = mdelta
         tlog.add(qname, elapsed_ms)
         print(f"====== Run {qname} ======")
         print(f"Time taken: {elapsed_ms} millis for {qname}")
-        if not report.is_success():
-            failures += 1
         if json_summary_folder and primary:
             cwd = os.getcwd()
             os.chdir(json_summary_folder)
@@ -298,19 +356,25 @@ def subprocess_env(backend: str | None = None) -> dict:
 
 
 def add_config_args(parser) -> None:
-    """The --template/--property_file CLI surface shared by every driver
-    (reference: spark-submit-template sources the template,
-    `nds_power.py:324-330` merges the property file)."""
+    """The --template/--property_file/--trace CLI surface shared by
+    every driver (reference: spark-submit-template sources the
+    template, `nds_power.py:324-330` merges the property file)."""
     parser.add_argument("--template",
                         help="engine template file (k=v with ${ENV:-default})")
     parser.add_argument("--property_file",
                         help="k=v property file overriding the template")
+    parser.add_argument("--trace",
+                        help="append per-query Chrome trace-event JSONL "
+                             "here (same as NDS_TPU_TRACE=path; see "
+                             "README Observability)")
 
 
 def config_from_args(args, default_backend: str = "tpu") -> EngineConfig:
     """CLI --backend > property file > template > the driver's default
     (matching spark-submit-template < --property_file precedence with
     spark-submit's own CLI last)."""
+    if getattr(args, "trace", None):
+        os.environ["NDS_TPU_TRACE"] = args.trace
     cli_backend = getattr(args, "backend", None)
     overrides = {}
     if cli_backend is not None:

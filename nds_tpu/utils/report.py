@@ -11,6 +11,12 @@ consumers keep working. Differences are TPU-native by design:
   failure collector — there is no JVM boundary in this stack;
 - timing brackets call ``block_until_ready`` upstream so async dispatch
   cannot hide work (SURVEY.md §5 tracing note).
+
+Schema additions over the reference format (README "Observability"):
+the power loop attaches ``spans`` (the per-query span tree from
+nds_tpu/obs/trace.py) and ``metrics`` (the per-query delta of the
+global counter registry) to each summary; both are absent when the
+corresponding subsystem recorded nothing.
 """
 
 from __future__ import annotations
@@ -59,7 +65,13 @@ class TaskFailureCollector:
 
     @classmethod
     def notify(cls, reason: str) -> None:
-        """Called by engine internals on recoverable task-level failures."""
+        """Called by engine internals on recoverable task-level
+        failures. Every notification also increments the
+        ``task_failures_total`` metrics counter, so anomaly volume is
+        visible across a whole run even when no collector is
+        registered (warmups, direct executor use)."""
+        from nds_tpu.obs import metrics as obs_metrics
+        obs_metrics.counter("task_failures_total").inc()
         for listener in cls._active:
             listener.failures.append(reason)
 

@@ -13,10 +13,52 @@ import os
 # force, don't setdefault: the ambient environment may point JAX at a
 # remote TPU tunnel (axon); tests must run on the local virtual CPU mesh
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _jaxlib_knows(*flag_names: str) -> bool:
+    """True when the installed jaxlib's binaries mention EVERY given
+    XLA flag. XLA ABORTS the whole process on any unknown XLA_FLAGS
+    entry (older jaxlibs predate the collective-timeout flags below,
+    and the abort killed the entire pytest run at the first device
+    use), so probe the shared objects for the flags' names before
+    opting in. The grep verdict is cached in a tempdir marker keyed by
+    the jaxlib version (the install cannot change mid-run), so the
+    multi-hundred-MB scan runs once per install, not once per pytest
+    session. Probe failure keeps the flags (the original behavior)."""
+    import hashlib
+    import importlib.util
+    import pathlib
+    import shlex
+    import subprocess
+    import tempfile
+    try:
+        import jaxlib
+        spec = importlib.util.find_spec("jaxlib")
+        root = list(spec.submodule_search_locations)[0]
+        tag = hashlib.md5(
+            "|".join((jaxlib.__version__, root) + flag_names).encode()
+        ).hexdigest()[:12]
+        cache = pathlib.Path(tempfile.gettempdir()) / (
+            f"nds_tpu_xlaflag_probe_{tag}")
+        if cache.exists():
+            return cache.read_text() == "1"
+        cmd = " && ".join(
+            f"grep -rqs {shlex.quote(f)} {shlex.quote(root)}"
+            for f in flag_names)
+        ok = subprocess.run(["sh", "-c", cmd],
+                            timeout=120).returncode == 0
+        cache.write_text("1" if ok else "0")
+        return ok
+    except Exception:  # noqa: BLE001 - no grep/jaxlib layout surprises
+        return True
+
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-if "collective_call_terminate" not in flags:
+if "collective_call_terminate" not in flags and _jaxlib_knows(
+        "xla_cpu_collective_call_warn_stuck_timeout_seconds",
+        "xla_cpu_collective_call_terminate_timeout_seconds"):
     # virtual devices are threads sharing the host's cores: on a small
     # box the 8 per-device threads serialize, and a heavy pre-collective
     # section can overrun XLA CPU's default 40 s rendezvous termination

@@ -1,0 +1,560 @@
+"""Observability layer tests (nds_tpu/obs): span nesting + attributes,
+disabled-mode no-ops, the Chrome trace-event JSONL schema (golden,
+gated by tools/check_trace_schema.py), the TaskFailureCollector ->
+metrics bridge, timings parity between the span-fed query_timings
+accessor and legacy last_timings on single-chip and virtual-mesh
+distributed executors, and the end-to-end power-run contract: a
+3-query NDS power run with NDS_TPU_TRACE set emits schema-valid JSONL
+whose per-query span totals agree with the TimeLog CSV within 5 ms on
+both executors, staged sub-program spans included."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from nds_tpu import obs
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs.trace import (
+    NOOP_SPAN, Span, Tracer, export_chrome, timings_from_span,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+# --------------------------------------------------------------- tracer
+
+class TestTracer:
+    def test_span_nesting_and_attrs(self):
+        tr = Tracer(enabled=True)
+        with tr.span("query", query="q1") as root:
+            with tr.span("sql.parse", chars=42) as p:
+                pass
+            with tr.span("device.execute") as ex:
+                with tr.span("device.compile") as c:
+                    pass
+        assert [c.name for c in root.children] == ["sql.parse",
+                                                   "device.execute"]
+        assert ex.children == [c]
+        assert root.attrs["query"] == "q1"
+        assert p.attrs["chars"] == 42
+        assert root.t1 is not None
+        assert root.dur_ms >= ex.dur_ms >= c.dur_ms >= 0
+        assert [s.name for s in root.walk()] == [
+            "query", "sql.parse", "device.execute", "device.compile"]
+        assert root.find("device.compile") == [c]
+        # root retention for BenchReport export
+        assert tr.last_roots[-1] is root
+
+    def test_exception_closes_span_and_records_error(self):
+        tr = Tracer(enabled=True)
+        with pytest.raises(ValueError):
+            with tr.span("query") as root:
+                raise ValueError("boom")
+        assert root.t1 is not None
+        assert "boom" in root.attrs["error"]
+
+    def test_begin_attach_for_async_owners(self):
+        """Async executors own their span explicitly: begin() does not
+        touch the thread stack; attach() makes it current for nested
+        phases without ending it."""
+        tr = Tracer(enabled=True)
+        q = tr.begin("device.execute", parent=None)
+        assert tr.current() is None
+        with tr.attach(q):
+            assert tr.current() is q
+            with tr.span("device.materialize"):
+                pass
+        assert tr.current() is None
+        assert q.t1 is None  # attach never ends
+        run = tr.begin("device.run", parent=q, t0=q.t0)
+        run.end(t=q.t0 + 0.5)
+        assert abs(run.dur_ms - 500.0) < 1e-6
+        q.set(timings={"execute_ms": 500.0}).end()
+        assert [c.name for c in q.children] == ["device.materialize",
+                                                "device.run"]
+        assert tr.last_roots[-1] is q
+
+    def test_disabled_mode_is_noop(self):
+        tr = Tracer(enabled=False)
+        s = tr.span("query", big_attr="x")
+        assert s is NOOP_SPAN and not s
+        assert s.set(a=1) is s and s.end() is s
+        with s:
+            pass
+        assert tr.begin("device.execute") is NOOP_SPAN
+        with tr.attach(s):
+            assert tr.current() is None
+        assert len(tr.last_roots) == 0
+        assert timings_from_span(s) == {}
+
+    def test_threads_get_independent_stacks(self):
+        tr = Tracer(enabled=True)
+        seen = {}
+
+        def worker():
+            seen["current"] = tr.current()
+            with tr.span("query", thread="t") as s:
+                seen["span"] = s
+
+        with tr.span("query", thread="main") as root:
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["current"] is None       # no cross-thread leakage
+        assert seen["span"].parent is None   # its own root
+        assert root.children == []
+
+    def test_timings_from_span_prefers_attached_dict(self):
+        tr = Tracer(enabled=True)
+        with tr.span("device.execute") as q:
+            with tr.span("device.compile"):
+                pass
+        q.set(timings={"compile_ms": 7.0, "bytes_scanned": 10.0})
+        assert timings_from_span(q) == {"compile_ms": 7.0,
+                                        "bytes_scanned": 10.0}
+
+    def test_timings_from_span_sums_phases(self):
+        tr = Tracer(enabled=True)
+        q = tr.begin("device.execute", parent=None)
+        tr.begin("device.run", parent=q, t0=1.0).end(t=1.25)
+        tr.begin("device.run", parent=q, t0=2.0).end(t=2.25)
+        tr.begin("device.compile", parent=q, t0=0.0).end(t=0.5)
+        q.end()
+        t = timings_from_span(q)
+        assert abs(t["execute_ms"] - 500.0) < 1e-6
+        assert abs(t["compile_ms"] - 500.0) < 1e-6
+
+
+# ------------------------------------------------------- chrome export
+
+class TestChromeExport:
+    def _tree(self):
+        tr = Tracer(enabled=True)
+        with tr.span("query", query="q96") as root:
+            with tr.span("device.execute", executor="DeviceExecutor"):
+                pass
+        return root
+
+    def test_export_appends_jsonl(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        root = self._tree()
+        export_chrome(root, path)
+        export_chrome(root, path)  # append, not truncate
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 4
+        assert lines[0]["name"] == "query"
+        assert lines[1]["name"] == "device.execute"
+
+    def test_event_schema_golden(self, tmp_path):
+        """The documented event schema, field by field — consumers
+        (Perfetto after array-wrapping, check_trace_schema.py) parse
+        exactly this."""
+        path = str(tmp_path / "trace.jsonl")
+        export_chrome(self._tree(), path)
+        ev = json.loads(open(path).readline())
+        assert set(ev) == {"name", "cat", "ph", "ts", "dur", "pid",
+                           "tid", "args"}
+        assert ev["ph"] == "X"
+        assert ev["cat"] == "query"
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["ts"], float) and ev["ts"] >= 0
+        assert isinstance(ev["dur"], float) and ev["dur"] >= 0
+        assert ev["pid"] == os.getpid()
+        assert isinstance(ev["tid"], int)
+        assert ev["args"] == {"query": "q96"}
+
+    def test_env_var_triggers_export_on_root_end(self, tmp_path,
+                                                 monkeypatch):
+        path = str(tmp_path / "auto.jsonl")
+        monkeypatch.setenv("NDS_TPU_TRACE", path)
+        tr = Tracer(enabled=True)
+        with tr.span("query", query="auto"):
+            with tr.span("sql.parse"):
+                pass
+        events = [json.loads(ln) for ln in open(path)]
+        assert [e["name"] for e in events] == ["query", "sql.parse"]
+
+    def test_check_trace_schema_validates(self, tmp_path):
+        from tools.check_trace_schema import validate_file
+        path = str(tmp_path / "trace.jsonl")
+        export_chrome(self._tree(), path)
+        assert validate_file(path) == []
+
+    def test_check_trace_schema_rejects_bad_events(self, tmp_path):
+        from tools.check_trace_schema import validate_event, validate_file
+        assert validate_event([]) != []
+        assert validate_event({"name": "x"}) != []
+        good = {"name": "x", "cat": "x", "ph": "X", "ts": 0.0,
+                "dur": 1.0, "pid": 1, "tid": 1, "args": {}}
+        assert validate_event(good) == []
+        assert validate_event({**good, "ph": "B"}) != []
+        assert validate_event({**good, "dur": -1}) != []
+        assert validate_event({**good, "args": 3}) != []
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(json.dumps(good) + "\nnot json\n")
+        errs = validate_file(str(bad))
+        assert len(errs) == 1 and "line 2" in errs[0]
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert validate_file(str(empty)) != []
+
+
+# -------------------------------------------------------------- metrics
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2.5)
+        reg.gauge("g").set(7)
+        reg.histogram("h").observe(1.0)
+        reg.histogram("h").observe(3.0)
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == 3.5
+        assert snap["gauges"]["g"] == 7
+        assert snap["histograms"]["h"] == {
+            "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0}
+
+    def test_delta(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("a").inc(5)
+        reg.histogram("h").observe(2.0)
+        before = reg.snapshot()
+        reg.counter("a").inc(3)
+        reg.counter("b").inc()
+        reg.gauge("g").set(1)
+        reg.histogram("h").observe(4.0)
+        d = obs_metrics.delta(before, reg.snapshot())
+        assert d["counters"] == {"a": 3, "b": 1}
+        assert d["gauges"] == {"g": 1}
+        assert d["histograms"]["h"] == {"count": 1, "sum": 4.0}
+        assert obs_metrics.delta(before, before) == {}
+
+    def test_counter_thread_safety(self):
+        reg = obs_metrics.MetricsRegistry()
+
+        def hammer():
+            c = reg.counter("n")
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counter("n").value == 8000
+
+    def test_task_failure_collector_bridge(self):
+        """Every TaskFailureCollector.notify lands in the
+        task_failures_total counter — with and without a registered
+        listener."""
+        from nds_tpu.utils.report import TaskFailureCollector
+        before = obs_metrics.counter("task_failures_total").value
+        TaskFailureCollector.notify("anomaly with nobody listening")
+        col = TaskFailureCollector()
+        col.register()
+        try:
+            TaskFailureCollector.notify("anomaly with a listener")
+        finally:
+            col.unregister()
+        assert obs_metrics.counter(
+            "task_failures_total").value == before + 2
+        assert col.failures == ["anomaly with a listener"]
+
+
+# ------------------------------------------------------- timings parity
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def tpch_raw():
+    from nds_tpu.datagen import tpch
+    from nds_tpu.nds_h.schema import get_schemas
+    return {t: tpch.gen_table(t, SF) for t in get_schemas()}
+
+
+def _nds_h_session(raw, factory=None):
+    from nds_tpu.engine.session import Session
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds_h.schema import get_schemas
+    schemas = get_schemas()
+    sess = Session.for_nds_h(factory)
+    for t in schemas:
+        sess.register_table(from_arrays(t, schemas[t], raw[t]))
+    return sess
+
+
+TIMING_KEYS = {"compile_ms", "execute_ms", "materialize_ms",
+               "bytes_scanned", "scan_gbps"}
+
+
+class TestTimingsParity:
+    def test_single_chip_query_timings_match_last_timings(self,
+                                                          tpch_raw):
+        from nds_tpu.engine.device_exec import make_device_factory
+        from nds_tpu.nds_h import streams
+        sess = _nds_h_session(tpch_raw, make_device_factory())
+        sess.sql(streams.render_query(6))
+        ex = sess._executor_factory(sess.tables)
+        got = obs.query_timings(ex)
+        assert got == ex.last_timings
+        assert TIMING_KEYS <= set(got)
+        root = ex.last_query_span
+        assert root.name == "device.execute"
+        names = {c.name for c in root.children}
+        assert {"device.compile", "device.run",
+                "device.materialize"} <= names
+
+    def test_distributed_query_timings_match_last_timings(self,
+                                                          tpch_raw):
+        """The multichip path reports the same timing schema as
+        single-chip (round-5 advisor fix: DistributedExecutor.execute
+        used to leave last_timings stale/empty)."""
+        from nds_tpu.nds_h import streams
+        from nds_tpu.parallel.dist_exec import make_distributed_factory
+        sess = _nds_h_session(
+            tpch_raw,
+            make_distributed_factory(n_devices=8, shard_threshold=1000))
+        sess.sql(streams.render_query(6))
+        ex = sess._executor_factory(sess.tables)
+        got = obs.query_timings(ex)
+        assert got == ex.last_timings
+        assert TIMING_KEYS <= set(got)
+        assert got["execute_ms"] > 0
+
+    def test_distributed_staged_bill_folds_into_timings(
+            self, tpch_raw, monkeypatch):
+        """Staged sub-programs on the multichip path must bill into
+        the query's timings (the dropped-bill half of the advisor
+        finding) and appear as spans."""
+        from nds_tpu.engine import staging
+        from nds_tpu.nds_h import streams
+        from nds_tpu.parallel.dist_exec import (
+            DistributedExecutor, make_distributed_factory,
+        )
+        monkeypatch.setattr(DistributedExecutor, "STAGE_WEIGHT", 4)
+        monkeypatch.setattr(staging, "MIN_CUT_WEIGHT", 2)
+        sess = _nds_h_session(
+            tpch_raw,
+            make_distributed_factory(n_devices=8, shard_threshold=1000))
+        sess.sql(streams.render_query(3))
+        ex = sess._executor_factory(sess.tables)
+        tm = obs.query_timings(ex)
+        assert tm.get("staged_programs", 0) >= 1
+        assert tm == ex.last_timings
+        assert not ex._stage_timings  # bill consumed, no leak
+        assert len(ex.last_query_span.find("stage.sub")) >= 1
+
+    def test_stage_plan_reuse_requires_pinned_plan(self, tpch_raw,
+                                                   monkeypatch):
+        """_stage_plans entries pin the caller's plan object; an entry
+        whose pin does not match the incoming plan (recycled id() /
+        rebound key) is recomputed, never served stale (round-5
+        advisor finding)."""
+        from nds_tpu.engine import staging
+        from nds_tpu.engine.device_exec import DeviceExecutor
+        from nds_tpu.nds_h import streams
+        monkeypatch.setattr(DeviceExecutor, "STAGE_WEIGHT", 4)
+        monkeypatch.setattr(staging, "MIN_CUT_WEIGHT", 2)
+        sess = _nds_h_session(tpch_raw)
+        planned_a = sess.plan(streams.render_query(3))
+        planned_b = sess.plan(streams.render_query(10))
+        ex = DeviceExecutor(sess.tables)
+        ex.execute(planned_a, key="k")
+        entry_a = ex._stage_plans["k"]
+        assert entry_a[0] is planned_a
+        # pin matches: the cached split is reused, not recomputed
+        ex.execute(planned_a, key="k")
+        assert ex._stage_plans["k"] is entry_a
+        # the overflow-retry path re-dispatches the staged MAIN plan
+        # under the same key: that must reuse the split (temps are
+        # registered, the bill is parked) — NOT evict the compile entry
+        # whose slack the retry just doubled
+        main = entry_a[2]
+        assert main is not planned_a
+        assert ex._staged_effective(main, "k") is main
+        assert ex._stage_plans["k"] is entry_a
+        assert "k" in ex._compiled
+        # eviction dropped the program + pinning ref, then the key was
+        # recycled by a DIFFERENT plan: the stale split must not serve
+        ex._compiled.pop("k")
+        ex.execute(planned_b, key="k")
+        assert ex._stage_plans["k"][0] is planned_b
+
+    def test_distributed_eviction_drops_stage_state(self, tpch_raw,
+                                                    monkeypatch):
+        """LRU eviction of a compiled program also drops its staging
+        state (including recursive sub-program keys) so recycled id()s
+        can never hit a stale split."""
+        from nds_tpu.engine import staging
+        from nds_tpu.nds_h import streams
+        from nds_tpu.parallel.dist_exec import DistributedExecutor
+        monkeypatch.setattr(DistributedExecutor, "STAGE_WEIGHT", 4)
+        monkeypatch.setattr(DistributedExecutor, "MAX_COMPILED", 2)
+        monkeypatch.setattr(staging, "MIN_CUT_WEIGHT", 2)
+        holder = {}
+
+        def factory(tables):
+            ex = holder.get("ex")
+            if ex is None or ex.tables is not tables:
+                ex = DistributedExecutor(tables, n_devices=8,
+                                         shard_threshold=1000)
+                holder["ex"] = ex
+            return ex
+
+        sess = _nds_h_session(tpch_raw, factory)
+        sess.sql(streams.render_query(3))   # stages: main + sub keys
+        ex = holder["ex"]
+        staged_keys = set(ex._stage_plans)
+        assert staged_keys
+        temps = [t for e in ex._stage_plans.values() for _s, t in e[1]]
+        assert temps and all(t in ex.tables for t in temps)
+        sess.sql(streams.render_query(6))
+        sess.sql(streams.render_query(1))
+        assert len(ex._compiled) <= 2
+        # q3's main AND derived sub-program staging state evicted with it
+        assert not (staged_keys & set(ex._stage_plans))
+        # ...including its temp tables and their caches (eviction+rerun
+        # cycles must not leak staged intermediates)
+        for t in temps:
+            assert t not in ex.tables and t not in ex._stage_fps
+            assert not any(k.startswith(t + ".") for k in ex._buffers)
+
+
+# ----------------------------------------------- power-run integration
+
+NDS_SF = 0.002
+NDS_QUERIES = [96, 7, 93]
+
+
+@pytest.fixture(scope="module")
+def nds_power_dirs(tmp_path_factory):
+    """Tiny NDS warehouse (one parquet per table) + a 3-query stream."""
+    from nds_tpu.datagen import tpcds
+    from nds_tpu.io import csv_io
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds import streams
+    from nds_tpu.nds.schema import get_schemas
+    root = tmp_path_factory.mktemp("obs_power")
+    wh = root / "wh"
+    wh.mkdir()
+    schemas = get_schemas()
+    for t, schema in schemas.items():
+        table = from_arrays(t, schema, tpcds.gen_table(t, NDS_SF))
+        csv_io.write_parquet(table, str(wh / f"{t}.parquet"))
+    sdir = root / "streams"
+    streams.generate_query_streams(str(sdir), 1,
+                                   templates=NDS_QUERIES)
+    return {"wh": str(wh), "stream": str(sdir / "query_0.sql"),
+            "root": str(root)}
+
+
+def _run_power(dirs, backend, tag, monkeypatch, tmp_path):
+    from nds_tpu.engine import staging
+    from nds_tpu.engine.device_exec import DeviceExecutor
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.parallel.dist_exec import DistributedExecutor
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    # force plan splitting so staged sub-program spans appear
+    monkeypatch.setattr(DeviceExecutor, "STAGE_WEIGHT", 8)
+    monkeypatch.setattr(DistributedExecutor, "STAGE_WEIGHT", 8)
+    monkeypatch.setattr(staging, "MIN_CUT_WEIGHT", 2)
+    trace_path = str(tmp_path / f"trace_{tag}.jsonl")
+    time_log = str(tmp_path / f"time_{tag}.csv")
+    summaries = str(tmp_path / f"json_{tag}")
+    monkeypatch.setenv("NDS_TPU_TRACE", trace_path)
+    failures = power_core.run_query_stream(
+        SUITE, dirs["wh"], dirs["stream"], time_log,
+        config=EngineConfig(overrides={"engine.backend": backend}),
+        json_summary_folder=summaries)
+    return {"failures": failures, "trace": trace_path,
+            "time_log": time_log, "summaries": summaries}
+
+
+def _check_power_artifacts(res):
+    """The acceptance contract, shared by both backends: schema-valid
+    trace, span/CSV agreement within 5 ms, staged spans present,
+    engineTimings + spans + metrics in the JSON summaries."""
+    from nds_tpu.utils.timelog import TimeLog
+    from tools.check_trace_schema import validate_file
+    assert res["failures"] == 0
+    assert validate_file(res["trace"]) == []
+    events = [json.loads(ln) for ln in open(res["trace"])]
+    csv_ms = {q: ms for _app, q, ms in TimeLog.read(res["time_log"])}
+    roots = [e for e in events if e["name"] == "query"]
+    assert {e["args"]["query"] for e in roots} == {
+        f"query{n}" for n in NDS_QUERIES}
+    for ev in roots:
+        q = ev["args"]["query"]
+        span_ms = ev["dur"] / 1000.0
+        assert abs(span_ms - csv_ms[q]) <= 5.0, (
+            f"{q}: span {span_ms:.2f} ms vs CSV {csv_ms[q]} ms")
+    # staged sub-programs traced (STAGE_WEIGHT forced low)
+    assert any(e["name"] == "stage.sub" for e in events)
+    assert any(e["name"] == "device.compile" for e in events)
+    # JSON summaries carry the new schema fields
+    files = os.listdir(res["summaries"])
+    assert len(files) == len(NDS_QUERIES)
+    for f in files:
+        with open(os.path.join(res["summaries"], f)) as fh:
+            s = json.load(fh)
+        assert s["queryStatus"] == ["Completed"]
+        et = s["engineTimings"]
+        assert et["execute_ms"] > 0 and et["bytes_scanned"] > 0
+        assert et.get("staged_programs", 0) >= 1
+        assert s["spans"]["name"] == "query"
+        kids = [c["name"] for c in s["spans"]["children"]]
+        assert "device.execute" in kids
+        assert s["metrics"]["counters"]["queries_total"] == 1
+
+
+class TestPowerRunTracing:
+    def test_single_chip_power_run_trace(self, nds_power_dirs,
+                                         monkeypatch, tmp_path):
+        res = _run_power(nds_power_dirs, "tpu", "tpu", monkeypatch,
+                         tmp_path)
+        _check_power_artifacts(res)
+
+    def test_distributed_power_run_trace(self, nds_power_dirs,
+                                         monkeypatch, tmp_path):
+        res = _run_power(nds_power_dirs, "distributed", "dist",
+                         monkeypatch, tmp_path)
+        _check_power_artifacts(res)
+
+
+# ------------------------------------------------------------ CI gates
+
+class TestToolGates:
+    def test_check_headers_gate(self):
+        """Every source file keeps its design-intent docstring (the
+        repo's license-header-check analog) — run the real tool so a
+        regression fails tier-1, not just CI."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "check_headers.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_check_trace_schema_cli(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.span("query", query="cli") as root:
+            pass
+        good = tmp_path / "good.jsonl"
+        export_chrome(root, str(good))
+        tool = os.path.join(TOOLS, "check_trace_schema.py")
+        ok = subprocess.run([sys.executable, tool, str(good)],
+                            capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"name": "x"}\n')
+        fail = subprocess.run([sys.executable, tool, str(bad)],
+                              capture_output=True, text=True)
+        assert fail.returncode == 1
+        assert "missing key" in fail.stdout

@@ -1,0 +1,95 @@
+"""CI gate for the Chrome trace-event JSONL the engine emits via
+``NDS_TPU_TRACE`` (nds_tpu/obs/trace.py): every line must be one JSON
+object matching the documented event schema (README "Observability"),
+so downstream consumers — Perfetto after array-wrapping, or anything
+parsing the JSONL directly — never meet a malformed event.
+
+Schema (one event per line):
+  name: non-empty str      ph:  "X" (complete event)
+  cat:  str                ts:  number >= 0 (microseconds)
+  dur:  number >= 0        pid: int        tid: int
+  args: object (optional)
+
+Exit 0 when every line validates; prints each offending line otherwise.
+Run by tests/test_observability.py as a tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REQUIRED = {
+    "name": str,
+    "cat": str,
+    "ph": str,
+    "ts": (int, float),
+    "dur": (int, float),
+    "pid": int,
+    "tid": int,
+}
+
+
+def validate_event(obj: object) -> list[str]:
+    """Schema errors for one parsed event ([] = valid)."""
+    errs = []
+    if not isinstance(obj, dict):
+        return [f"event is {type(obj).__name__}, not an object"]
+    for key, typ in REQUIRED.items():
+        if key not in obj:
+            errs.append(f"missing key {key!r}")
+        elif not isinstance(obj[key], typ) or isinstance(obj[key], bool):
+            errs.append(f"{key!r} has type {type(obj[key]).__name__}")
+    if not errs:
+        if not obj["name"]:
+            errs.append("empty name")
+        if obj["ph"] != "X":
+            errs.append(f"ph {obj['ph']!r} != 'X'")
+        if obj["ts"] < 0:
+            errs.append("negative ts")
+        if obj["dur"] < 0:
+            errs.append("negative dur")
+    if "args" in obj and not isinstance(obj.get("args"), dict):
+        errs.append("args is not an object")
+    return errs
+
+
+def validate_file(path: str) -> list[str]:
+    """All schema errors in a trace file, prefixed with line numbers
+    ([] = valid). An empty file is an error: a power run with tracing
+    enabled must emit at least one event."""
+    errors = []
+    n = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                obj = json.loads(line)
+            except ValueError as exc:
+                errors.append(f"line {lineno}: not JSON ({exc})")
+                continue
+            for e in validate_event(obj):
+                errors.append(f"line {lineno}: {e}")
+    if n == 0:
+        errors.append("no events: file is empty")
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: check_trace_schema.py TRACE_JSONL")
+        return 2
+    errors = validate_file(argv[0])
+    for e in errors:
+        print(e)
+    print(f"{'FAIL' if errors else 'OK'}: {len(errors)} schema error(s) "
+          f"in {argv[0]}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
