@@ -1,0 +1,20 @@
+"""Static-analysis layer: plan invariants and codebase lint.
+
+Two subsystems live here, both gated into tier-1 by
+``tools/static_checks.py``:
+
+- ``plan_verify``: walks every ``PlannedQuery`` post-planning and checks
+  the structural invariants the executors silently rely on (ColRef
+  resolution, dtype propagation, join-key dtype agreement, staged-scan
+  integrity). Enabled automatically under ``NDS_TPU_VERIFY_PLANS=1``
+  and always in tests.
+- ``lint_rules``: ast-based rules over the codebase encoding the
+  mechanical hazard classes advisor rounds kept rediscovering by hand
+  (id()-keyed caches without a pinning ref, raw timing calls in the
+  engine, prefix-only content fingerprints, dead dataclass fields, ...),
+  driven by ``tools/ndslint.py``.
+"""
+
+from nds_tpu.analysis.plan_verify import (  # noqa: F401
+    PlanVerifyError, Violation, assert_valid, verify, verify_enabled,
+)

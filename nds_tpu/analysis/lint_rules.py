@@ -1,0 +1,435 @@
+"""ndslint rules: the repo's recurring hazard classes as ast checks.
+
+Every rule here encodes a bug class an advisor round actually found by
+hand (ADVICE.md rounds 1-5) — the linter exists so the NEXT instance
+fails CI instead of waiting for a human audit:
+
+- NDS101 id-keyed-cache     storing under ``id(obj)`` without the value
+                            pinning the object: a recycled address
+                            serves another object's cached state
+                            (round-5 `_stage_plans` finding).
+- NDS102 raw-timing         ``time.time()/perf_counter()/monotonic()``
+                            inside ``engine/`` / ``parallel/``: timing
+                            bills belong to ``obs`` spans so traces and
+                            CSVs can never drift apart.
+- NDS103 unsynced-timing    a perf-counter delta in a function that
+                            touches jax but never syncs
+                            (``block_until_ready``/``device_get``):
+                            async dispatch makes the bracket measure
+                            dispatch, not execution.
+- NDS104 prefix-hash        content fingerprint over a sliced prefix
+                            (``arr[:n].tobytes()``): same-shape changes
+                            past the prefix serve stale cache entries
+                            (round-5 `_register_staged` finding).
+- NDS105 dead-field         dataclass field written but never read
+                            anywhere in the tree (round-5 `_DistTrace`
+                            finding).
+- NDS106 mutable-default    mutable function-argument default.
+- NDS107 bare-except        ``except:`` catching SystemExit/
+                            KeyboardInterrupt.
+
+Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
+the offending line or the line directly above. The justification is
+mandatory; a waiver without one, or one that matches no violation, is
+itself an error. The marker and file roots come from
+``[tool.ndslint]`` in pyproject.toml (tools/ndslint.py loads it).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LintViolation:
+    rule: str
+    path: str
+    line: int
+    msg: str
+    waived: bool = False
+    waiver_note: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+@dataclass
+class Waiver:
+    line: int           # line the waiver covers
+    rules: list
+    note: str
+    used: bool = False
+
+
+# ------------------------------------------------------------- waivers
+
+WAIVER_RE = re.compile(
+    r"#\s*ndslint:\s*waive\[(?P<rules>[A-Z0-9, ]+)\]"
+    r"(?:\s*--\s*(?P<note>.*\S))?")
+
+
+def parse_waivers(src: str) -> "tuple[dict, list[LintViolation]]":
+    """{covered_line: Waiver} plus violations for malformed waivers
+    (missing justification). A waiver on its own line covers the next
+    line; an end-of-line waiver covers its own."""
+    waivers: dict[int, Waiver] = {}
+    errors: list[LintViolation] = []
+    for lineno, text in enumerate(src.splitlines(), 1):
+        m = WAIVER_RE.search(text)
+        if not m:
+            continue
+        rules = [r.strip() for r in m.group("rules").split(",")
+                 if r.strip()]
+        note = (m.group("note") or "").strip()
+        standalone = text[: m.start()].strip() == ""
+        covered = lineno + 1 if standalone else lineno
+        if not note:
+            errors.append(LintViolation(
+                "NDS100", "", lineno,
+                "waiver without justification (use "
+                "'# ndslint: waive[NDS1xx] -- why')"))
+            continue
+        waivers[covered] = Waiver(covered, rules, note)
+    return waivers, errors
+
+
+# --------------------------------------------------------------- rules
+
+class Rule:
+    id = "NDS000"
+    name = "base"
+    #: path substrings this rule is restricted to ([] = everywhere)
+    paths: tuple = ()
+
+    def applies(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return not self.paths or any(p in norm for p in self.paths)
+
+    def check(self, tree: ast.AST, src: str,
+              path: str) -> "list[LintViolation]":
+        raise NotImplementedError
+
+
+def _walk_funcs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _attrs_in(node: ast.AST) -> set:
+    return {n.attr for n in ast.walk(node)
+            if isinstance(n, ast.Attribute)}
+
+
+def _is_id_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "id")
+
+
+class IdKeyedCacheRule(Rule):
+    """NDS101: ``cache[id(x)] = v`` / ``cache.setdefault(id(x), v)``
+    where nothing guarantees ``x`` outlives the entry. Detected
+    syntactically (any id()-derived subscript store); sites that DO pin
+    the object in the stored value carry a waiver saying so."""
+
+    id = "NDS101"
+    name = "id-keyed-cache"
+
+    def check(self, tree, src, path):
+        out = []
+        # names assigned from a bare id(...) call anywhere in the file:
+        # `nid = id(node); cache[nid] = v` is the same hazard spelled
+        # in two statements (name collisions across scopes only widen
+        # the net, which is the right failure mode for a linter)
+        id_vars = {t.id for n in ast.walk(tree)
+                   if isinstance(n, ast.Assign) and _is_id_call(n.value)
+                   for t in n.targets if isinstance(t, ast.Name)}
+
+        def keyed_by_id(expr: ast.AST) -> bool:
+            return (any(_is_id_call(x) for x in ast.walk(expr))
+                    or (isinstance(expr, ast.Name)
+                        and expr.id in id_vars))
+
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                tgts = (n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                for t in tgts:
+                    if (isinstance(t, ast.Subscript)
+                            and keyed_by_id(t.slice)):
+                        out.append(LintViolation(
+                            self.id, path, n.lineno,
+                            "store keyed by id(): a recycled address "
+                            "can serve another object's entry unless "
+                            "the value pins the object"))
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr == "setdefault" and n.args
+                  and keyed_by_id(n.args[0])):
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    "id()-keyed setdefault: the stored value must pin "
+                    "the keyed object (or waive with the pinning "
+                    "argument)"))
+        return out
+
+
+class RawTimingRule(Rule):
+    """NDS102: raw wall-clock reads in the engine/parallel layers."""
+
+    id = "NDS102"
+    name = "raw-timing"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+    _FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in self._FUNCS
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id.lstrip("_") == "time"):
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    f"raw time.{n.func.attr}() in the engine layer — "
+                    f"use an obs span (or waive with why the raw "
+                    f"bracket is required)"))
+        return out
+
+
+class UnsyncedTimingRule(Rule):
+    """NDS103: a perf-counter delta inside a function that references
+    jax but never calls block_until_ready/device_get — with async
+    dispatch the bracket closes before the device work does."""
+
+    id = "NDS103"
+    name = "unsynced-timing"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+    _JAX = {"jax", "jnp", "lax", "jitted", "shard_map"}
+    _SYNC = {"block_until_ready", "device_get"}
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _walk_funcs(tree):
+            names = _names_in(fn)
+            attrs = _attrs_in(fn)
+            if not (names & self._JAX):
+                continue
+            if (names | attrs) & self._SYNC:
+                continue
+            timer_vars = set()
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)
+                        and isinstance(n.value.func, ast.Attribute)
+                        and n.value.func.attr == "perf_counter"):
+                    timer_vars |= {t.id for t in n.targets
+                                   if isinstance(t, ast.Name)}
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.BinOp)
+                        and isinstance(n.op, ast.Sub)):
+                    continue
+                ends_bracket = any(
+                    (isinstance(x, ast.Name) and x.id in timer_vars)
+                    or (isinstance(x, ast.Call)
+                        and isinstance(x.func, ast.Attribute)
+                        and x.func.attr == "perf_counter")
+                    for x in (n.left, n.right))
+                if ends_bracket and timer_vars:
+                    out.append(LintViolation(
+                        self.id, path, n.lineno,
+                        f"timing bracket in {fn.name}() closes without "
+                        f"block_until_ready/device_get — async "
+                        f"dispatch makes this measure dispatch, not "
+                        f"execution"))
+        return out
+
+
+class PrefixHashRule(Rule):
+    """NDS104: hashing a sliced array prefix (``arr[:n].tobytes()``)
+    as a content fingerprint."""
+
+    id = "NDS104"
+    name = "prefix-hash"
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "tobytes"):
+                continue
+            sliced = any(isinstance(x, ast.Subscript)
+                         and isinstance(x.slice, ast.Slice)
+                         for x in ast.walk(n.func.value))
+            if sliced:
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    "content fingerprint over a sliced prefix: "
+                    "same-shape changes past the slice serve stale "
+                    "cache entries — hash the full buffer"))
+        return out
+
+
+class DeadDataclassFieldRule(Rule):
+    """NDS105: a dataclass field no code ever reads. Reads counted
+    tree-wide: attribute loads, keyword-free getattr-style string
+    constants (``getattr(n, "child")`` walks via string names), so only
+    fields dead under BOTH access styles flag. Needs the whole-tree
+    index built by ``build_read_index``."""
+
+    id = "NDS105"
+    name = "dead-field"
+
+    def __init__(self):
+        self.reads: set = set()
+        self.strings: set = set()
+
+    def build_read_index(self, trees: "list[ast.AST]") -> None:
+        for tree in trees:
+            for n in ast.walk(tree):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Load)):
+                    self.reads.add(n.attr)
+                elif (isinstance(n, ast.Constant)
+                      and isinstance(n.value, str)):
+                    self.strings.add(n.value)
+
+    @staticmethod
+    def _is_dataclass(cls: ast.ClassDef) -> bool:
+        for d in cls.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            name = (target.attr if isinstance(target, ast.Attribute)
+                    else getattr(target, "id", ""))
+            if name == "dataclass":
+                return True
+        return False
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.ClassDef)
+                    and self._is_dataclass(n)):
+                continue
+            for stmt in n.body:
+                if not (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    continue
+                fname = stmt.target.id
+                if fname.startswith("__"):
+                    continue
+                if fname in self.reads or fname in self.strings:
+                    continue
+                out.append(LintViolation(
+                    self.id, path, stmt.lineno,
+                    f"dataclass field {n.name}.{fname} is written but "
+                    f"never read anywhere in the tree"))
+        return out
+
+
+class MutableDefaultRule(Rule):
+    """NDS106: mutable default argument shared across calls."""
+
+    id = "NDS106"
+    name = "mutable-default"
+    _CTORS = {"list", "dict", "set"}
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _walk_funcs(tree):
+            for d in list(fn.args.defaults) + [
+                    x for x in fn.args.kw_defaults if x is not None]:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in self._CTORS)
+                if bad:
+                    out.append(LintViolation(
+                        self.id, path, d.lineno,
+                        f"mutable default argument in {fn.name}()"))
+        return out
+
+
+class BareExceptRule(Rule):
+    """NDS107: ``except:`` swallows SystemExit/KeyboardInterrupt."""
+
+    id = "NDS107"
+    name = "bare-except"
+
+    def check(self, tree, src, path):
+        return [LintViolation(self.id, path, n.lineno,
+                              "bare except: catches SystemExit and "
+                              "KeyboardInterrupt — name the exception")
+                for n in ast.walk(tree)
+                if isinstance(n, ast.ExceptHandler) and n.type is None]
+
+
+def default_rules() -> "list[Rule]":
+    return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
+            PrefixHashRule(), DeadDataclassFieldRule(),
+            MutableDefaultRule(), BareExceptRule()]
+
+
+# -------------------------------------------------------------- driver
+
+@dataclass
+class LintResult:
+    violations: list = field(default_factory=list)  # unwaived, to fix
+    waived: list = field(default_factory=list)      # waived, informational
+    errors: list = field(default_factory=list)      # malformed/unused waivers
+
+
+def lint_sources(sources: "dict[str, str]",
+                 rules: "list[Rule] | None" = None,
+                 enabled: "set[str] | None" = None) -> LintResult:
+    """Lint {path: source}. Rules needing a whole-tree read index (dead
+    fields) see every file; violations and waiver bookkeeping are
+    per-file. ``enabled`` filters by rule id (None = all)."""
+    rules = default_rules() if rules is None else rules
+    if enabled is not None:
+        rules = [r for r in rules if r.id in enabled]
+    res = LintResult()
+    trees: dict[str, ast.AST] = {}
+    for path, src in sorted(sources.items()):
+        try:
+            trees[path] = ast.parse(src)
+        except SyntaxError as exc:
+            res.errors.append(LintViolation(
+                "NDS000", path, exc.lineno or 0,
+                f"syntax error: {exc.msg}"))
+    for r in rules:
+        if isinstance(r, DeadDataclassFieldRule):
+            r.build_read_index(list(trees.values()))
+    for path, tree in trees.items():
+        src = sources[path]
+        waivers, werrs = parse_waivers(src)
+        for w in werrs:
+            w.path = path
+            res.errors.append(w)
+        for r in rules:
+            if not r.applies(path):
+                continue
+            for v in r.check(tree, src, path):
+                w = waivers.get(v.line)
+                if w is not None and v.rule in w.rules:
+                    w.used = True
+                    v.waived = True
+                    v.waiver_note = w.note
+                    res.waived.append(v)
+                else:
+                    res.violations.append(v)
+        for w in waivers.values():
+            if not w.used:
+                res.errors.append(LintViolation(
+                    "NDS100", path, w.line,
+                    f"waiver for {','.join(w.rules)} matches no "
+                    f"violation — stale, remove it"))
+    return res

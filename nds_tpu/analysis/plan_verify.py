@@ -1,0 +1,525 @@
+"""Plan verifier: structural invariants every executor assumes.
+
+The executors (cpu_exec / device_exec / dist_exec) walk planned trees
+and address columns as ``(binding, name)`` pairs in a runtime context;
+nothing re-checks at execution time that those addresses exist, that
+dtypes propagated consistently, or that join keys agree across sides —
+a planner bug surfaces as a KeyError deep inside a compiled program (or
+worse, as silently wrong rows). This module proves those invariants
+right after planning, the typed-plan validation discipline the tensor-
+runtime lowering papers rely on (PAPERS.md: Query Processing on Tensor
+Computation Runtimes; Flare's staged compilation checks).
+
+Checked per node (namespaces mirror each ``_run_*``'s context
+construction in cpu_exec / the DCtx construction in device_exec):
+
+- every ``ColRef`` resolves in the namespace of the child it is
+  evaluated against, with the dtype recorded there;
+- expression dtypes are consistent: ``Arith`` matches
+  ``ir.arith_type``, aggregate specs match ``ir.agg_type``, predicates
+  are BOOL;
+- join / set-op key dtypes agree across sides (joinable, not merely
+  present);
+- ``AggRef`` / ``WindowRef`` / ``GroupingRef`` never survive planning
+  (the planner remaps them onto concrete columns; one escaping — or
+  carrying an out-of-range index — would crash or misbind at runtime);
+- ``ScalarRef.plan_id`` indexes a real scalar subplan;
+- Sort / Limit / Distinct binding invariants (passthrough output stays
+  addressable, limit count non-negative);
+- ``StagedScan`` integrity: mangled columns bijective with the backing
+  temp-table scan, and (when an executor's table registry is supplied)
+  the temp is actually registered;
+- exchange slack / partition-capacity consistency for the distributed
+  path (``check_exchange_invariants``).
+
+Gate: ``NDS_TPU_VERIFY_PLANS=1`` turns verification on inside
+``Session.plan`` and the device executors; tests force it on
+(tests/conftest.py). ``tools/ndsverify.py`` runs it over every NDS /
+NDS-H statement with no accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from nds_tpu.engine.types import (
+    BOOL, BoolType, DateType, DecimalType, DType, FloatType, IntType,
+    StringType,
+)
+from nds_tpu.sql import ir
+from nds_tpu.sql import plan as P
+
+ENV_FLAG = "NDS_TPU_VERIFY_PLANS"
+
+
+def verify_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "0") not in ("", "0")
+
+
+@dataclass
+class Violation:
+    rule: str       # short stable id, e.g. "colref-unresolved"
+    node: str       # plan-node type the violation anchors to
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.rule}] {self.node}: {self.detail}"
+
+
+class PlanVerifyError(ValueError):
+    def __init__(self, violations: list[Violation], label: str = ""):
+        self.violations = violations
+        head = f"plan verification failed{' for ' + label if label else ''}"
+        super().__init__(
+            "\n  ".join([f"{head} ({len(violations)} violation(s)):"]
+                        + [str(v) for v in violations]))
+
+
+# --------------------------------------------------------------- dtypes
+
+def _joinable(lt: DType, rt: DType) -> bool:
+    """Key dtypes that compare correctly on both engines. Integer
+    widths may differ (the executors widen); everything else must match
+    exactly — a decimal-scale or string/int mismatch would compare raw
+    representations and silently drop matches."""
+    if lt is None or rt is None:
+        return False
+    if lt == rt:
+        return True
+    if isinstance(lt, IntType) and isinstance(rt, IntType):
+        return True
+    # epoch-day dates are int32 on device; planner may emit either side
+    # as the raw int (EXTRACT output, d_date + N arithmetic)
+    date_int = (DateType, IntType)
+    if isinstance(lt, date_int) and isinstance(rt, date_int):
+        return True
+    return False
+
+
+def _union_compatible(lt: DType, rt: DType) -> bool:
+    """Branch output dtypes a SetOp may concatenate: exact match, any
+    integer pair, any float pair, or string/string (the cpu engine
+    concatenates decoded values; dictionary codes never cross a union).
+    Decimals must agree on scale — concatenating scaled ints of
+    different scales is a value corruption."""
+    if lt is None or rt is None:
+        return False
+    if lt == rt:
+        return True
+    if isinstance(lt, IntType) and isinstance(rt, IntType):
+        return True
+    if isinstance(lt, FloatType) and isinstance(rt, FloatType):
+        return True
+    if isinstance(lt, StringType) and isinstance(rt, StringType):
+        return True
+    if isinstance(lt, DecimalType) and isinstance(rt, DecimalType):
+        return lt.scale == rt.scale
+    return False
+
+
+# ----------------------------------------------------------- namespaces
+
+def _namespace(node: P.Node, memo: dict) -> dict:
+    """{(binding, name): dtype} the node's runtime context exposes to
+    its parent — mirrors cpu_exec's Context keys per node type (and
+    staging._exposed, which encodes the same contract for cuts)."""
+    nid = id(node)
+    if nid in memo:
+        return memo[nid]
+    # ndslint: waive[NDS101] -- memo lives for one verify() pass; the plan pins nodes
+    memo[nid] = {}  # cycle guard; real value set below
+    if isinstance(node, P.Scan):
+        ns = {(node.binding, n): dt for n, dt in node.output}
+    elif isinstance(node, P.DerivedScan):
+        ns = {(node.binding, n): dt for n, dt in node.child.output}
+    elif isinstance(node, P.StagedScan):
+        ns = {(b, n): dt for b, n, _m, dt in node.cols}
+    elif isinstance(node, P.Project):
+        ns = {(node.binding, n): e.dtype for n, e in node.exprs}
+    elif isinstance(node, P.Aggregate):
+        ns = {(node.binding, n): dt for n, dt in node.output}
+    elif isinstance(node, P.Join):
+        ns = dict(_namespace(node.left, memo))
+        ns.update(_namespace(node.right, memo))
+    elif isinstance(node, P.SemiJoin):
+        ns = dict(_namespace(node.left, memo))
+    elif isinstance(node, P.Window):
+        ns = dict(_namespace(node.child, memo))
+        ns.update({(node.binding, n): s.dtype for n, s in node.specs})
+    elif isinstance(node, P.SetOp):
+        if node.kind.startswith("union"):
+            # _run_setop materializes ONLY the left output columns
+            # under the left binding; sibling columns do not survive
+            lb = node.left.binding
+            ns = {(lb, n): dt for n, dt in node.left.output}
+        else:  # intersect/except keep the left context wholesale
+            ns = dict(_namespace(node.left, memo))
+    elif isinstance(node, (P.Filter, P.Sort, P.Limit, P.Distinct)):
+        ns = dict(_namespace(node.child, memo))
+    else:
+        ns = {}
+    # ndslint: waive[NDS101] -- memo lives for one verify() pass; the plan pins nodes
+    memo[nid] = ns
+    return ns
+
+
+# ---------------------------------------------------------- expressions
+
+_PREDICATE_IRS = (ir.Cmp, ir.BoolOp, ir.Not, ir.LikeIR, ir.InListIR,
+                  ir.IsNullIR)
+
+
+class _Verifier:
+    def __init__(self, planned: P.PlannedQuery,
+                 tables: "dict | None" = None,
+                 catalog=None):
+        self.planned = planned
+        self.tables = tables
+        self.catalog = catalog
+        self.out: list[Violation] = []
+        self.ns_memo: dict = {}
+
+    def fail(self, rule: str, node, detail: str) -> None:
+        name = type(node).__name__ if isinstance(node, (P.Node, ir.IR)) \
+            else str(node)
+        self.out.append(Violation(rule, name, detail))
+
+    # ------------------------------------------------- expression checks
+
+    def check_expr(self, e: ir.IR, ns: dict, node: P.Node) -> None:
+        for x in ir.walk(e):
+            if isinstance(x, ir.ColRef):
+                key = (x.binding, x.name)
+                if key not in ns:
+                    self.fail("colref-unresolved", node,
+                              f"{x!r} not in the evaluation namespace "
+                              f"(bindings in scope: "
+                              f"{sorted({b for b, _ in ns})})")
+                elif x.dtype is None:
+                    self.fail("colref-untyped", node, f"{x!r} has no dtype")
+                elif x.dtype != ns[key]:
+                    self.fail("colref-dtype", node,
+                              f"{x!r} typed {x.dtype} but the child "
+                              f"exposes {ns[key]}")
+            elif isinstance(x, (ir.AggRef, ir.WindowRef, ir.GroupingRef)):
+                # the planner remaps every one of these onto concrete
+                # columns; any survivor (in-range or not) would misbind
+                idx = getattr(x, "index", getattr(x, "key_index", None))
+                self.fail("ref-unresolved", node,
+                          f"unresolved {type(x).__name__}(#{idx}) "
+                          f"escaped planning")
+            elif isinstance(x, ir.ScalarRef):
+                nsub = len(self.planned.scalar_subplans)
+                if not (0 <= x.plan_id < nsub):
+                    self.fail("scalarref-range", node,
+                              f"scalar#{x.plan_id} out of range "
+                              f"({nsub} subplan(s))")
+            elif isinstance(x, ir.Arith):
+                lt, rt = x.left.dtype, x.right.dtype
+                if lt is None or rt is None:
+                    self.fail("arith-untyped", node,
+                              f"{x.op} operand missing dtype")
+                else:
+                    try:
+                        want = ir.arith_type(x.op, lt, rt)
+                    except TypeError as exc:
+                        self.fail("arith-illegal", node, str(exc))
+                        continue
+                    if x.dtype != want:
+                        self.fail("arith-dtype", node,
+                                  f"{lt} {x.op} {rt} must produce "
+                                  f"{want}, plan says {x.dtype}")
+            elif isinstance(x, _PREDICATE_IRS):
+                if not isinstance(x.dtype, BoolType):
+                    self.fail("predicate-dtype", node,
+                              f"{type(x).__name__} typed {x.dtype}, "
+                              f"not bool")
+            elif isinstance(x, (ir.Neg, ir.CastIR, ir.CaseIR, ir.Lit,
+                                ir.SubstrIR, ir.StrMapIR, ir.ConcatIR,
+                                ir.ExtractIR)):
+                if x.dtype is None:
+                    self.fail("expr-untyped", node,
+                              f"{type(x).__name__} has no dtype")
+
+    # ------------------------------------------------------- node checks
+
+    def check_node(self, node: P.Node) -> None:
+        m = getattr(self, "_check_" + type(node).__name__.lower(), None)
+        if m is not None:
+            m(node)
+
+    def _check_scan(self, node: P.Scan) -> None:
+        ns = _namespace(node, self.ns_memo)
+        for f in node.filters:
+            self.check_expr(f, ns, node)
+            if f.dtype is not None and not isinstance(f.dtype, BoolType):
+                self.fail("filter-dtype", node,
+                          f"pushed-down filter typed {f.dtype}, not bool")
+        schema = None
+        if self.tables is not None:
+            t = self.tables.get(node.table)
+            if t is None:
+                # at execution time EVERY scan must resolve in the
+                # registry — this would otherwise die as a KeyError
+                # inside buffer collection
+                self.fail("scan-unregistered", node,
+                          f"table {node.table!r} not in the executor "
+                          f"registry")
+                return
+            schema = getattr(t, "schema", None)
+        elif self.catalog is not None:
+            if not self.catalog.has_table(node.table):
+                self.fail("scan-unknown-table", node,
+                          f"table {node.table!r} not in catalog")
+                return
+            schema = self.catalog.schemas[node.table]
+        if schema is not None:
+            for n, dt in node.output:
+                if n not in schema:
+                    self.fail("scan-unknown-column", node,
+                              f"{node.table}.{n} not in schema")
+                elif schema.field(n).dtype != dt:
+                    self.fail("scan-column-dtype", node,
+                              f"{node.table}.{n} is "
+                              f"{schema.field(n).dtype} in the schema, "
+                              f"{dt} in the plan")
+
+    def _check_stagedscan(self, node: P.StagedScan) -> None:
+        if not isinstance(node.child, P.Scan):
+            self.fail("staged-child", node,
+                      f"child is {type(node.child).__name__}, not a "
+                      f"temp-table Scan")
+            return
+        child_cols = dict(node.child.output)
+        mangled = [m for _b, _n, m, _dt in node.cols]
+        if sorted(mangled) != sorted(child_cols):
+            self.fail("staged-mangle", node,
+                      f"cols mapping {sorted(mangled)} is not a "
+                      f"bijection with the temp scan's "
+                      f"{sorted(child_cols)}")
+        else:
+            for _b, n, m, dt in node.cols:
+                if child_cols[m] != dt:
+                    self.fail("staged-dtype", node,
+                              f"{m} staged as {child_cols[m]} but "
+                              f"re-exposed as {dt} ({n})")
+        if self.tables is not None and node.child.table not in self.tables:
+            self.fail("staged-unregistered", node,
+                      f"temp table {node.child.table!r} is not "
+                      f"registered with the executor")
+
+    def _check_filter(self, node: P.Filter) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        self.check_expr(node.predicate, ns, node)
+        if (node.predicate.dtype is not None
+                and not isinstance(node.predicate.dtype, BoolType)):
+            self.fail("filter-dtype", node,
+                      f"predicate typed {node.predicate.dtype}, not bool")
+
+    def _check_project(self, node: P.Project) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        seen = set()
+        for n, e in node.exprs:
+            if n in seen:
+                self.fail("project-dup", node,
+                          f"duplicate output column {n!r}")
+            seen.add(n)
+            self.check_expr(e, ns, node)
+
+    def _check_join_like(self, node) -> None:
+        lns = _namespace(node.left, self.ns_memo)
+        rns = _namespace(node.right, self.ns_memo)
+        if len(node.left_keys) != len(node.right_keys):
+            self.fail("join-key-arity", node,
+                      f"{len(node.left_keys)} left vs "
+                      f"{len(node.right_keys)} right keys")
+        for k in node.left_keys:
+            self.check_expr(k, lns, node)
+        for k in node.right_keys:
+            self.check_expr(k, rns, node)
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            if not _joinable(lk.dtype, rk.dtype):
+                self.fail("join-key-dtype", node,
+                          f"key pair {lk!r}:{lk.dtype} vs "
+                          f"{rk!r}:{rk.dtype} is not joinable")
+        if node.residual is not None:
+            both = dict(lns)
+            both.update(rns)
+            self.check_expr(node.residual, both, node)
+            if (node.residual.dtype is not None
+                    and not isinstance(node.residual.dtype, BoolType)):
+                self.fail("residual-dtype", node,
+                          f"residual typed {node.residual.dtype}")
+
+    def _check_join(self, node: P.Join) -> None:
+        self._check_join_like(node)
+        if node.kind not in ("inner", "left", "full"):
+            self.fail("join-kind", node, f"unknown kind {node.kind!r}")
+
+    def _check_semijoin(self, node: P.SemiJoin) -> None:
+        self._check_join_like(node)
+
+    def _check_aggregate(self, node: P.Aggregate) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        for _n, e in node.group_keys:
+            self.check_expr(e, ns, node)
+        for n, spec in node.aggs:
+            if spec.arg is not None:
+                self.check_expr(spec.arg, ns, node)
+            arg_t = spec.arg.dtype if spec.arg is not None else None
+            try:
+                want = ir.agg_type(spec.func, arg_t)
+            except TypeError as exc:
+                self.fail("agg-illegal", node, f"{n}: {exc}")
+                continue
+            if spec.dtype != want:
+                self.fail("agg-dtype", node,
+                          f"{spec.func}({arg_t}) must produce {want}, "
+                          f"plan says {spec.dtype} for {n!r}")
+
+    def _check_window(self, node: P.Window) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        for n, s in node.specs:
+            if s.dtype is None:
+                self.fail("window-untyped", node, f"{n} has no dtype")
+            if s.arg is not None:
+                self.check_expr(s.arg, ns, node)
+            for p in s.partition:
+                self.check_expr(p, ns, node)
+            for e, _asc, _nf in s.order:
+                self.check_expr(e, ns, node)
+
+    def _check_sort(self, node: P.Sort) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        for e, asc, nf in node.keys:
+            self.check_expr(e, ns, node)
+            # nulls_first is Optional: None = SQL default (nulls last),
+            # the encoding both engines' sort paths treat as falsy
+            if not isinstance(asc, bool) or not isinstance(nf,
+                                                           (bool,
+                                                            type(None))):
+                self.fail("sort-flags", node,
+                          f"non-bool sort flags ({asc!r}, {nf!r})")
+
+    def _check_limit(self, node: P.Limit) -> None:
+        if not isinstance(node.count, int) or node.count < 0:
+            self.fail("limit-count", node,
+                      f"count {node.count!r} is not a non-negative int")
+
+    def _check_distinct(self, node: P.Distinct) -> None:
+        ns = _namespace(node.child, self.ns_memo)
+        for n, _dt in node.output:
+            if (node.binding, n) not in ns:
+                self.fail("distinct-binding", node,
+                          f"output column ({node.binding!r}, {n!r}) not "
+                          f"addressable in the child context")
+
+    def _check_setop(self, node: P.SetOp) -> None:
+        kinds = ("union", "union all", "intersect", "except")
+        if node.kind not in kinds:
+            self.fail("setop-kind", node, f"unknown kind {node.kind!r}")
+        lo, ro = node.left.output, node.right.output
+        if len(lo) != len(ro):
+            self.fail("setop-arity", node,
+                      f"{len(lo)} vs {len(ro)} output columns")
+            return
+        for (ln, lt), (rn, rt) in zip(lo, ro):
+            if not _union_compatible(lt, rt):
+                self.fail("setop-dtype", node,
+                          f"column pair {ln!r}:{lt} vs {rn!r}:{rt} "
+                          f"cannot combine")
+
+    # ------------------------------------------------------------ driver
+
+    def run(self) -> list[Violation]:
+        planned = self.planned
+        roots = [("root", planned.root)]
+        for i, sub in enumerate(planned.scalar_subplans):
+            roots.append((f"scalar#{i}", sub))
+            if not isinstance(sub, P.Node):
+                self.fail("subplan-type", sub,
+                          f"scalar subplan #{i} is not a plan Node")
+                continue
+            if len(sub.output) != 1:
+                self.fail("subplan-arity", sub,
+                          f"scalar subplan #{i} produces "
+                          f"{len(sub.output)} columns, not 1")
+        if planned.column_names and len(planned.column_names) != len(
+                planned.root.output):
+            self.fail("result-arity", planned.root,
+                      f"{len(planned.column_names)} result names for "
+                      f"{len(planned.root.output)} output columns")
+        # the session/driver reads the root's output through its binding
+        root_ns = _namespace(planned.root, self.ns_memo)
+        for n, _dt in planned.root.output:
+            if (planned.root.binding, n) not in root_ns:
+                self.fail("root-binding", planned.root,
+                          f"result column ({planned.root.binding!r}, "
+                          f"{n!r}) not addressable at the root")
+        seen: set = set()
+        for _label, root in roots:
+            if not isinstance(root, P.Node):
+                continue
+            for node in P.walk_plan(root):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                self.check_node(node)
+        return self.out
+
+
+# -------------------------------------------------------------- frontend
+
+def verify(planned: P.PlannedQuery, tables: "dict | None" = None,
+           catalog=None) -> list[Violation]:
+    """All invariant violations in one planned statement ([] = valid).
+
+    ``tables`` (an executor's name -> HostTable registry) additionally
+    proves Scan columns against real schemas and StagedScan temps
+    against registration; ``catalog`` (planner CatalogInfo) does the
+    schema half when no executor exists yet."""
+    if not isinstance(planned, P.PlannedQuery):
+        return [Violation("not-a-plan", type(planned).__name__,
+                          "verify() expects a PlannedQuery")]
+    return _Verifier(planned, tables, catalog).run()
+
+
+def assert_valid(planned: P.PlannedQuery, tables: "dict | None" = None,
+                 catalog=None, label: str = "") -> P.PlannedQuery:
+    """verify() that raises PlanVerifyError on any violation; returns
+    the plan unchanged so call sites can wrap in-line."""
+    violations = verify(planned, tables, catalog)
+    if violations:
+        raise PlanVerifyError(violations, label)
+    return planned
+
+
+def check_exchange_invariants(n_rows: int, n_dev: int,
+                              slack: float) -> list[Violation]:
+    """Distributed-path consistency: the static-shape exchange contract
+    (parallel/exchange.py) only holds when every device's per-peer
+    bucket of ceil(n * slack / n_dev) rows gives total capacity >= the
+    rows actually present. slack < 1 breaks that bound even with a
+    perfectly uniform hash; non-positive mesh sizes are configuration
+    corruption."""
+    out: list[Violation] = []
+    if n_dev < 1:
+        out.append(Violation("exchange-mesh", "exchange",
+                             f"n_dev={n_dev} must be >= 1"))
+    if slack < 1.0:
+        out.append(Violation("exchange-slack", "exchange",
+                             f"slack={slack} < 1.0 cannot cover even a "
+                             f"uniform partition"))
+    if n_rows < 0:
+        out.append(Violation("exchange-rows", "exchange",
+                             f"negative row count {n_rows}"))
+    if out:
+        return out
+    bucket = max(1, -(-int(n_rows * slack) // n_dev))
+    if n_rows and bucket * n_dev < n_rows:
+        out.append(Violation(
+            "exchange-capacity", "exchange",
+            f"bucket {bucket} x {n_dev} devices = {bucket * n_dev} "
+            f"slots < {n_rows} rows"))
+    return out

@@ -113,7 +113,7 @@ class _MergeTrace(dx._Trace):
     def run(self, node: P.Node) -> DCtx:
         rep = getattr(self.ex, "_replace", None)
         if rep and id(node) in rep and id(node) not in self._cache:
-            self._cache[id(node)] = self._merged_ctx(*rep[id(node)])
+            self.stash(node, self._merged_ctx(*rep[id(node)]))
         return super().run(node)
 
     def _merged_ctx(self, merge_node: P.Aggregate,

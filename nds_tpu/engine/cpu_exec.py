@@ -164,6 +164,7 @@ class CpuExecutor:
             return self._node_cache[nid]
         method = getattr(self, "_run_" + type(node).__name__.lower())
         ctx = method(node)
+        # ndslint: waive[NDS101] -- cleared at execute() entry; the running plan pins nodes
         self._node_cache[nid] = ctx
         return ctx
 
@@ -347,10 +348,15 @@ class CpuExecutor:
                 keyframes[f"k{i}n"] = ~v
             keyframes[f"k{i}"] = col
         df = pd.DataFrame(keyframes)
-        codes, uniques = pd.factorize(
-            pd.MultiIndex.from_frame(df) if len(df.columns) > 1
-            else df.iloc[:, 0], sort=False)
-        ngroups = len(uniques)
+        if len(df) == 0:
+            # this pandas raises on MultiIndex.from_frame of an empty
+            # frame; an empty input groups to zero groups either way
+            codes, ngroups = np.zeros(0, dtype=np.int64), 0
+        else:
+            codes, uniques = pd.factorize(
+                pd.MultiIndex.from_frame(df) if len(df.columns) > 1
+                else df.iloc[:, 0], sort=False)
+            ngroups = len(uniques)
         out = Context(ngroups)
         # representative (first-occurrence) row per group for key values
         rev = np.arange(len(codes))[::-1]
@@ -484,9 +490,13 @@ class CpuExecutor:
                     col = np.where(v, col, col[0] if len(col) else 0)
                 frames[f"p{i}"] = col
             pdf = pd.DataFrame(frames)
-            codes, _ = pd.factorize(
-                pd.MultiIndex.from_frame(pdf) if len(pdf.columns) > 1
-                else pdf.iloc[:, 0], sort=False)
+            if len(pdf) == 0:
+                # MultiIndex.from_frame raises on empty frames here
+                codes = np.zeros(0, dtype=np.int64)
+            else:
+                codes, _ = pd.factorize(
+                    pd.MultiIndex.from_frame(pdf) if len(pdf.columns) > 1
+                    else pdf.iloc[:, 0], sort=False)
         else:
             codes = np.zeros(n, dtype=np.int64)
         # sorted space: partition-major, order-minor (stable); NULL order

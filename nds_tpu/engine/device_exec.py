@@ -407,7 +407,13 @@ class DeviceExecutor:
             arr = np.ascontiguousarray(col.values)
             h.update(name.encode())
             h.update(str(arr.shape).encode())
-            h.update(arr[: 1 << 14].tobytes())
+            # FULL content, not a prefix: a same-shape sub-result whose
+            # change lies past any prefix must invalidate the staged
+            # buffers (ADVICE r5); hashing is linear and cheap next to
+            # the sub-program that produced the rows. The contiguous
+            # array feeds hashlib via the buffer protocol — no bytes
+            # copy of a possibly-GB column
+            h.update(arr)
         fp = h.hexdigest()
         if self._stage_fps.get(temp) == fp:
             return
@@ -578,6 +584,14 @@ class DeviceExecutor:
         import time as _time
         with tracer.attach(qspan):
             planned = self._staged_effective(planned, key)
+            from nds_tpu.analysis import plan_verify
+            if plan_verify.verify_enabled():
+                # post-staging verification: _staged_effective has run
+                # and registered every sub-program temp, so the staged
+                # main plan's StagedScan nodes must now resolve against
+                # this executor's table registry
+                plan_verify.assert_valid(planned, tables=self.tables,
+                                         label="staged plan")
             timings = {"compile_ms": 0.0}
             self.last_timings = timings
             # the cache entry holds a strong ref to the plan: id()-keyed
@@ -588,6 +602,7 @@ class DeviceExecutor:
             entry = self._compiled.setdefault(
                 key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
             if "compiled" not in entry:
+                # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
                 t0 = _time.perf_counter()
                 with tracer.span("device.compile", slack=entry["slack"]):
                     jitted, side = self._compile(planned, entry["slack"])
@@ -597,6 +612,7 @@ class DeviceExecutor:
                     entry["compiled"] = jitted.lower(bufs).compile()
                 entry["side"] = side
                 timings["compile_ms"] += (
+                    # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; the execute bracket closes via device_get in _finish_traced
                     _time.perf_counter() - t0) * 1000
                 # overflow retries recompile the SAME query: count them
                 # apart from first compiles (distributed executor
@@ -614,6 +630,7 @@ class DeviceExecutor:
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
+            # ndslint: waive[NDS102] -- execute bracket opens here; _finish_traced closes it after device_get
             t1 = _time.perf_counter()
             row, outs, overflow = entry["compiled"](bufs)
         return _AsyncResult(self, planned, key, entry, timings, t1,
@@ -648,12 +665,14 @@ class DeviceExecutor:
                 outs2 = [(jnp.take(a, perm, axis=0),
                           jnp.take(v, perm, axis=0)) for a, v in outs]
                 return cnt, jnp.take(row, perm), outs2
+            # ndslint: waive[NDS102] -- compactor compile bracket (attributed to compile_ms)
             t0 = _time.perf_counter()
             avatars = (jax.ShapeDtypeStruct(row_d.shape, row_d.dtype),
                        [(jax.ShapeDtypeStruct(a.shape, a.dtype),
                          jax.ShapeDtypeStruct(v.shape, v.dtype))
                         for a, v in outs_d])
             cf = jax.jit(fn).lower(*avatars).compile()
+            # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; no device work is in flight here
             dt = (_time.perf_counter() - t0) * 1000
             timings["compile_ms"] = timings.get("compile_ms", 0.0) + dt
             timings["__compact_compile_ms"] = dt
@@ -721,6 +740,7 @@ class DeviceExecutor:
                 row_h = outs_h = None
         else:
             row_h, outs_h, overflow_h = jax.device_get(devs)
+        # ndslint: waive[NDS102] -- bracket endpoint after device_get; becomes the device.run span via begin(t0=t1).end(t=t2)
         t2 = _time.perf_counter()
         if int(overflow_h) == 0:
             # the execute bracket closed at t2 (device_get blocks until
@@ -729,6 +749,7 @@ class DeviceExecutor:
             with tracer.attach(span), tracer.span("device.materialize"):
                 out = self._materialize(planned, row_h, outs_h,
                                         entry["side"])
+            # ndslint: waive[NDS102] -- host materialize endpoint; the device.materialize span brackets the same region
             t3 = _time.perf_counter()
             timings["execute_ms"] = (t2 - t1) * 1000
             timings["materialize_ms"] = (t3 - t2) * 1000
@@ -1054,12 +1075,20 @@ class _Trace:
 
     # ----------------------------------------------------------- plan nodes
 
+    def stash(self, node: P.Node, ctx: DCtx) -> None:
+        """The trace's one node-result cache write point. id()-keying
+        is sound here (and only here): the cache dies with this trace,
+        and the traced PlannedQuery pins every node for that whole
+        lifetime — no address can recycle while its entry is live."""
+        # ndslint: waive[NDS101] -- trace-scoped; the traced plan pins its nodes
+        self._cache[id(node)] = ctx
+
     def run(self, node: P.Node) -> DCtx:
         nid = id(node)
         if nid in self._cache:
             return self._cache[nid]
         ctx = getattr(self, "_run_" + type(node).__name__.lower())(node)
-        self._cache[nid] = ctx
+        self.stash(node, ctx)
         return ctx
 
     def _run_scan(self, node: P.Scan) -> DCtx:

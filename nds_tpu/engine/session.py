@@ -82,7 +82,17 @@ class Session:
 
     def plan_ast(self, stmt):
         planner = Planner(self.catalog, self.views)
-        return planner.plan_statement(stmt)
+        planned = planner.plan_statement(stmt)
+        from nds_tpu.analysis import plan_verify
+        if plan_verify.verify_enabled():
+            # NDS_TPU_VERIFY_PLANS=1 (always on in tests): reject a
+            # structurally invalid plan here, where the statement text
+            # is known, instead of as a KeyError inside an executor
+            target = planned[2] if isinstance(planned, tuple) else planned
+            if isinstance(target, P.PlannedQuery):
+                plan_verify.assert_valid(target, catalog=self.catalog,
+                                         label=type(stmt).__name__)
+        return planned
 
     def _views_signature(self) -> frozenset:
         return frozenset(self._view_sql.items())

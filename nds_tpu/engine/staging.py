@@ -229,6 +229,7 @@ def _replace(node: P.Node, cut: P.Node, repl: P.Node, memo: dict):
             if r is not c:
                 changed[attr] = r
     out = dc_replace(node, **changed) if changed else node
+    # ndslint: waive[NDS101] -- memo lives for one _replace() pass over a live plan
     memo[nid] = out
     return out
 

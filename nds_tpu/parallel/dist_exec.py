@@ -106,6 +106,15 @@ class DistributedExecutor(dx.DeviceExecutor):
         self._explicit_shard = shard_tables
         self.shard_threshold = shard_threshold
         self.slack = slack
+        from nds_tpu.analysis import plan_verify
+        if plan_verify.verify_enabled():
+            # exchange static-shape contract: a slack below 1.0 or a
+            # degenerate mesh makes every all_to_all bucket undersized
+            vs = plan_verify.check_exchange_invariants(
+                max(t.nrows for t in tables.values()) if tables else 0,
+                self.n_dev, self.slack)
+            if vs:
+                raise plan_verify.PlanVerifyError(vs, "DistributedExecutor")
 
     def _is_sharded(self, table: str) -> bool:
         if self._explicit_shard is not None:
@@ -289,6 +298,7 @@ class DistributedExecutor(dx.DeviceExecutor):
                 state.pop("jitted", None)
                 import gc
                 gc.collect()
+                # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
                 t0 = _time.perf_counter()
                 with tracer.span("device.compile", slack=slack):
                     jitted, state["sk"], state["rk"] = build(slack)
@@ -301,6 +311,7 @@ class DistributedExecutor(dx.DeviceExecutor):
                         {k: bufs[k] for k in state["rk"]}).compile()
                 state["slack"] = slack
                 timings["compile_ms"] += (
+                    # ndslint: waive[NDS102] -- .compile() is synchronous; bracket ends when it returns
                     _time.perf_counter() - t0) * 1000
                 obs_metrics.counter(
                     "compiles_total" if attempt == 0
@@ -313,17 +324,20 @@ class DistributedExecutor(dx.DeviceExecutor):
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
+            # ndslint: waive[NDS102] -- execute bracket start; closed below after device_get
             t1 = _time.perf_counter()
             row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
             # one batched device->host round trip (see DeviceExecutor)
             row_h, outs_h, overflow_h = jax.device_get(
                 (row, outs, overflow))
+            # ndslint: waive[NDS102] -- bracket endpoint after device_get; becomes the device.run span
             t2 = _time.perf_counter()
             if int(overflow_h) == 0:
                 tracer.begin("device.run", t0=t1).end(t=t2)
                 with tracer.span("device.materialize"):
                     out = self._materialize(planned, row_h, outs_h,
                                             side)
+                # ndslint: waive[NDS102] -- host materialize endpoint bracketed by the device.materialize span
                 t3 = _time.perf_counter()
                 timings["execute_ms"] = (t2 - t1) * 1000
                 timings["materialize_ms"] = (t3 - t2) * 1000
@@ -556,16 +570,16 @@ class _DistTrace(dx._Trace):
 
     def _join_cached(self, node, lctx, rctx):
         """Run the single-device join logic on prepared child contexts."""
-        self._cache[id(node.left)] = lctx
-        self._cache[id(node.right)] = rctx
+        self.stash(node.left, lctx)
+        self.stash(node.right, rctx)
         self._cache.pop(id(node), None)
         return super()._run_join(node)
 
     def _cross_replicated(self, node, lctx, rctx, ls, rs):
         lctx = self._replicate(lctx) if ls else lctx
         rctx = self._replicate(rctx) if rs else rctx
-        self._cache[id(node.left)] = lctx
-        self._cache[id(node.right)] = rctx
+        self.stash(node.left, lctx)
+        self.stash(node.right, rctx)
         out = self._cross_join(node, lctx, rctx)
         out.sharded = False
         return out
@@ -575,8 +589,8 @@ class _DistTrace(dx._Trace):
         ls = getattr(lctx, "sharded", False)
         if getattr(rctx, "sharded", False):
             rctx = self._replicate(rctx)
-        self._cache[id(node.left)] = lctx
-        self._cache[id(node.right)] = rctx
+        self.stash(node.left, lctx)
+        self.stash(node.right, rctx)
         self._cache.pop(id(node), None)
         out = super()._run_semijoin(node)
         out.sharded = ls
@@ -595,7 +609,7 @@ class _DistTrace(dx._Trace):
         try:
             key, kok = self._key_of(ctx, [e for _, e in node.group_keys])
         except DeviceExecError:
-            self._cache[id(node.child)] = self._replicate(ctx)
+            self.stash(node.child, self._replicate(ctx))
             self._cache.pop(id(node), None)
             out = super()._run_aggregate(node)
             out.sharded = False
@@ -604,7 +618,7 @@ class _DistTrace(dx._Trace):
         # still form their own (local) group only if all-null; TPC group
         # keys are non-null so route by key, keep row presence as-is
         new, _ = self._exchange_ctx(ctx, key, ctx.row)
-        self._cache[id(node.child)] = new
+        self.stash(node.child, new)
         self._cache.pop(id(node), None)
         out = super()._run_aggregate(node)
         out.sharded = True
@@ -613,7 +627,7 @@ class _DistTrace(dx._Trace):
     def _global_agg_sharded(self, node: P.Aggregate, ctx: DCtx) -> DCtx:
         b = node.binding
         if any(spec.distinct for _, spec in node.aggs):
-            self._cache[id(node.child)] = self._replicate(ctx)
+            self.stash(node.child, self._replicate(ctx))
             self._cache.pop(id(node), None)
             out = super()._run_aggregate(node)
             out.sharded = False
@@ -665,7 +679,7 @@ class _DistTrace(dx._Trace):
     def _run_sort(self, node: P.Sort) -> DCtx:
         child = self.run(node.child)
         if getattr(child, "sharded", False):
-            self._cache[id(node.child)] = self._replicate(child)
+            self.stash(node.child, self._replicate(child))
             self._cache.pop(id(node), None)
         out = super()._run_sort(node)
         out.sharded = False
@@ -674,7 +688,7 @@ class _DistTrace(dx._Trace):
     def _run_limit(self, node: P.Limit) -> DCtx:
         child = self.run(node.child)
         if getattr(child, "sharded", False):
-            self._cache[id(node.child)] = self._replicate(child)
+            self.stash(node.child, self._replicate(child))
             self._cache.pop(id(node), None)
         out = super()._run_limit(node)
         out.sharded = False
@@ -683,7 +697,7 @@ class _DistTrace(dx._Trace):
     def _run_distinct(self, node: P.Distinct) -> DCtx:
         child = self.run(node.child)
         if getattr(child, "sharded", False):
-            self._cache[id(node.child)] = self._replicate(child)
+            self.stash(node.child, self._replicate(child))
             self._cache.pop(id(node), None)
         out = super()._run_distinct(node)
         out.sharded = False
@@ -693,7 +707,7 @@ class _DistTrace(dx._Trace):
         for side in (node.left, node.right):
             c = self.run(side)
             if getattr(c, "sharded", False):
-                self._cache[id(side)] = self._replicate(c)
+                self.stash(side, self._replicate(c))
         self._cache.pop(id(node), None)
         out = super()._run_setop(node)
         out.sharded = False
@@ -704,7 +718,7 @@ class _DistTrace(dx._Trace):
         # (an exchange-by-partition-key path can land later)
         child = self.run(node.child)
         if getattr(child, "sharded", False):
-            self._cache[id(node.child)] = self._replicate(child)
+            self.stash(node.child, self._replicate(child))
             self._cache.pop(id(node), None)
         out = super()._run_window(node)
         out.sharded = False
@@ -713,7 +727,7 @@ class _DistTrace(dx._Trace):
     def run_query(self, planned: P.PlannedQuery):
         for i, sub in enumerate(planned.scalar_subplans):
             ctx = self._replicate(self.run(sub))
-            self._cache[id(sub)] = ctx
+            self.stash(sub, ctx)
             name, dt = sub.output[0]
             dv = ctx.cols[(sub.binding, name)]
             pos = jnp.argmax(ctx.row)

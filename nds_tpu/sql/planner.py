@@ -42,6 +42,29 @@ WINDOW_RANK_FUNCS = {"rank", "dense_rank", "row_number"}
 _EPOCH = datetime.date(1970, 1, 1)
 
 
+DUP_MARK = "#dup"  # internal suffix disambiguating repeated output names
+
+
+def _dedupe_out_names(pairs: list) -> list:
+    """Projection output names must be unique: executor contexts key
+    columns by (binding, name), so q64's unaliased `cs1.syear ...
+    cs2.syear` select list would silently collapse both outputs onto
+    whichever column lands last. Internal names get a #dup suffix ('#'
+    cannot appear in a SQL identifier); result display names strip it
+    (`_display_name`), keeping the positional ResultTable contract."""
+    seen: dict = {}
+    out = []
+    for n, e in pairs:
+        c = seen.get(n, 0)
+        seen[n] = c + 1
+        out.append((n if c == 0 else f"{n}{DUP_MARK}{c}", e))
+    return out
+
+
+def _display_name(n: str) -> str:
+    return n.split(DUP_MARK)[0]
+
+
 class PlanError(ValueError):
     pass
 
@@ -208,7 +231,7 @@ class Planner:
                     f"INSERT into {stmt.table}: select produces "
                     f"{len(root.output)} columns, table has "
                     f"{len(target.fields)}")
-            names = [n for n, _ in root.output]
+            names = [_display_name(n) for n, _ in root.output]
             return ("insert", stmt.table,
                     P.PlannedQuery(root, self.scalar_subplans, names))
         if isinstance(stmt, ast.Delete):
@@ -216,7 +239,7 @@ class Planner:
                 raise PlanError(f"unknown delete target {stmt.table!r}")
             return ("delete", stmt.table, stmt.where)
         root = self.plan_select(stmt, None, {})
-        names = [n for n, _ in root.output]
+        names = [_display_name(n) for n, _ in root.output]
         return P.PlannedQuery(root, self.scalar_subplans, names)
 
     # ----------------------------------------------------------- helpers
@@ -916,6 +939,7 @@ class Planner:
                 name = it.alias or (e.name if isinstance(e, ir.ColRef)
                                     else f"_c{i}")
                 exprs.append((name, e))
+            exprs = _dedupe_out_names(exprs)
             post: P.Node = node
             if wins:
                 win_node, wremap = self._attach_window(
@@ -951,6 +975,7 @@ class Planner:
             name = it.alias or (e.name if isinstance(e, ir.ColRef)
                                 else f"_c{i}")
             lowered_items.append((name, e))
+        lowered_items = _dedupe_out_names(lowered_items)
         having_ir = None
         if sel.having is not None:
             having_ir, _ = self._lower(sel.having, scope, allow_agg=True,

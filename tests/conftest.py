@@ -14,6 +14,11 @@ import os
 # remote TPU tunnel (axon); tests must run on the local virtual CPU mesh
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# plan verification is ALWAYS on under tests (nds_tpu/analysis): every
+# plan any test produces gets its structural invariants checked at
+# planning time and again post-staging on the device path
+os.environ["NDS_TPU_VERIFY_PLANS"] = "1"
+
 
 def _jaxlib_knows(*flag_names: str) -> bool:
     """True when the installed jaxlib's binaries mention EVERY given

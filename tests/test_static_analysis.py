@@ -1,0 +1,335 @@
+"""Static-analysis layer tests: plan verifier + ndslint + tier-1 gates.
+
+Three layers, mirroring the subsystem (nds_tpu/analysis/):
+
+- negative plan-verifier tests build deliberately malformed plans with
+  raw constructors and assert each invariant class trips;
+- lint-rule tests run every NDS1xx rule against small fixture snippets,
+  violating and waived;
+- gate tests execute tools/static_checks.py end-to-end and
+  tools/ndsverify.py over all 103 NDS + 22 NDS-H statements, asserting
+  the tree itself stays clean (the tier-1 contract from ISSUE 2).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nds_tpu.analysis import lint_rules, plan_verify
+from nds_tpu.analysis.plan_verify import (
+    PlanVerifyError, check_exchange_invariants, verify,
+)
+from nds_tpu.engine.session import Session
+from nds_tpu.engine.types import (
+    FLOAT64, INT32, INT64, STRING, Schema,
+)
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.sql import ir
+from nds_tpu.sql import plan as P
+from nds_tpu.sql.planner import CatalogInfo
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _scan(name="t", binding="t", cols=(("a", INT32), ("b", INT64))):
+    return P.Scan(name, binding, [(n, d) for n, d in cols])
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+# ------------------------------------------------------- plan verifier
+
+def test_valid_plan_is_clean():
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.ColRef("t", "a", INT32))], "p")
+    assert verify(P.PlannedQuery(proj, [], ["x"])) == []
+
+
+def test_dangling_colref():
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.ColRef("ghost", "a", INT32))], "p")
+    assert "colref-unresolved" in _rules(
+        verify(P.PlannedQuery(proj, [], ["x"])))
+
+
+def test_colref_dtype_mismatch():
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.ColRef("t", "a", INT64))], "p")
+    assert "colref-dtype" in _rules(
+        verify(P.PlannedQuery(proj, [], ["x"])))
+
+
+def test_mismatched_join_key_dtypes():
+    s1 = _scan("t1", "t1", (("k", INT32),))
+    s2 = _scan("t2", "t2", (("s", STRING),))
+    j = P.Join("inner", s1, s2,
+               [ir.ColRef("t1", "k", INT32)],
+               [ir.ColRef("t2", "s", STRING)],
+               None, False, output=list(s1.output), binding="t1")
+    assert "join-key-dtype" in _rules(
+        verify(P.PlannedQuery(j, [], ["k"])))
+
+
+def test_join_key_arity_mismatch():
+    s1 = _scan("t1", "t1", (("k", INT32),))
+    s2 = _scan("t2", "t2", (("k", INT32),))
+    j = P.SemiJoin(s1, s2, [ir.ColRef("t1", "k", INT32)], [], None)
+    assert "join-key-arity" in _rules(
+        verify(P.PlannedQuery(j, [], ["k"])))
+
+
+def test_out_of_range_aggref_flags():
+    # the planner remaps every AggRef onto agg-output ColRefs; one
+    # surviving (here with an absurd index) must trip the verifier
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.AggRef(99, INT64))], "p")
+    assert "ref-unresolved" in _rules(
+        verify(P.PlannedQuery(proj, [], ["x"])))
+
+
+def test_scalarref_out_of_range():
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.ScalarRef(3, INT64))], "p")
+    assert "scalarref-range" in _rules(
+        verify(P.PlannedQuery(proj, [], ["x"])))
+
+
+def test_arith_dtype_propagation():
+    scan = _scan()
+    bad = ir.Arith("+", ir.ColRef("t", "a", INT32),
+                   ir.Lit(1, INT32), FLOAT64)  # int32+int32 is int32
+    proj = P.Project(scan, [("x", bad)], "p")
+    assert "arith-dtype" in _rules(
+        verify(P.PlannedQuery(proj, [], ["x"])))
+
+
+def test_agg_dtype_propagation():
+    scan = _scan()
+    agg = P.Aggregate(scan, [], [("s", P.AggSpec(
+        "sum", ir.ColRef("t", "a", INT32), False, INT32))], "g")
+    assert "agg-dtype" in _rules(  # sum(int32) widens to int64
+        verify(P.PlannedQuery(agg, [], ["s"])))
+
+
+def test_negative_limit():
+    assert "limit-count" in _rules(
+        verify(P.PlannedQuery(P.Limit(_scan(), -1), [], ["a", "b"])))
+
+
+def test_setop_arity_mismatch():
+    l = P.Project(_scan(), [("x", ir.ColRef("t", "a", INT32)),
+                            ("y", ir.ColRef("t", "b", INT64))], "pl")
+    r = P.Project(_scan(), [("x", ir.ColRef("t", "a", INT32))], "pr")
+    u = P.SetOp("union all", l, r)
+    assert "setop-arity" in _rules(verify(P.PlannedQuery(u, [], ["x", "y"])))
+
+
+def test_setop_dtype_mismatch():
+    l = P.Project(_scan(), [("x", ir.ColRef("t", "a", INT32))], "pl")
+    r = P.Project(_scan(), [("x", ir.ColRef("t", "b", INT64))], "pr")
+    bad = P.Project(_scan(), [("x", ir.Lit("s", STRING))], "ps")
+    u = P.SetOp("union all", l, bad)
+    assert "setop-dtype" in _rules(verify(P.PlannedQuery(u, [], ["x"])))
+    ok = P.SetOp("union all", l, r)  # int widths may differ
+    assert "setop-dtype" not in _rules(verify(P.PlannedQuery(ok, [], ["x"])))
+
+
+def test_stagedscan_mangle_and_registration():
+    temp = P.Scan("__stage_1", "__t", [("t__a", INT32)])
+    good = P.StagedScan(temp, [("t", "a", "t__a", INT32)], "t",
+                        [("a", INT32)])
+    pq = P.PlannedQuery(P.Filter(good, ir.Cmp(
+        "=", ir.ColRef("t", "a", INT32), ir.Lit(1, INT32))), [], ["a"])
+    assert verify(pq) == []
+    # unregistered temp only flags when a table registry is supplied
+    # (and the backing Scan independently flags as unregistered too)
+    got = _rules(verify(pq, tables={}))
+    assert "staged-unregistered" in got and "scan-unregistered" in got
+    bad = P.StagedScan(temp, [("t", "a", "WRONG", INT32)], "t",
+                       [("a", INT32)])
+    assert "staged-mangle" in _rules(
+        verify(P.PlannedQuery(bad, [], ["a"])))
+
+
+def test_exchange_invariants():
+    assert check_exchange_invariants(1000, 8, 2.0) == []
+    assert {v.rule for v in check_exchange_invariants(1000, 8, 0.5)} == {
+        "exchange-slack"}
+    assert "exchange-mesh" in {
+        v.rule for v in check_exchange_invariants(1000, 0, 2.0)}
+
+
+def test_assert_valid_raises_with_context():
+    scan = _scan()
+    proj = P.Project(scan, [("x", ir.ColRef("ghost", "a", INT32))], "p")
+    with pytest.raises(PlanVerifyError, match="colref-unresolved"):
+        plan_verify.assert_valid(P.PlannedQuery(proj, [], ["x"]),
+                                 label="unit")
+
+
+# -------------------------------------------- session + executor gates
+
+def _tiny_session():
+    sch = Schema.of(("k", INT32, False), ("x", INT32, False))
+    cat = CatalogInfo({"t": sch}, {"t": ("k",)}, {"t": 10.0})
+    s = Session(cat)
+    s.register_table(from_arrays(
+        "t", sch, {"k": np.array([1, 2], np.int32),
+                   "x": np.array([10, 20], np.int32)}))
+    return s
+
+
+def test_session_plan_verifies_under_env(monkeypatch):
+    s = _tiny_session()
+    # a structurally broken view body: resolvable by the planner (its
+    # output list is fine) but with a dangling ColRef inside
+    s.views["broken_v"] = P.Project(
+        P.Scan("t", "b", []), [("x", ir.ColRef("ghost", "c", INT32))],
+        "pv")
+    monkeypatch.setenv(plan_verify.ENV_FLAG, "1")
+    with pytest.raises(PlanVerifyError, match="colref-unresolved"):
+        s.plan("select x from broken_v")
+    monkeypatch.setenv(plan_verify.ENV_FLAG, "0")
+    assert isinstance(s.plan("select x from broken_v"), P.PlannedQuery)
+
+
+def test_duplicate_output_names_stay_positional():
+    # q64 regression: unaliased same-named columns from two bindings
+    # must keep their own values (the planner dedupes internal names;
+    # display names stay as written)
+    s = _tiny_session()
+    r = s.sql("select a.x, b.x from t a, t b "
+              "where a.k = 1 and b.k = 2 and a.k < b.k")
+    assert r.names == ["x", "x"]
+    assert r.to_pandas().values.tolist() == [[10, 20]]
+
+
+def test_register_staged_hashes_full_content():
+    # ADVICE r5: a same-shape change PAST the old 16Ki prefix must
+    # invalidate the staged fingerprint (stale device buffers otherwise)
+    from nds_tpu.engine.device_exec import DeviceExecutor
+    n = (1 << 14) + 8
+    sch = Schema.of(("c", INT64, False))
+    a1 = np.zeros(n, np.int64)
+    ex = DeviceExecutor({})
+    ex._register_staged("__stage_t", from_arrays("__stage_t", sch,
+                                                 {"c": a1}))
+    fp1 = ex._stage_fps["__stage_t"]
+    a2 = a1.copy()
+    a2[-1] = 7
+    t2 = from_arrays("__stage_t", sch, {"c": a2})
+    ex._register_staged("__stage_t", t2)
+    assert ex._stage_fps["__stage_t"] != fp1
+    assert ex.tables["__stage_t"] is t2
+
+
+# ------------------------------------------------------------- ndslint
+
+def _lint(src, path="nds_tpu/engine/fixture.py", enabled=None):
+    res = lint_rules.lint_sources({path: src}, enabled=enabled)
+    return res
+
+
+def test_rule_id_keyed_cache():
+    res = _lint("def f(c, x):\n    c[id(x)] = 1\n", enabled={"NDS101"})
+    assert _rules(res.violations) == {"NDS101"}
+    res = _lint("def f(c, x):\n    nid = id(x)\n    c[nid] = 1\n",
+                enabled={"NDS101"})
+    assert _rules(res.violations) == {"NDS101"}
+    res = _lint("def f(c, x):\n    c.setdefault(id(x), [])\n",
+                enabled={"NDS101"})
+    assert _rules(res.violations) == {"NDS101"}
+    waived = ("def f(c, x):\n"
+              "    # ndslint: waive[NDS101] -- value pins x\n"
+              "    c[id(x)] = (x, 1)\n")
+    res = _lint(waived, enabled={"NDS101"})
+    assert res.violations == [] and len(res.waived) == 1
+    assert res.waived[0].waiver_note == "value pins x"
+
+
+def test_rule_raw_timing_scoped_to_engine():
+    src = "import time\n\ndef f():\n    return time.perf_counter()\n"
+    assert _rules(_lint(src, enabled={"NDS102"}).violations) == {"NDS102"}
+    # same source outside engine//parallel/ is fine
+    assert _lint(src, path="nds_tpu/utils/fixture.py",
+                 enabled={"NDS102"}).violations == []
+
+
+def test_rule_unsynced_device_timing():
+    src = ("import time\n"
+           "import jax.numpy as jnp\n\n"
+           "def f(x):\n"
+           "    t0 = time.perf_counter()\n"
+           "    y = jnp.sum(x)\n"
+           "    return (time.perf_counter() - t0), y\n")
+    assert "NDS103" in _rules(_lint(src, enabled={"NDS103"}).violations)
+    synced = src.replace("return (time.perf_counter() - t0), y",
+                         "y.block_until_ready()\n"
+                         "    return (time.perf_counter() - t0), y")
+    assert _lint(synced, enabled={"NDS103"}).violations == []
+
+
+def test_rule_prefix_hash():
+    src = ("def f(h, arr):\n"
+           "    h.update(arr[: 1 << 14].tobytes())\n")
+    assert _rules(_lint(src, enabled={"NDS104"}).violations) == {"NDS104"}
+    full = "def f(h, arr):\n    h.update(arr.tobytes())\n"
+    assert _lint(full, enabled={"NDS104"}).violations == []
+
+
+def test_rule_dead_dataclass_field():
+    src = ("from dataclasses import dataclass\n\n"
+           "@dataclass\n"
+           "class C:\n"
+           "    used: int = 0\n"
+           "    zz_never_read_zz: int = 0\n\n"
+           "def f(c):\n"
+           "    return c.used\n")
+    res = _lint(src, enabled={"NDS105"})
+    assert [v.rule for v in res.violations] == ["NDS105"]
+    assert "zz_never_read_zz" in res.violations[0].msg
+
+
+def test_rule_mutable_default_and_bare_except():
+    src = ("def f(a=[]):\n"
+           "    try:\n"
+           "        return a\n"
+           "    except:\n"
+           "        pass\n")
+    assert _rules(_lint(src, enabled={"NDS106", "NDS107"}).violations) \
+        == {"NDS106", "NDS107"}
+
+
+def test_waiver_requires_justification_and_use():
+    src = ("def f(a=[]):  # ndslint: waive[NDS106]\n"
+           "    return a\n")
+    res = _lint(src, enabled={"NDS106"})
+    # malformed waiver is an error AND the violation stays unwaived
+    assert any(v.rule == "NDS100" for v in res.errors)
+    assert _rules(res.violations) == {"NDS106"}
+    stale = "def f(a):\n    # ndslint: waive[NDS106] -- nothing here\n    return a\n"
+    res = _lint(stale, enabled={"NDS106"})
+    assert any("matches no violation" in v.msg for v in res.errors)
+
+
+# --------------------------------------------------------- tier-1 gates
+
+def test_ndsverify_all_125_statements_clean(capsys):
+    import ndsverify
+    assert ndsverify.main(["--suite", "all"]) == 0
+    out = capsys.readouterr().out
+    assert "103 nds" in out and "22 nds_h" in out
+
+
+def test_static_checks_end_to_end():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "static_checks.py")],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "STATIC CHECKS OK" in r.stdout

@@ -1,0 +1,115 @@
+"""ndslint: run the repo's hazard-class lint rules over the tree.
+
+Drives ``nds_tpu/analysis/lint_rules.py`` (rule catalog + waiver
+semantics live there; see its docstring for the NDS1xx rule ids).
+Configuration comes from ``[tool.ndslint]`` in pyproject.toml:
+
+    roots   = ["nds_tpu", "tools"]   # directories to lint
+    exclude = ["query_templates"]    # path substrings to skip
+    rules   = []                     # rule-id allowlist ([] = all)
+
+Waivers are per-line and must carry a justification:
+
+    cache[id(plan)] = entry  # ndslint: waive[NDS1xx] -- entry pins plan
+
+Exit 0 when the tree is clean (waived findings print with their notes
+under -v); exit 1 on any unwaived violation, malformed waiver, or
+stale waiver. Run by tools/static_checks.py as a tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nds_tpu.analysis import lint_rules  # noqa: E402
+
+DEFAULT_CONFIG = {
+    "roots": ["nds_tpu", "tools"],
+    "exclude": [],
+    "rules": [],
+}
+
+
+def load_config(repo: pathlib.Path) -> dict:
+    """[tool.ndslint] from pyproject.toml, via tomllib/tomli when
+    available with a string/string-list fallback parser otherwise (the
+    config uses nothing fancier)."""
+    cfg = dict(DEFAULT_CONFIG)
+    pp = repo / "pyproject.toml"
+    if not pp.exists():
+        return cfg
+    text = pp.read_text()
+    data = None
+    for mod in ("tomllib", "tomli"):
+        try:
+            data = __import__(mod).loads(text)
+            break
+        except ImportError:
+            continue
+    if data is not None:
+        cfg.update(data.get("tool", {}).get("ndslint", {}))
+        return cfg
+    # minimal fallback: section header + `key = [...]` string lists
+    in_section = False
+    for line in text.splitlines():
+        s = line.strip()
+        if s.startswith("["):
+            in_section = s == "[tool.ndslint]"
+            continue
+        if in_section and "=" in s:
+            key, _, val = s.partition("=")
+            items = [v.strip().strip("\"'")
+                     for v in val.strip().strip("[]").split(",")]
+            cfg[key.strip()] = [v for v in items if v]
+    return cfg
+
+
+def collect_sources(repo: pathlib.Path, cfg: dict) -> "dict[str, str]":
+    sources = {}
+    for root in cfg["roots"]:
+        base = repo / root
+        if not base.exists():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = p.relative_to(repo).as_posix()
+            if any(x in rel for x in cfg["exclude"]):
+                continue
+            sources[rel] = p.read_text()
+    return sources
+
+
+def run(repo: pathlib.Path, verbose: bool = False,
+        cfg: "dict | None" = None) -> int:
+    cfg = load_config(repo) if cfg is None else cfg
+    sources = collect_sources(repo, cfg)
+    enabled = set(cfg["rules"]) or None
+    res = lint_rules.lint_sources(sources, enabled=enabled)
+    for v in res.violations + res.errors:
+        print(v)
+    if verbose:
+        for v in res.waived:
+            print(f"{v.path}:{v.line}: {v.rule} waived -- "
+                  f"{v.waiver_note}")
+    bad = len(res.violations) + len(res.errors)
+    print(f"{'FAIL' if bad else 'OK'}: {bad} violation(s), "
+          f"{len(res.waived)} waived, {len(sources)} file(s)")
+    return 1 if bad else 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print waived findings with their notes")
+    args = ap.parse_args(argv)
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    return run(repo, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Plan-and-verify every NDS / NDS-H statement on CPU — no accelerator.
+
+Planning is pure Python (parser + planner + catalog), so the full
+invariant sweep over all 103 NDS statements (99 templates; q14/q23/
+q24/q39 are two-statement) and 22 NDS-H SELECTs runs in seconds on any
+host. This is the static half of the correctness story: the
+differential tiers prove the *results*, this proves the *plans* — and
+it runs in tier-1 (tests/test_static_analysis.py) so a planner
+regression fails before any engine executes it.
+
+Exit 0 when every statement plans and verifies clean; prints each
+violation otherwise. View DDL (NDS-H q15's create/drop cycle) is
+applied to the session, not verified as a plan.
+
+Usage: python tools/ndsverify.py [--suite nds|nds_h|all] [-v]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nds_tpu.analysis import plan_verify  # noqa: E402
+from nds_tpu.engine.session import Session  # noqa: E402
+from nds_tpu.sql import plan as P  # noqa: E402
+
+
+def _verify_statement(session: Session, label: str, stmt: str,
+                      failures: list) -> int:
+    """Plan one statement, apply DDL side effects, verify SELECT/INSERT
+    plans. Returns the number of PlannedQuery units verified.
+
+    Under NDS_TPU_VERIFY_PLANS=1 (tests force it) Session.plan raises
+    on the first violation before our collecting verify() pass runs —
+    catch it so one bad statement still reports its violations and the
+    sweep continues to the remaining statements."""
+    try:
+        planned = session.plan(stmt)
+    except plan_verify.PlanVerifyError as exc:
+        for v in exc.violations:
+            failures.append(f"{label}: {v}")
+        return 1
+    if isinstance(planned, tuple):
+        action, name, node = planned
+        if action == "create_view":
+            session.views[name] = node
+            session._view_sql[name] = stmt
+            return 0
+        if action == "drop_view":
+            session.views.pop(name, None)
+            session._view_sql.pop(name, None)
+            return 0
+        if action == "insert" and isinstance(node, P.PlannedQuery):
+            planned = node
+        else:  # delete carries a raw WHERE ast, nothing planned
+            return 0
+    vs = plan_verify.verify(planned, catalog=session.catalog)
+    for v in vs:
+        failures.append(f"{label}: {v}")
+    return 1
+
+
+def verify_nds(failures: list, verbose: bool = False) -> int:
+    from nds_tpu.nds import streams
+    session = Session.for_nds()
+    n = 0
+    for qn in streams.available_templates():
+        sql = streams.render_query(qn)
+        parts = [s for s in sql.split(";") if s.strip()]
+        for i, stmt in enumerate(parts, 1):
+            label = f"nds q{qn}" + (f" part{i}" if len(parts) > 1 else "")
+            n += _verify_statement(session, label, stmt, failures)
+            if verbose:
+                print(f"  {label}: ok")
+    return n
+
+
+def verify_nds_h(failures: list, verbose: bool = False) -> int:
+    from nds_tpu.nds_h import streams
+    session = Session.for_nds_h()
+    n = 0
+    for qn in streams.stream_order(0):
+        for i, stmt in enumerate(streams.statements(qn), 1):
+            label = f"nds_h q{qn} part{i}"
+            n += _verify_statement(session, label, stmt, failures)
+            if verbose:
+                print(f"  {label}: ok")
+    return n
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--suite", choices=("nds", "nds_h", "all"),
+                    default="all")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    failures: list[str] = []
+    counts = []
+    if args.suite in ("nds", "all"):
+        counts.append(("nds", verify_nds(failures, args.verbose)))
+    if args.suite in ("nds_h", "all"):
+        counts.append(("nds_h", verify_nds_h(failures, args.verbose)))
+    for line in failures:
+        print(line)
+    summary = " + ".join(f"{n} {name}" for name, n in counts)
+    print(f"{'FAIL' if failures else 'OK'}: {len(failures)} "
+          f"violation(s) across {summary} statement(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

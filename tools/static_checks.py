@@ -1,0 +1,87 @@
+"""One-shot static gate: headers + trace schema + ndslint + plan verify.
+
+The single entrypoint tier-1 runs (tests/test_static_analysis.py) and
+the one to run locally before pushing:
+
+  1. check_headers      every module opens with a design-intent docstring
+  2. check_trace_schema the obs tracer's Chrome-trace JSONL export
+                        round-trips through the schema validator (a
+                        real trace is generated in-process — no
+                        accelerator, no jax import)
+  3. ndslint            hazard-class lint over nds_tpu/ + tools/
+                        (rules + waiver semantics:
+                        nds_tpu/analysis/lint_rules.py)
+  4. ndsverify          plan + verify all 103 NDS and 22 NDS-H
+                        statements on CPU (invariants:
+                        nds_tpu/analysis/plan_verify.py)
+
+Exit 0 only when every section passes; each section prints its own
+verdict line so CI logs show exactly which gate broke.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import check_headers  # noqa: E402
+import check_trace_schema  # noqa: E402
+import ndslint  # noqa: E402
+import ndsverify  # noqa: E402
+
+
+def run_trace_schema_check() -> int:
+    """Exercise the tracer end-to-end: emit a real span tree to a JSONL
+    file and validate it against the documented event schema."""
+    from nds_tpu.obs.trace import Tracer, export_chrome
+    tracer = Tracer(enabled=True)
+    with tracer.span("static_checks.trace_selftest", gate="tier-1"):
+        with tracer.span("static_checks.child", n=1):
+            pass
+    roots = getattr(tracer, "last_roots", None)
+    if not roots:  # tracer API drift: fail loudly, not silently
+        print("FAIL: tracer produced no root span")
+        return 1
+    root = roots[-1]
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        path = f.name
+    try:
+        export_chrome(root, path)
+        errors = check_trace_schema.validate_file(path)
+        for e in errors:
+            print(e)
+        print(f"{'FAIL' if errors else 'OK'}: {len(errors)} schema "
+              f"error(s) in generated trace")
+        return 1 if errors else 0
+    finally:
+        os.unlink(path)
+
+
+def main() -> int:
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    sections = [
+        ("headers", check_headers.main),
+        ("trace-schema", run_trace_schema_check),
+        ("ndslint", lambda: ndslint.run(repo)),
+        ("ndsverify", lambda: ndsverify.main([])),
+    ]
+    failed = []
+    for name, fn in sections:
+        print(f"== {name} ==")
+        if fn() != 0:
+            failed.append(name)
+    if failed:
+        print(f"STATIC CHECKS FAILED: {', '.join(failed)}")
+        return 1
+    print(f"STATIC CHECKS OK: {len(sections)} section(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
