@@ -27,6 +27,13 @@ fails CI instead of waiting for a human audit:
 - NDS106 mutable-default    mutable function-argument default.
 - NDS107 bare-except        ``except:`` catching SystemExit/
                             KeyboardInterrupt.
+- NDS108 naked-retry        a retry loop (loop + except handler) that
+                            sleeps a CONSTANT between attempts (no
+                            backoff) or spins ``while True`` (no
+                            attempt cap): under real contention a
+                            fixed-interval uncapped retry herd is the
+                            outage amplifier — use
+                            ``resilience.retry.RetryPolicy``.
 
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
@@ -372,10 +379,64 @@ class BareExceptRule(Rule):
                 if isinstance(n, ast.ExceptHandler) and n.type is None]
 
 
+class NakedRetryRule(Rule):
+    """NDS108: hand-rolled retry loops. A loop whose body contains an
+    ``except`` handler (the retry shape) flags when it either sleeps a
+    constant interval (no backoff) or is ``while True`` with a sleep
+    (no attempt cap). ``resilience.retry.RetryPolicy`` provides capped
+    attempts + exponential backoff + jitter; loops that delegate to it
+    (``policy.attempts()``, computed delays) don't match."""
+
+    id = "NDS108"
+    name = "naked-retry"
+
+    @staticmethod
+    def _is_sleep(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id == "sleep"
+        return (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name)
+                and f.value.id.lstrip("_") == "time")
+
+    def check(self, tree, src, path):
+        out = []
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            has_except = any(isinstance(x, ast.ExceptHandler)
+                             for x in ast.walk(loop))
+            if not has_except:
+                continue
+            sleeps = [x for x in ast.walk(loop) if self._is_sleep(x)]
+            if not sleeps:
+                continue
+            uncapped = (isinstance(loop, ast.While)
+                        and isinstance(loop.test, ast.Constant)
+                        and loop.test.value is True)
+            if uncapped:
+                out.append(LintViolation(
+                    self.id, path, loop.lineno,
+                    "while True retry loop with no attempt cap — use "
+                    "resilience.retry.RetryPolicy (capped attempts + "
+                    "backoff)"))
+                continue
+            for s in sleeps:
+                if any(isinstance(a, ast.Constant) for a in s.args):
+                    out.append(LintViolation(
+                        self.id, path, s.lineno,
+                        "retry loop sleeps a constant interval (no "
+                        "backoff) — use resilience.retry.RetryPolicy "
+                        "(exponential backoff + jitter)"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
-            MutableDefaultRule(), BareExceptRule()]
+            MutableDefaultRule(), BareExceptRule(), NakedRetryRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -43,6 +43,7 @@ from nds_tpu.engine.types import (
 from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
+from nds_tpu.resilience.retry import RetryPolicy, is_oom
 from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
 
@@ -221,6 +222,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
 
     # ----------------------------------------------------------------- API
 
+    # chunk halving floor: below this the per-chunk dispatch overhead
+    # dominates and an OOM is no longer a chunk-size problem
+    MIN_CHUNK_ROWS = 1 << 12
+
     def execute_async(self, planned: P.PlannedQuery, key: object = None):
         key = key if key is not None else id(planned)
         scans = self._streamed_scans(planned)
@@ -231,45 +236,82 @@ class ChunkedExecutor(dx.DeviceExecutor):
         # executor; last_timings rebinds only after phase A succeeds)
         self.last_query_span = None
         self.last_timings = {}
-        if key not in self._reduced:
-            reduced = {}
-            for table, table_scans in scans.items():
-                reduced[table] = self._reduce_table(table, table_scans)
-            sub = None
-            # filters didn't shrink some table under the budget: try
-            # per-chunk PARTIAL AGGREGATION before resorting to a full
-            # upload (the q1 full-scan-aggregate shape)
-            big = [t for t, r in reduced.items()
-                   if _table_bytes(r) > self.stream_bytes]
-            if len(big) == 1 and len(scans[big[0]]) == 1:
-                try:
-                    sub = self._try_partial_agg(
-                        planned, big[0], scans[big[0]][0], reduced)
-                except Exception as exc:  # noqa: BLE001 - fall back
-                    from nds_tpu.utils.report import TaskFailureCollector
-                    TaskFailureCollector.notify(
-                        f"partial-agg path failed for {big[0]}, falling "
-                        f"back to full upload: "
-                        f"{type(exc).__name__}: {exc}")
-            if sub is None:
-                # identity reductions (keep-all) are the session's own
-                # table objects — those buffers can live in the shared
-                # pool; genuinely reduced tables differ per plan and
-                # stay executor-local
-                local = {t for t, r in reduced.items()
-                         if r is not self.tables[t]}
-                sub = _PhaseBExecutor({**self.tables, **reduced},
-                                      self.float_dtype, self._buffers,
-                                      local)
-            while len(self._reduced) >= self.MAX_REDUCED:
-                self._reduced.pop(next(iter(self._reduced)))
-            self._reduced[key] = sub
-        sub = self._reduced[key]
-        res = sub.execute_async(planned, key)
+        # graceful degradation: an OOM-classified failure halves the
+        # chunk size and rebuilds phase A before giving up — the
+        # out-of-core engine's whole premise is that residency, not
+        # total size, is the limit (shared resilience policy; no sleep,
+        # each retry already pays a full re-scan)
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        last_attempt = policy.max_attempts - 1
+        for attempt in policy.attempts():
+            try:
+                if key not in self._reduced:
+                    sub = self._build_phase_b(planned, scans)
+                    while len(self._reduced) >= self.MAX_REDUCED:
+                        self._reduced.pop(next(iter(self._reduced)))
+                    self._reduced[key] = sub
+                sub = self._reduced[key]
+                res = sub.execute_async(planned, key)
+                break
+            except Exception as exc:  # noqa: BLE001 - classified below
+                if (not is_oom(exc) or attempt >= last_attempt
+                        or self.chunk_rows // 2 < self.MIN_CHUNK_ROWS):
+                    raise
+                self.chunk_rows //= 2
+                # drop every artifact sized by the old chunking
+                self._reduced.pop(key, None)
+                self._survivor_cache.clear()
+                obs_metrics.counter("chunk_shrink_total").inc()
+                from nds_tpu.utils.report import TaskFailureCollector
+                TaskFailureCollector.notify(
+                    f"OOM-classified failure in chunked execution "
+                    f"({type(exc).__name__}); halving chunk_rows to "
+                    f"{self.chunk_rows}")
         self.last_timings = sub.last_timings
         # the sub-executor's span/timings finalize at result(): forward
         # them so obs.query_timings(chunked_executor) sees the query
         return _ForwardResult(self, sub, res)
+
+    def _build_phase_b(self, planned: P.PlannedQuery, scans: dict):
+        """Phase A (reduce streamed tables) + phase-B executor choice
+        for one plan."""
+        reduced = {}
+        for table, table_scans in scans.items():
+            reduced[table] = self._reduce_table(table, table_scans)
+        sub = None
+        # filters didn't shrink some table under the budget: try
+        # per-chunk PARTIAL AGGREGATION before resorting to a full
+        # upload (the q1 full-scan-aggregate shape)
+        big = [t for t, r in reduced.items()
+               if _table_bytes(r) > self.stream_bytes]
+        if len(big) == 1 and len(scans[big[0]]) == 1:
+            try:
+                sub = self._try_partial_agg(
+                    planned, big[0], scans[big[0]][0], reduced)
+            except Exception as exc:  # noqa: BLE001 - fall back
+                if (is_oom(exc)
+                        and self.chunk_rows // 2 >= self.MIN_CHUNK_ROWS):
+                    # the chunk-halving loop can still shrink phase A;
+                    # once the floor is reached, OOM falls through to
+                    # the full-upload fallback below like any other
+                    # partial-agg failure
+                    raise
+                from nds_tpu.utils.report import TaskFailureCollector
+                TaskFailureCollector.notify(
+                    f"partial-agg path failed for {big[0]}, falling "
+                    f"back to full upload: "
+                    f"{type(exc).__name__}: {exc}")
+        if sub is None:
+            # identity reductions (keep-all) are the session's own
+            # table objects — those buffers can live in the shared
+            # pool; genuinely reduced tables differ per plan and
+            # stay executor-local
+            local = {t for t, r in reduced.items()
+                     if r is not self.tables[t]}
+            sub = _PhaseBExecutor({**self.tables, **reduced},
+                                  self.float_dtype, self._buffers,
+                                  local)
+        return sub
 
     def _streamed_scans(self, planned: P.PlannedQuery) -> dict:
         """{table: [Scan, ...]} for streamed tables in this plan."""
@@ -471,13 +513,17 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     if bkey + "#v" in bufs:
                         bufs[bkey + "#v"] = jnp.asarray(
                             col.null_mask[s:e])
-                for attempt in range(4):
+                # overflow-retry on the shared policy (slack-doubling
+                # shape, no backoff sleep — same as dist_exec)
+                overflow_policy = RetryPolicy(max_attempts=4,
+                                              base_delay_s=0.0)
+                for attempt in overflow_policy.attempts():
                     row, outs, overflow = compiled(bufs)
                     row_h, outs_h, over_h = jax.device_get(
                         (row, outs, overflow))
                     if int(over_h) == 0:
                         break
-                    if attempt == 3:
+                    if attempt == overflow_policy.max_attempts - 1:
                         raise dx.DeviceExecError(
                             "partial-agg chunk overflow persisted")
                     # skewed chunk expands past the chunk-0-sized join

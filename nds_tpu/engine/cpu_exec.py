@@ -131,6 +131,10 @@ class CpuExecutor:
     # ----------------------------------------------------------------- API
 
     def execute(self, planned: P.PlannedQuery):
+        from nds_tpu.resilience import faults
+        # chaos site shared with the device executors: CPU-backend runs
+        # exercise the retry/fallback machinery without a chip
+        faults.fault_point("device.execute", executor="CpuExecutor")
         self._node_cache.clear()
         self.scalars.clear()
         for i, sub in enumerate(planned.scalar_subplans):

@@ -76,6 +76,10 @@ class Session:
 
     def plan(self, sql_text: str):
         from nds_tpu.obs.trace import get_tracer
+        from nds_tpu.resilience import faults
+        # chaos site: deterministic plan-time faults must fail fast
+        # (the retry classifier never retries this class)
+        faults.fault_point("plan")
         with get_tracer().span("sql.parse", chars=len(sql_text)):
             stmt = parse(sql_text)
         return self.plan_ast(stmt)
@@ -121,12 +125,26 @@ class Session:
             self.tables[name] = dml.filter_rows(table, keep)
         self.invalidate()
 
-    def sql(self, sql_text: str) -> ResultTable | None:
-        key = (sql_text, self._views_signature())
+    def _planned_for(self, key: tuple, sql_text: str):
+        """Plan-cache lookup that keeps the 'plan' chaos site firing
+        exactly once per query submission: a cache MISS fires inside
+        plan(); a HIT fires here (warmup passes populate the cache —
+        a scheduled plan fault must still reach the timed pass)."""
         planned = self._plan_cache.get(key)
         if planned is None:
             planned = self.plan(sql_text)
             self._plan_cache[key] = planned
+        else:
+            from nds_tpu.resilience import faults
+            faults.fault_point("plan")
+        return planned
+
+    def sql(self, sql_text: str) -> ResultTable | None:
+        key = (sql_text, self._views_signature())
+        planned = self._planned_for(key, sql_text)
+        return self._run_planned(key, sql_text, planned)
+
+    def _run_planned(self, key: tuple, sql_text: str, planned):
         if isinstance(planned, tuple):
             action, name, node = planned
             if action == "create_view":
@@ -156,16 +174,13 @@ class Session:
         (`engine.concurrent_tasks` pipelining); everything else runs
         synchronously and returns an already-completed handle."""
         key = (sql_text, self._views_signature())
-        planned = self._plan_cache.get(key)
-        if planned is None:
-            planned = self.plan(sql_text)
-            self._plan_cache[key] = planned
+        planned = self._planned_for(key, sql_text)
         if not isinstance(planned, tuple):
             executor = self._executor_factory(self.tables)
             dispatch = getattr(executor, "execute_async", None)
             if dispatch is not None:
                 return dispatch(planned)
-        return _Completed(self.sql(sql_text))
+        return _Completed(self._run_planned(key, sql_text, planned))
 
 
 class _Completed:

@@ -42,6 +42,8 @@ def _arrow_read_type(dtype) -> pa.DataType:
 def read_tbl(paths: list[str] | str, name: str, schema: Schema,
              trailing_delimiter: bool = True) -> HostTable:
     """Read one table from one or more '|'-delimited files."""
+    from nds_tpu.resilience import faults
+    faults.fault_point("io.read", table=name)
     if isinstance(paths, str):
         paths = [paths]
     names = schema.names + (["_trailing"] if trailing_delimiter else [])
@@ -265,6 +267,8 @@ def read_paths_auto(paths: list[str], name: str, schema: Schema,
 def read_table_fmt(paths: list[str] | str, name: str, schema: Schema,
                    fmt: str) -> HostTable:
     """Read a warehouse table written by ``write_table`` in any format."""
+    from nds_tpu.resilience import faults
+    faults.fault_point("io.read", table=name, fmt=fmt)
     if fmt == "parquet":
         return read_parquet(paths, name, schema)
     if fmt == "avro":

@@ -15,6 +15,13 @@ metric (`nds/nds_bench.py:334-357`):
 
 Config comes from a YAML like `configs/bench_nds.yml` (the reference's
 `nds/bench.yml:18-59`).
+
+Resumability (README "Resilience"): every completed phase journals its
+timings to ``bench_state.json`` in the report dir; ``--resume`` replays
+completed phases from the journal instead of re-running them, so a
+crash in throughput round 2 costs only that round — the journal guards
+against config drift via a digest, and the resumed run computes the
+SAME composite metric an uninterrupted one would.
 """
 
 from __future__ import annotations
@@ -29,6 +36,7 @@ import time
 import yaml
 
 from nds_tpu.nds.transcode import get_load_time, get_rngseed
+from nds_tpu.resilience.journal import PhaseJournal, config_digest
 from nds_tpu.utils.timelog import TimeLog
 
 
@@ -76,7 +84,7 @@ def get_perf_metric(scale: float, num_streams: int, tload: float,
     return int(scale * q / denom) if denom > 0 else 0
 
 
-def run_full_bench(cfg: dict) -> dict:
+def run_full_bench(cfg: dict, resume: bool = False) -> dict:
     paths = cfg["paths"]
     scale = float(cfg.get("scale_factor", 1))
     parallel = int(cfg.get("parallel", 2))
@@ -95,72 +103,123 @@ def run_full_bench(cfg: dict) -> dict:
     load_report = os.path.join(report_dir, "load_report.txt")
     metrics: dict = {"scale": scale, "streams": num_streams}
 
-    if not skip.get("data_gen", False):
-        _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
-              str(scale), str(parallel), raw_dir, "--overwrite_output"],
-             backend="cpu")
-        # one refresh set per maintenance run (2 per full bench)
-        for update in (1, 2):
-            _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
-                  str(scale), "1", f"{refresh_base}{update}",
-                  "--update", str(update), "--overwrite_output"],
-                 backend="cpu")
-    if not skip.get("load_test", False):
-        _run([sys.executable, "-m", "nds_tpu.nds.transcode",
-              raw_dir, wh_dir, load_report], backend="cpu")
-    metrics["load_time_s"] = tld = get_load_time(load_report)
-    rngseed = get_rngseed(load_report)
+    journal = PhaseJournal(os.path.join(report_dir, "bench_state.json"),
+                           config_digest(cfg))
+    if resume:
+        if journal.load():
+            done = sorted(journal.state["phases"])
+            print(f"== resuming: journal has {', '.join(done)} ==")
+    else:
+        # a fresh run must not leave a stale journal a later --resume
+        # could splice in
+        journal.reset()
 
-    if not skip.get("stream_gen", False):
-        from nds_tpu.nds.streams import generate_query_streams
-        # rngseed from the load report redraws every stream's parameter
-        # bindings (dsqgen -rngseed, `nds/nds_bench.py:415`): throughput
-        # streams must be distinct workloads, not N copies
-        generate_query_streams(stream_dir, num_streams,
-                               rng_seed=rngseed, qualification=False)
+    def phase(name, body):
+        """Run one phase unless the journal already has it; journal its
+        result values (the numbers the composite metric needs) on
+        completion. Phase bodies honor cfg['skip'] themselves."""
+        if resume and journal.done(name):
+            print(f"== skipping {name} (journaled) ==")
+            return journal.timings(name)
+        vals = body()
+        journal.complete(name, **vals)
+        return vals
+
+    def _data_gen():
+        if not skip.get("data_gen", False):
+            _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
+                  str(scale), str(parallel), raw_dir,
+                  "--overwrite_output"], backend="cpu")
+            # one refresh set per maintenance run (2 per full bench)
+            for update in (1, 2):
+                _run([sys.executable, "-m", "nds_tpu.nds.gen_data",
+                      str(scale), "1", f"{refresh_base}{update}",
+                      "--update", str(update), "--overwrite_output"],
+                     backend="cpu")
+        return {}
+
+    def _load_test():
+        if not skip.get("load_test", False):
+            _run([sys.executable, "-m", "nds_tpu.nds.transcode",
+                  raw_dir, wh_dir, load_report], backend="cpu")
+        return {"load_time_s": get_load_time(load_report),
+                "rngseed": get_rngseed(load_report)}
+
+    phase("data_gen", _data_gen)
+    load_vals = phase("load_test", _load_test)
+    metrics["load_time_s"] = tld = load_vals["load_time_s"]
+    rngseed = load_vals["rngseed"]
+
+    def _stream_gen():
+        if not skip.get("stream_gen", False):
+            from nds_tpu.nds.streams import generate_query_streams
+            # rngseed from the load report redraws every stream's
+            # parameter bindings (dsqgen -rngseed,
+            # `nds/nds_bench.py:415`): throughput streams must be
+            # distinct workloads, not N copies
+            generate_query_streams(stream_dir, num_streams,
+                                   rng_seed=rngseed,
+                                   qualification=False)
+        return {}
+
+    phase("stream_gen", _stream_gen)
 
     power_log = os.path.join(report_dir, "power_time.csv")
-    if not skip.get("power_test", False):
-        _run([sys.executable, "-m", "nds_tpu.nds.power",
-              wh_dir, os.path.join(stream_dir, "query_0.sql"), power_log,
-              "--backend", backend,
-              "--json_summary_folder", os.path.join(report_dir, "json")],
-             backend=backend)
-    metrics["power_time_s"] = tpt = get_power_time(power_log)
+
+    def _power_test():
+        if not skip.get("power_test", False):
+            _run([sys.executable, "-m", "nds_tpu.nds.power",
+                  wh_dir, os.path.join(stream_dir, "query_0.sql"),
+                  power_log, "--backend", backend,
+                  "--json_summary_folder",
+                  os.path.join(report_dir, "json")],
+                 backend=backend)
+        return {"power_time_s": get_power_time(power_log)}
+
+    metrics["power_time_s"] = tpt = phase(
+        "power_test", _power_test)["power_time_s"]
+
+    def _throughput(round_no):
+        from nds_tpu.nds.throughput import (
+            run_streams, run_streams_inprocess,
+        )
+        streams_n = get_stream_range(num_streams, round_no)
+        tstreams = [os.path.join(stream_dir, f"query_{i}.sql")
+                    for i in streams_n]
+        tdir = os.path.join(report_dir, f"throughput{round_no}")
+        # one TPU chip cannot be opened by N subprocesses; the
+        # in-process mode time-shares it (cpu/distributed keep the
+        # reference's process fan-out). Overridable via YAML.
+        mode = cfg.get("throughput_mode",
+                       "inprocess" if backend == "tpu"
+                       else "subprocess")
+        if mode == "inprocess":
+            ttt, codes = run_streams_inprocess(
+                wh_dir, tstreams, tdir, backend=backend)
+        else:
+            ttt, codes = run_streams(
+                wh_dir, tstreams, tdir, backend=backend)
+        if any(codes):
+            raise SystemExit(
+                f"throughput {round_no} streams failed: {codes}")
+        return {"ttt": ttt}
+
+    def _maintenance(round_no):
+        dm_log = os.path.join(report_dir,
+                              f"maintenance{round_no}_time.csv")
+        _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
+              wh_dir, f"{refresh_base}{round_no}", dm_log,
+              "--backend", backend], backend=backend)
+        return {"tdm": get_maintenance_time(dm_log)}
 
     ttts, tdms = [], []
     for round_no in (1, 2):
         if not skip.get("throughput_test", False):
-            from nds_tpu.nds.throughput import (
-                run_streams, run_streams_inprocess,
-            )
-            streams_n = get_stream_range(num_streams, round_no)
-            tstreams = [os.path.join(stream_dir, f"query_{i}.sql")
-                        for i in streams_n]
-            tdir = os.path.join(report_dir, f"throughput{round_no}")
-            # one TPU chip cannot be opened by N subprocesses; the
-            # in-process mode time-shares it (cpu/distributed keep the
-            # reference's process fan-out). Overridable via YAML.
-            mode = cfg.get("throughput_mode",
-                           "inprocess" if backend == "tpu"
-                           else "subprocess")
-            if mode == "inprocess":
-                ttt, codes = run_streams_inprocess(
-                    wh_dir, tstreams, tdir, backend=backend)
-            else:
-                ttt, codes = run_streams(
-                    wh_dir, tstreams, tdir, backend=backend)
-            if any(codes):
-                raise SystemExit(
-                    f"throughput {round_no} streams failed: {codes}")
-            ttts.append(ttt)
+            ttts.append(phase(f"throughput_{round_no}",
+                              lambda r=round_no: _throughput(r))["ttt"])
         if not skip.get("maintenance_test", False):
-            dm_log = os.path.join(report_dir,
-                                  f"maintenance{round_no}_time.csv")
-            _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
-                  wh_dir, f"{refresh_base}{round_no}", dm_log,
-                  "--backend", backend], backend=backend)
-            tdms.append(get_maintenance_time(dm_log))
+            tdms.append(phase(f"maintenance_{round_no}",
+                              lambda r=round_no: _maintenance(r))["tdm"])
     metrics["throughput_times_s"] = ttts
     metrics["maintenance_times_s"] = tdms
 
@@ -189,10 +248,14 @@ def run_full_bench(cfg: dict) -> dict:
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="full NDS benchmark")
     p.add_argument("config", help="bench YAML (like configs/bench_nds.yml)")
+    p.add_argument("--resume", action="store_true",
+                   help="replay completed phases from the report dir's "
+                        "bench_state.json journal instead of re-running "
+                        "them (crash recovery; README Resilience)")
     args = p.parse_args(argv)
     with open(args.config) as f:
         cfg = yaml.safe_load(f)
-    run_full_bench(cfg)
+    run_full_bench(cfg, resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -132,12 +132,8 @@ def run_maintenance(data_dir: str, refresh_dir: str, time_log_path: str,
         if not report.is_success():
             failures += 1
         if json_summary_folder:
-            cwd = os.getcwd()
-            os.chdir(json_summary_folder)
-            try:
-                report.write_summary(prefix=f"maintenance-{app_id}")
-            finally:
-                os.chdir(cwd)
+            report.write_summary(prefix=f"maintenance-{app_id}",
+                                 out_dir=json_summary_folder)
     dm_ms = int((time.perf_counter() - dm_start) * 1000)
     tlog.add("Data Maintenance Time", dm_ms)
     tlog.write(time_log_path)

@@ -60,8 +60,13 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
     progress together the way the xargs -P fan-out does. Per-stream time
     logs keep the reference format. Returns (elapse_s, failure counts)."""
     from nds_tpu.nds.power import SUITE
+    from nds_tpu.resilience import faults
+    from nds_tpu.resilience.retry import (
+        TRANSIENT, RetryPolicy, RetryStats, classify,
+    )
     from nds_tpu.utils import power_core
     from nds_tpu.utils.config import EngineConfig
+    from nds_tpu.utils.report import BenchReport
     from nds_tpu.utils.timelog import TimeLog
 
     os.makedirs(out_dir, exist_ok=True)
@@ -70,6 +75,7 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
     # terms must be measured under the same rule in both modes
     start = time.time()
     config = EngineConfig(overrides={"engine.backend": backend})
+    policy = RetryPolicy.from_config(config)
     session = power_core.make_session(SUITE, config)
     power_core.load_warehouse(
         SUITE, session, data_dir, input_format,
@@ -82,6 +88,13 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
             "queries": list(SUITE.parse_query_stream(sp).items()),
             "tlog": TimeLog(f"nds-tpu-throughput-{name}"),
             "failures": 0,
+            # per-stream BenchReport material: statuses/exception text
+            # per query, so throughput failures are diagnosable from
+            # the report JSON (the power path's `exceptions` contract)
+            "statuses": [],
+            "exceptions": [],
+            "qtimes": [],
+            "retries": 0,
         })
     # flatten round-robin, then run with `engine.concurrent_tasks`
     # queries in flight: dispatch is async on the device engine
@@ -97,21 +110,51 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
     inflight: list = []
 
     def _finish_one():
-        s, qname, t0, handle, err = inflight.pop(0)
+        s, qname, sql, t0, handle, err = inflight.pop(0)
         if err is None:
             try:
                 handle.result()
             except Exception as exc:  # noqa: BLE001
                 err = exc
+        if (err is not None and classify(err) == TRANSIENT
+                and policy.max_attempts > 1):
+            # transient failure (device OOM, injected chaos): re-run
+            # synchronously under the shared policy — the stream keeps
+            # its pipelining for the healthy queries and pays the
+            # backoff only on the sick one. The failed async dispatch
+            # already SPENT attempt 1, so the rerun policy gets the
+            # remaining budget, keeping the per-query attempt cap
+            # identical to the power path's
+            st = RetryStats()
+            from nds_tpu.obs import metrics as obs_metrics
+            obs_metrics.counter("query_retries_total").inc()
+            s["retries"] += 1
+            rerun = policy.with_attempts(policy.max_attempts - 1)
+            try:
+                with faults.context(query=qname, stream=s["name"]):
+                    rerun.call(session.sql, sql, stats=st)
+                err = None
+            except Exception as exc:  # noqa: BLE001
+                err = exc
+            s["retries"] += st.retries
         if err is not None:
             import traceback
             traceback.print_exception(type(err), err, err.__traceback__)
             s["failures"] += 1
+            # exception text into the stream's report summary: a
+            # throughput failure used to be a bare count, invisible in
+            # the report JSON
+            s["exceptions"].append(
+                f"{qname}: {type(err).__name__}: {err}")
+            s["statuses"].append("Failed")
+        else:
+            s["statuses"].append("Completed")
         done = time.time()
         # dispatch->result bracket; queue wait from pipelining is
         # inherent to a time-shared chip, exactly as a query inside a
         # reference throughput stream waits on cluster resources
         s["tlog"].add(qname, int((done - t0) * 1000))
+        s["qtimes"].append(int((done - t0) * 1000))
         s["first_t0"] = min(s.get("first_t0", t0), t0)
         s["last_done"] = done
 
@@ -119,10 +162,12 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
         t0 = time.time()
         handle, err = None, None
         try:
-            handle = session.sql_async(sql)
+            with faults.context(query=qname, stream=s["name"]):
+                faults.fault_point("stream.query")
+                handle = session.sql_async(sql)
         except Exception as exc:  # noqa: BLE001
             err = exc
-        inflight.append((s, qname, t0, handle, err))
+        inflight.append((s, qname, sql, t0, handle, err))
         while len(inflight) >= depth:
             _finish_one()
     while inflight:
@@ -135,6 +180,17 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
                    s.get("first_t0", start)) * 1000)
         s["tlog"].add("Power Test Time", ptt)
         s["tlog"].write(os.path.join(out_dir, f"{s['name']}_time.csv"))
+        # one BenchReport JSON per stream (reference summary shape, one
+        # entry per query): failures carry their exception text, the
+        # resilience fields record recovery work
+        rep = BenchReport(s["name"], config.as_dict())
+        rep.capture_env()
+        rep.summary["startTime"] = int(start * 1000)
+        rep.summary["queryStatus"] = s["statuses"]
+        rep.summary["exceptions"] = s["exceptions"]
+        rep.summary["queryTimes"] = s["qtimes"]
+        rep.summary["retries"] = s["retries"]
+        rep.write_summary(prefix="throughput", out_dir=out_dir)
     elapse = math.ceil((time.time() - start) * 10) / 10.0
     return elapse, [s["failures"] for s in streams]
 

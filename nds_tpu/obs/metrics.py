@@ -19,10 +19,13 @@ Metric names in use across the stack (documented in README
 - ``staged_subprograms_total`` — host-staged plan splitting
 - ``exchanges_traced_total`` / ``exchange_overflow_retries_total`` /
   ``exchange_overflow_rows_total`` — distributed exchange
-- ``chunk_scans_total`` / ``chunk_fallbacks_total`` — out-of-core
-  executor
+- ``chunk_scans_total`` / ``chunk_fallbacks_total`` /
+  ``chunk_shrink_total`` — out-of-core executor
 - ``task_failures_total`` — TaskFailureCollector bridge
   (utils/report.py)
+- ``faults_injected_total`` / ``query_retries_total`` /
+  ``query_deadline_exceeded_total`` / ``engine_fallbacks_total`` —
+  resilience layer (nds_tpu/resilience/)
 
 Per-query deltas (``delta(before, after)``) land in each BenchReport
 JSON under ``metrics``.

@@ -41,6 +41,8 @@ from nds_tpu.parallel.exchange import exchange, exchange_hierarchical
 from nds_tpu.parallel.mesh import (
     DATA_AXIS, HOST_AXIS, make_mesh, pad_to_multiple,
 )
+from nds_tpu.resilience import faults
+from nds_tpu.resilience.retry import RetryPolicy
 from nds_tpu.sql import plan as P
 from nds_tpu.utils.report import TaskFailureCollector
 
@@ -243,6 +245,8 @@ class DistributedExecutor(dx.DeviceExecutor):
         the query span, and the staged sub-program bill folds in after
         materialize (the round-5 advisor finding: multichip queries
         silently dropped their bill)."""
+        faults.fault_point("device.execute",
+                           executor=type(self).__name__)
         key = key if key is not None else id(planned)
         orig = planned
         tracer = get_tracer()
@@ -288,7 +292,11 @@ class DistributedExecutor(dx.DeviceExecutor):
             self._compiled[key] = self._compiled.pop(key)
         (build, side), state, _ref = self._compiled[key]
         slack = state.get("slack", self.slack)
-        for attempt in range(3):
+        # the ad-hoc `for attempt in range(3)` slack loop, generalized
+        # onto the shared resilience policy (no backoff sleep: each
+        # retry already pays a full recompile)
+        for attempt in RetryPolicy(max_attempts=3,
+                                   base_delay_s=0.0).attempts():
             if "jitted" not in state or state.get("slack") != slack:
                 # free the previous slack's executable BEFORE compiling
                 # the bigger one: the 8-way compiled forms of wide

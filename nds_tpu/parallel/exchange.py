@@ -56,6 +56,11 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     overrides the per-peer capacity — hierarchical stage 2 sizes it
     from the LOGICAL row count, not the stage-1 padded length."""
     n = dest.shape[0]
+    # chaos site (trace time, like the counter below): an injected
+    # fault here surfaces during compile, where the executor's retry
+    # loop classifies and handles it like a real capacity failure
+    from nds_tpu.resilience import faults
+    faults.fault_point("exchange", n_dev=n_dev)
     # trace-time count: how many exchange ops the compiled programs
     # contain (runtime executions multiply by program runs; in-program
     # counting would cost a collective per query for a vanity number)

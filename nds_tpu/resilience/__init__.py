@@ -1,0 +1,21 @@
+"""Fault-injection & resilience layer.
+
+The reference harness's only failure story is "keep going and downgrade
+the exit code" (``--allow_failure``); a production-scale run needs more:
+transient device OOMs, exchange overflows and mid-run crashes are
+routine events to recover from, not reasons to restart a multi-hour
+benchmark. This package is the shared vocabulary for that recovery:
+
+- ``faults``   seeded, deterministic fault injection at named sites
+               (``NDS_TPU_FAULTS`` schedule; zero-cost no-op when unset)
+- ``retry``    transient-vs-deterministic failure classification plus
+               ``RetryPolicy`` (exponential backoff, jitter, attempt
+               caps, per-query wall-clock deadlines)
+- ``journal``  phase journal for resumable whole-benchmark runs
+               (``bench_state.json`` + ``--resume``)
+
+See README "Resilience" for the schedule syntax and config keys.
+"""
+
+from nds_tpu.resilience.faults import fault_point  # noqa: F401
+from nds_tpu.resilience.retry import RetryPolicy, RetryStats, classify  # noqa: F401

@@ -1,0 +1,263 @@
+"""Seeded, deterministic fault injection at named sites.
+
+Chaos testing for the harness itself: the engine/parallel/io layers
+call ``fault_point(site, **info)`` inline at their hazard points, and a
+schedule — the ``NDS_TPU_FAULTS`` env var or a programmatic
+``install()`` — decides which calls raise, delay, or pass through.
+Unset, a fault point is one dict lookup and a string compare (the same
+zero-cost-when-disabled contract as ``nds_tpu/obs/trace.py``).
+
+Registered sites (the call sites live inline in the layer they test):
+
+- ``plan``            Session.plan (parse+plan front door)
+- ``device.execute``  every executor's execute/execute_async entry
+                      (CPU oracle included, so chaos runs need no chip)
+- ``exchange``        the distributed all_to_all shuffle (trace time)
+- ``io.read``         warehouse table reads (csv/parquet/raw)
+- ``stream.query``    per-query dispatch in the throughput stream loop
+
+Schedule syntax (comma-separated entries)::
+
+    NDS_TPU_FAULTS="device.execute:oom@q5,io.read:delay=0.2@*"
+
+    entry := site ":" kind ["=" param] ["*" times] ["~" prob] "@" scope
+
+- ``kind``   ``oom`` (raises InjectedOOM, classified transient),
+             ``fault`` (generic transient), ``deterministic`` (never
+             retried), ``delay`` (sleeps ``param`` seconds)
+- ``times``  how many matching calls fire (default 1 for raising
+             kinds — so one retry succeeds — unlimited for ``delay``)
+- ``prob``   per-match firing probability in [0,1] (default 1); drawn
+             from a counter-keyed RNG seeded by ``NDS_TPU_FAULT_SEED``,
+             so a chaos run replays EXACTLY from its seed
+- ``scope``  fnmatch pattern over the call's context values (the power
+             loop publishes the current query name via ``context()``);
+             ``q5`` also matches ``query5``, ``*`` matches everything
+
+Every fired fault increments the ``faults_injected_total`` metrics
+counter with the site recorded on the exception, so chaos runs are
+auditable from the report JSON alone.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import re
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+FAULTS_ENV = "NDS_TPU_FAULTS"
+SEED_ENV = "NDS_TPU_FAULT_SEED"
+
+SITES = ("plan", "device.execute", "exchange", "io.read", "stream.query")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every injected failure (always carries its site
+    so reports/classifiers can tell chaos from organic errors)."""
+
+    def __init__(self, site: str, msg: str):
+        super().__init__(msg)
+        self.site = site
+
+
+class InjectedTransientFault(InjectedFault):
+    """Injected failure the retry classifier treats as transient."""
+
+
+class InjectedOOM(InjectedTransientFault):
+    """Injected device-memory exhaustion; the message deliberately
+    carries RESOURCE_EXHAUSTED so generic OOM classification (the one
+    real jaxlib errors hit) covers it too."""
+
+
+class InjectedDeterministicFault(InjectedFault):
+    """Injected failure that must NEVER be retried (the planner-bug
+    analog)."""
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<site>[a-z_.]+):(?P<kind>[a-z]+)"
+    r"(?:=(?P<param>[0-9.]+))?"
+    r"(?:\*(?P<times>\d+))?"
+    r"(?:~(?P<prob>[0-9.]+))?"
+    r"@(?P<scope>.+)$")
+
+_KINDS = ("oom", "fault", "deterministic", "delay")
+
+
+@dataclass
+class FaultSpec:
+    """One parsed schedule entry."""
+    site: str
+    kind: str
+    scope: str
+    param: float | None = None
+    times: int | None = 1       # None = unlimited
+    prob: float = 1.0
+    index: int = 0              # position in the schedule (RNG keying)
+    fired: int = 0
+    matched: int = 0
+
+
+def parse_schedule(text: str) -> list[FaultSpec]:
+    specs: list[FaultSpec] = []
+    for i, raw in enumerate(e.strip() for e in text.split(",")):
+        if not raw:
+            continue
+        m = _ENTRY_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad {FAULTS_ENV} entry {raw!r} (expected "
+                f"site:kind[=param][*times][~prob]@scope)")
+        site, kind = m.group("site"), m.group("kind")
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} (known: {', '.join(SITES)})")
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (known: {', '.join(_KINDS)})")
+        times = m.group("times")
+        specs.append(FaultSpec(
+            site=site, kind=kind, scope=m.group("scope"),
+            param=float(m.group("param")) if m.group("param") else None,
+            times=(int(times) if times is not None
+                   else (None if kind == "delay" else 1)),
+            prob=float(m.group("prob")) if m.group("prob") else 1.0,
+            index=i))
+    return specs
+
+
+def _scope_matches(scope: str, ctx: dict) -> bool:
+    if scope == "*":
+        return True
+    patterns = [scope]
+    # `q5` is the documented shorthand for NDS query names (`query5`)
+    m = re.match(r"^q(\d.*)$", scope)
+    if m:
+        patterns.append("query" + m.group(1))
+    return any(fnmatch.fnmatchcase(str(v), p)
+               for v in ctx.values() for p in patterns)
+
+
+@dataclass
+class FaultPlan:
+    """A parsed schedule bound to a seed; owns firing bookkeeping."""
+    specs: list = field(default_factory=list)
+    seed: int = 0
+
+    def fire(self, site: str, ctx: dict) -> None:
+        for spec in self.specs:
+            if spec.site != site or not _scope_matches(spec.scope, ctx):
+                continue
+            if spec.times is not None and spec.fired >= spec.times:
+                continue
+            spec.matched += 1
+            if spec.prob < 1.0:
+                # counter-keyed draw: replaying the same schedule+seed
+                # over the same call sequence reproduces bit-for-bit
+                # (bytes seeding is version-stable; tuple seeding would
+                # go through the salted str hash)
+                key = f"{self.seed}:{site}:{spec.index}:{spec.matched}"
+                if random.Random(key.encode()).random() >= spec.prob:
+                    continue
+            spec.fired += 1
+            self._act(spec, site, ctx)
+
+    @staticmethod
+    def _act(spec: FaultSpec, site: str, ctx: dict) -> None:
+        from nds_tpu.obs import metrics as obs_metrics
+        obs_metrics.counter("faults_injected_total").inc()
+        where = f"site={site}" + (
+            f" query={ctx['query']}" if ctx.get("query") else "")
+        if spec.kind == "delay":
+            time.sleep(spec.param or 0.0)
+            return
+        if spec.kind == "oom":
+            raise InjectedOOM(
+                site, f"injected RESOURCE_EXHAUSTED: out of memory "
+                      f"({where})")
+        if spec.kind == "deterministic":
+            raise InjectedDeterministicFault(
+                site, f"injected deterministic fault ({where})")
+        raise InjectedTransientFault(
+            site, f"injected transient fault ({where})")
+
+
+# programmatic plan (tests / chaos_check) beats the env-derived one;
+# the env plan caches on the (schedule, seed) STRINGS so fault_point
+# stays two dict lookups + a compare when nothing changed (and a no-op
+# when unset) — keying on the schedule alone would silently ignore a
+# changed seed and leak fired-counts across in-process runs
+_installed: FaultPlan | None = None
+_env_cache: tuple[tuple | None, FaultPlan | None] = (None, None)
+_suppressed = 0
+_ctx = threading.local()
+
+
+def install(schedule: str, seed: int = 0) -> FaultPlan:
+    """Activate a schedule programmatically (wins over the env var).
+    Returns the plan so callers can inspect firing counts."""
+    global _installed
+    _installed = FaultPlan(parse_schedule(schedule), seed)
+    return _installed
+
+
+def clear() -> None:
+    """Drop the programmatic plan AND the env cache (tests)."""
+    global _installed, _env_cache
+    _installed = None
+    _env_cache = (None, None)
+
+
+def _current_plan() -> FaultPlan | None:
+    if _installed is not None:
+        return _installed
+    global _env_cache
+    text = os.environ.get(FAULTS_ENV)
+    if not text:
+        return None
+    key = (text, os.environ.get(SEED_ENV, "0"))
+    if key != _env_cache[0]:
+        _env_cache = (key, FaultPlan(parse_schedule(text),
+                                     int(key[1])))
+    return _env_cache[1]
+
+
+@contextmanager
+def context(**kv):
+    """Publish call-site context (e.g. the current query name) to every
+    fault_point fired inside the block; thread-local, nestable."""
+    prev = getattr(_ctx, "d", {})
+    _ctx.d = {**prev, **kv}
+    try:
+        yield
+    finally:
+        _ctx.d = prev
+
+
+@contextmanager
+def suppress():
+    """Disable firing inside the block (warmup passes must not consume
+    a timed query's fault budget)."""
+    global _suppressed
+    _suppressed += 1
+    try:
+        yield
+    finally:
+        _suppressed -= 1
+
+
+def fault_point(site: str, **info) -> None:
+    """Inline injection site: no-op unless an active schedule matches.
+
+    ``info`` extends the thread-local context for scope matching (e.g.
+    ``fault_point("io.read", table=name)``)."""
+    plan = _current_plan()
+    if plan is None or _suppressed:
+        return
+    plan.fire(site, {**getattr(_ctx, "d", {}), **info})

@@ -1,0 +1,97 @@
+"""Phase journal: crash-resumable whole-benchmark orchestration.
+
+Execution Templates (PAPERS.md) makes the case that long-running cloud
+query workloads need cheap recovery from PARTIAL failure — re-running
+a finished three-hour load phase because throughput round 2 crashed is
+the whole-run-restart anti-pattern. The orchestrator
+(``nds/bench.py``) records each completed phase here, with the
+timings the composite metric needs, into ``bench_state.json``;
+``--resume`` replays completed phases from the journal instead of
+re-running them, so a crash costs only the phase it interrupted.
+
+The journal is guarded by a digest of the bench config: resuming
+under a DIFFERENT config would splice timings from two different
+workloads into one metric, so a mismatch refuses loudly. Writes are
+atomic (tmp + rename) — a crash mid-write leaves the previous valid
+journal, never a torn one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+
+class JournalMismatch(RuntimeError):
+    """The on-disk journal belongs to a different bench config."""
+
+
+def config_digest(cfg: dict) -> str:
+    """Stable fingerprint of the bench config (sorted-key JSON)."""
+    blob = json.dumps(cfg, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class PhaseJournal:
+    """Completed-phase record keyed by phase name.
+
+    ``complete(name, **timings)`` journals a finished phase;
+    ``done(name)`` / ``timings(name)`` replay it on resume."""
+
+    VERSION = 1
+
+    def __init__(self, path: str, digest: str | None = None):
+        self.path = path
+        self.digest = digest
+        self.state: dict = {"version": self.VERSION,
+                            "config_digest": digest, "phases": {}}
+
+    def load(self) -> bool:
+        """Read the journal if present; returns True when prior state
+        exists. Raises JournalMismatch when it was written under a
+        different config digest."""
+        if not os.path.exists(self.path):
+            return False
+        with open(self.path) as f:
+            state = json.load(f)
+        recorded = state.get("config_digest")
+        if (self.digest is not None and recorded is not None
+                and recorded != self.digest):
+            raise JournalMismatch(
+                f"{self.path} was written for config {recorded}, "
+                f"current config is {self.digest} — refusing to splice "
+                f"timings across configs (delete it to start over)")
+        self.state = state
+        self.state.setdefault("phases", {})
+        return bool(self.state["phases"])
+
+    def done(self, name: str) -> bool:
+        return name in self.state["phases"]
+
+    def timings(self, name: str) -> dict:
+        entry = self.state["phases"].get(name, {})
+        return dict(entry.get("timings", {}))
+
+    def complete(self, name: str, **timings) -> None:
+        self.state["phases"][name] = {
+            "completed_at": time.time(),
+            "timings": timings,
+        }
+        self.write()
+
+    def write(self) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self.state, f, indent=2)
+        os.replace(tmp, self.path)
+
+    def reset(self) -> None:
+        """Fresh-run entry: drop any prior state on disk (a non-resume
+        run must not leave a stale journal a LATER --resume could
+        replay)."""
+        self.state = {"version": self.VERSION,
+                      "config_digest": self.digest, "phases": {}}
+        self.write()

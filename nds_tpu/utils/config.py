@@ -25,6 +25,17 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             the device in chunks instead of uploading
                             whole (out-of-core path; 0 = off)
   engine.chunk_rows         rows per streamed chunk
+  engine.retry.max_attempts per-query attempt cap for transient
+                            failures (resilience layer; default 3)
+  engine.retry.base_delay_s / engine.retry.max_delay_s /
+  engine.retry.jitter / engine.retry.seed
+                            exponential-backoff shape (seeded jitter:
+                            chaos runs replay exactly)
+  engine.query_deadline_s   per-query wall-clock deadline (0/unset =
+                            none); overruns are flagged and counted
+  engine.fallback           "cpu" -> after repeated transient device
+                            failures the remaining stream runs on the
+                            CPU executor instead of aborting
 """
 
 from __future__ import annotations

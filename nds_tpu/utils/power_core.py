@@ -16,6 +16,15 @@ tree — engine compile/execute/materialize and staged sub-programs
 included — is attached to the JSON summary (``spans``) together with
 the per-query metrics delta (``metrics``); ``NDS_TPU_TRACE=path``
 additionally appends every tree to a Chrome trace-event JSONL.
+
+Resilience: the query body runs under ``resilience.retry.RetryPolicy``
+(``engine.retry.*`` / ``engine.query_deadline_s`` config keys) —
+transient failures (device OOM, exchange overflow, injected chaos)
+retry with backoff, deterministic parse/plan errors fail fast; the
+per-query summary records ``retries`` / ``gave_up_reason`` /
+``deadline_exceeded``. ``engine.fallback=cpu`` demotes the remaining
+stream to the CPU oracle after repeated device failures. Fault
+injection context (``NDS_TPU_FAULTS``) carries the query name.
 """
 
 from __future__ import annotations
@@ -28,9 +37,15 @@ from nds_tpu import obs
 from nds_tpu.engine.session import Session
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
+from nds_tpu.resilience import faults
+from nds_tpu.resilience.retry import RetryPolicy, RetryStats
 from nds_tpu.utils.config import EngineConfig
 from nds_tpu.utils.report import BenchReport
 from nds_tpu.utils.timelog import TimeLog
+
+# consecutive transiently-failed queries before the engine.fallback=cpu
+# demotion engages (one flaky query should not abandon the accelerator)
+FALLBACK_AFTER = 2
 
 
 @dataclass
@@ -165,6 +180,21 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
     return timings
 
 
+def _fallback_safe(backend: str) -> bool:
+    """engine.fallback=cpu must never engage on a multi-process SPMD
+    run: the demotion is rank-local, and a demoted rank stops
+    participating in the compiled programs' cross-host collectives —
+    every OTHER rank would block forever inside the next all_to_all.
+    Single-process backends (single chip, virtual mesh) demote freely."""
+    if backend != "distributed":
+        return True
+    try:
+        import jax
+        return jax.process_count() == 1
+    except Exception:  # jax unavailable: nothing to demote from anyway
+        return True
+
+
 def run_one_query(session: Session, sql: str, qname: str = "",
                   output_prefix: str | None = None):
     result = session.sql(sql)
@@ -224,21 +254,27 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         jax.profiler.start_trace(profile_dir)
         profiler_cm = True
     failures = 0
+    policy = RetryPolicy.from_config(config)
+    fallback = config.get("engine.fallback")
+    device_failure_streak = 0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
         if warmup and not qname.startswith(suite.warmup_skip_prefixes):
             # span recording off during warmup: untimed passes would
             # otherwise append orphan root trees to the Chrome trace,
-            # uncorrelated with any CSV row
+            # uncorrelated with any CSV row. Fault injection is
+            # suppressed too — warmup must not consume the timed
+            # query's fault budget
             wtracer = get_tracer()
             was_enabled = wtracer.enabled
             wtracer.enabled = False
             try:
-                for _ in range(warmup):
-                    try:
-                        run_one_query(session, sql)
-                    except Exception:
-                        break
+                with faults.suppress():
+                    for _ in range(warmup):
+                        try:
+                            run_one_query(session, sql)
+                        except Exception:
+                            break
             finally:
                 wtracer.enabled = was_enabled
         report = BenchReport(qname, config.as_dict())
@@ -257,13 +293,20 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         tracer = get_tracer()
         qhold: dict = {}
         metrics_before = obs_metrics.snapshot()
+        rstats = RetryStats()
 
         def traced_query(session, sql, _q=qname, _o=out_pref,
-                         _h=qhold):
+                         _h=qhold, _st=rstats):
+            # the retry loop nests INSIDE the query span: queryTimes /
+            # the TimeLog row bill the retries and backoff to the query
+            # that needed them, exactly like a Spark task retry bills
+            # its stage
             with tracer.span("query", query=_q, suite=suite.name,
                              backend=backend) as sp:
                 _h["span"] = sp
-                return run_one_query(session, sql, _q, _o)
+                with faults.context(query=_q):
+                    return policy.call(run_one_query, session, sql,
+                                       _q, _o, stats=_st)
 
         # exports park during the bracket (even a ~ms inline write
         # would skew span totals vs the TimeLog row) and flush after
@@ -291,6 +334,7 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         qspan = qhold.get("span")
         if qspan:
             summary["spans"] = qspan.to_dict()
+        report.attach_retry(rstats)
         elapsed_ms = summary["queryTimes"][-1]
         obs_metrics.counter("queries_total").inc()
         obs_metrics.histogram("query_seconds").observe(
@@ -298,6 +342,28 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         if not report.is_success():
             failures += 1
             obs_metrics.counter("query_failures_total").inc()
+            # engine.fallback=cpu: repeated TRANSIENT-exhausted device
+            # failures (never deterministic planner bugs) demote the
+            # remaining stream to the CPU oracle — degraded numbers
+            # beat an abandoned run
+            if (rstats.gave_up_reason
+                    and rstats.gave_up_reason != "deterministic"):
+                device_failure_streak += 1
+                if (fallback == "cpu" and backend != "cpu"
+                        and device_failure_streak >= FALLBACK_AFTER
+                        and _fallback_safe(backend)):
+                    from nds_tpu.engine.cpu_exec import CpuExecutor
+                    session._executor_factory = (
+                        lambda tables: CpuExecutor(tables))
+                    obs_metrics.counter("engine_fallbacks_total").inc()
+                    fallback = None  # one-shot demotion
+                    print(f"ENGINE FALLBACK: {device_failure_streak} "
+                          f"consecutive device failures — remaining "
+                          f"queries run on the CPU executor")
+            else:
+                device_failure_streak = 0
+        else:
+            device_failure_streak = 0
         mdelta = obs_metrics.delta(metrics_before,
                                    obs_metrics.snapshot())
         if mdelta:
@@ -306,12 +372,8 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         print(f"====== Run {qname} ======")
         print(f"Time taken: {elapsed_ms} millis for {qname}")
         if json_summary_folder and primary:
-            cwd = os.getcwd()
-            os.chdir(json_summary_folder)
-            try:
-                report.write_summary(prefix=f"power-{app_id}")
-            finally:
-                os.chdir(cwd)
+            report.write_summary(prefix=f"power-{app_id}",
+                                 out_dir=json_summary_folder)
     if profiler_cm:
         import jax
         jax.profiler.stop_trace()

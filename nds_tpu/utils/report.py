@@ -16,13 +16,16 @@ Schema additions over the reference format (README "Observability"):
 the power loop attaches ``spans`` (the per-query span tree from
 nds_tpu/obs/trace.py) and ``metrics`` (the per-query delta of the
 global counter registry) to each summary; both are absent when the
-corresponding subsystem recorded nothing.
+corresponding subsystem recorded nothing. The resilience layer
+(README "Resilience") adds ``retries`` plus, when set,
+``gave_up_reason`` and ``deadline_exceeded`` via ``attach_retry``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import traceback
 from typing import Callable
@@ -52,16 +55,33 @@ class TaskFailureCollector:
     """
 
     _active: list["TaskFailureCollector"] = []
+    # concurrent throughput streams notify from their own threads; the
+    # class-level listener list and each listener's failure store must
+    # not race (lost appends silently under-report anomalies)
+    _lock = threading.Lock()
 
     def __init__(self) -> None:
+        # ordered UNIQUE reasons; repeats count in _counts so a noisy
+        # anomaly (the same overflow retried 50 times) is one summary
+        # line with a multiplier, not 50 identical lines
         self.failures: list[str] = []
+        self._counts: dict[str, int] = {}
 
     def register(self) -> None:
-        TaskFailureCollector._active.append(self)
+        with TaskFailureCollector._lock:
+            TaskFailureCollector._active.append(self)
 
     def unregister(self) -> None:
-        if self in TaskFailureCollector._active:
-            TaskFailureCollector._active.remove(self)
+        with TaskFailureCollector._lock:
+            if self in TaskFailureCollector._active:
+                TaskFailureCollector._active.remove(self)
+
+    def formatted(self) -> list[str]:
+        """Unique reasons in first-seen order, deduplicated repeats
+        annotated with their count."""
+        with TaskFailureCollector._lock:
+            return [r if self._counts[r] == 1 else
+                    f"{r} (x{self._counts[r]})" for r in self.failures]
 
     @classmethod
     def notify(cls, reason: str) -> None:
@@ -72,8 +92,13 @@ class TaskFailureCollector:
         registered (warmups, direct executor use)."""
         from nds_tpu.obs import metrics as obs_metrics
         obs_metrics.counter("task_failures_total").inc()
-        for listener in cls._active:
-            listener.failures.append(reason)
+        with cls._lock:
+            for listener in cls._active:
+                if reason in listener._counts:
+                    listener._counts[reason] += 1
+                else:
+                    listener._counts[reason] = 1
+                    listener.failures.append(reason)
 
 
 class BenchReport:
@@ -94,7 +119,7 @@ class BenchReport:
         }
         self._engine_info = engine_info or {}
 
-    def _capture_env(self) -> None:
+    def capture_env(self) -> None:
         self.summary["env"]["envVars"] = redact_env(dict(os.environ))
         conf = dict(self._engine_info)
         try:
@@ -141,7 +166,7 @@ class BenchReport:
         Statuses: Completed | CompletedWithTaskFailures | Failed — the same
         vocabulary the reference emits (`PysparkBenchReport.py:90-103`).
         """
-        self._capture_env()
+        self.capture_env()
         collector = TaskFailureCollector()
         collector.register()
         start_time = int(time.time() * 1000)
@@ -150,7 +175,7 @@ class BenchReport:
             end_time = int(time.time() * 1000)
             if collector.failures:
                 self.summary["queryStatus"].append("CompletedWithTaskFailures")
-                self.summary["exceptions"].extend(collector.failures)
+                self.summary["exceptions"].extend(collector.formatted())
             else:
                 self.summary["queryStatus"].append("Completed")
         except Exception as e:
@@ -166,14 +191,34 @@ class BenchReport:
         self.summary["queryTimes"].append(end_time - start_time)
         return self.summary
 
-    def write_summary(self, prefix: str = "") -> str:
+    def attach_retry(self, stats) -> None:
+        """Record a resilience.retry.RetryStats into the summary:
+        ``retries`` always (0 is meaningful — the query needed no
+        recovery), ``gave_up_reason`` / ``deadline_exceeded`` only
+        when set (README "Resilience" schema)."""
+        self.summary["retries"] = stats.retries
+        if stats.retries:
+            # how much of the query's wall clock was backoff, so a
+            # retried query's TimeLog row can be decomposed
+            self.summary["retry_backoff_s"] = round(stats.backoff_s, 3)
+        if stats.gave_up_reason:
+            self.summary["gave_up_reason"] = stats.gave_up_reason
+        if stats.deadline_exceeded:
+            self.summary["deadline_exceeded"] = True
+
+    def write_summary(self, prefix: str = "",
+                      out_dir: str | None = None) -> str:
         """Write '{prefix}-{query}-{startTime}.json' (reference filename
-        contract, `PysparkBenchReport.py:117-119`) and return the path."""
+        contract, `PysparkBenchReport.py:117-119`), into ``out_dir``
+        when given (the recorded ``filename`` stays bare either way),
+        and return the written path."""
         filename = f"{prefix}-{self.summary['query']}-{self.summary['startTime']}.json"
         self.summary["filename"] = filename
-        with open(filename, "w") as f:
+        path = (os.path.join(out_dir, filename) if out_dir
+                else filename)
+        with open(path, "w") as f:
             json.dump(self.summary, f, indent=2)
-        return filename
+        return path
 
     def is_success(self) -> bool:
         return self.summary["queryStatus"] == ["Completed"]

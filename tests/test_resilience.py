@@ -1,0 +1,820 @@
+"""Resilience layer tests: seeded fault injection, failure
+classification, RetryPolicy backoff/deadline semantics, the power-loop
+retry + fallback integration, thread-safe failure collection, the
+NDS108 naked-retry lint rule, the resumable bench journal, chunked-
+executor OOM degradation, and throughput stream failure reports."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from nds_tpu.analysis import lint_rules
+from nds_tpu.nds import gen_data, streams
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.resilience import faults
+from nds_tpu.resilience.journal import (
+    JournalMismatch, PhaseJournal, config_digest,
+)
+from nds_tpu.resilience.retry import (
+    DETERMINISTIC, TRANSIENT, RetryPolicy, RetryStats, classify, is_oom,
+)
+from nds_tpu.utils import power_core
+from nds_tpu.utils.config import EngineConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def mini_wh(tmp_path_factory):
+    """Tiny raw NDS warehouse + a 3-query stream (raw format: the
+    power loop reads .dat directly, no transcode needed)."""
+    root = tmp_path_factory.mktemp("resilience")
+    raw = str(root / "raw")
+    gen_data.generate_data_local(0.01, 2, raw, workers=2)
+    sdir = str(root / "streams")
+    streams.generate_query_streams(sdir, 1, templates=[96, 7, 93])
+    return {"raw": raw, "stream": os.path.join(sdir, "query_0.sql"),
+            "root": str(root)}
+
+
+# ------------------------------------------------------- fault harness
+
+class TestFaultSchedule:
+    def test_parse_full_syntax(self):
+        specs = faults.parse_schedule(
+            "device.execute:oom@q5,io.read:delay=0.2@*,"
+            "exchange:fault*3~0.5@query1*")
+        assert [s.site for s in specs] == ["device.execute", "io.read",
+                                          "exchange"]
+        assert specs[0].times == 1          # raising kinds default once
+        assert specs[1].times is None       # delay defaults unlimited
+        assert specs[1].param == 0.2
+        assert specs[2].times == 3 and specs[2].prob == 0.5
+
+    @pytest.mark.parametrize("bad", [
+        "nonsense", "plan:oom",             # missing scope
+        "bogus.site:oom@*",                 # unknown site
+        "plan:explode@*",                   # unknown kind
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_schedule(bad)
+
+    def test_scope_q_alias_and_fnmatch(self):
+        assert faults._scope_matches("q5", {"query": "query5"})
+        assert not faults._scope_matches("q5", {"query": "query55"})
+        assert faults._scope_matches("query5*", {"query": "query55"})
+        assert faults._scope_matches("*", {})
+        assert faults._scope_matches("store_*", {"table": "store_sales"})
+
+    def test_times_budget_lets_retry_succeed(self):
+        faults.install("plan:oom@*")
+        with pytest.raises(faults.InjectedOOM):
+            faults.fault_point("plan")
+        faults.fault_point("plan")  # budget spent: the retry passes
+
+    def test_context_and_suppress(self):
+        faults.install("device.execute:fault@q7")
+        faults.fault_point("device.execute")  # no context: no match
+        with faults.context(query="query7"):
+            with faults.suppress():
+                faults.fault_point("device.execute")  # warmup analog
+            with pytest.raises(faults.InjectedTransientFault):
+                faults.fault_point("device.execute")
+
+    def test_probability_replays_from_seed(self):
+        def firing_pattern(seed):
+            plan = faults.install("plan:fault*999~0.4@*", seed=seed)
+            fired = []
+            for _ in range(40):
+                try:
+                    faults.fault_point("plan")
+                    fired.append(0)
+                except faults.InjectedTransientFault:
+                    fired.append(1)
+            faults.clear()
+            return fired, plan.specs[0].fired
+
+        a, na = firing_pattern(3)
+        b, nb = firing_pattern(3)
+        c, _ = firing_pattern(4)
+        assert a == b and na == nb      # exact replay from the seed
+        assert 0 < na < 40              # probabilistic, not all-or-none
+        assert a != c                   # the seed actually matters
+
+    def test_env_schedule_and_zero_cost_unset(self, monkeypatch):
+        monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+        faults.clear()
+        faults.fault_point("plan")      # unset: pure no-op
+        monkeypatch.setenv(faults.FAULTS_ENV, "plan:deterministic@*")
+        with pytest.raises(faults.InjectedDeterministicFault):
+            faults.fault_point("plan")
+
+    def test_env_seed_change_rebuilds_plan(self, monkeypatch):
+        """The env cache keys on (schedule, seed): changing only the
+        seed must rebuild the plan (fresh fired-counts, new RNG)."""
+        monkeypatch.setenv(faults.FAULTS_ENV, "plan:fault*999~0.5@*")
+        monkeypatch.setenv(faults.SEED_ENV, "1")
+        faults.clear()
+
+        def pattern():
+            fired = []
+            for _ in range(30):
+                try:
+                    faults.fault_point("plan")
+                    fired.append(0)
+                except faults.InjectedTransientFault:
+                    fired.append(1)
+            return fired
+
+        a = pattern()
+        monkeypatch.setenv(faults.SEED_ENV, "2")
+        b = pattern()
+        assert a != b                   # new seed actually took effect
+        monkeypatch.setenv(faults.SEED_ENV, "1")
+        assert pattern() == a           # and replays exactly again
+
+
+# ------------------------------------------------------ classification
+
+class TestClassify:
+    def test_vocabulary(self):
+        assert classify(faults.InjectedOOM("x", "boom")) == TRANSIENT
+        assert classify(
+            faults.InjectedTransientFault("x", "boom")) == TRANSIENT
+        assert classify(
+            faults.InjectedDeterministicFault("x", "boom")) \
+            == DETERMINISTIC
+        # jaxlib's device-OOM message shape
+        assert classify(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1 bytes")) \
+            == TRANSIENT
+        from nds_tpu.engine.device_exec import DeviceExecError
+        assert classify(DeviceExecError(
+            "exchange overflow persisted after retries")) == TRANSIENT
+        # parse/plan/verify-style errors never retry
+        assert classify(ValueError("no such column")) == DETERMINISTIC
+        assert classify(KeyError("tbl")) == DETERMINISTIC
+
+    def test_is_oom(self):
+        assert is_oom(faults.InjectedOOM("x", "injected"))
+        assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+        assert is_oom(RuntimeError("Out of memory allocating"))
+        assert not is_oom(faults.InjectedTransientFault("x", "generic"))
+
+
+# -------------------------------------------------------- retry policy
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        kw.setdefault("sleep", lambda d: None)
+        return RetryPolicy(**kw)
+
+    def test_transient_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise faults.InjectedOOM("s", "injected oom")
+            return "ok"
+
+        st = RetryStats()
+        before = obs_metrics.snapshot()
+        assert self._policy(max_attempts=3).call(flaky, stats=st) == "ok"
+        assert st.attempts == 3 and st.retries == 2
+        assert st.gave_up_reason is None
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["query_retries_total"] == 2
+
+    def test_deterministic_never_retries(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("planner bug")
+
+        st = RetryStats()
+        with pytest.raises(ValueError):
+            self._policy(max_attempts=5).call(broken, stats=st)
+        assert len(calls) == 1 and st.retries == 0
+        assert st.gave_up_reason == "deterministic"
+
+    def test_attempt_cap_exhausts(self):
+        def always():
+            raise faults.InjectedOOM("s", "injected oom")
+
+        st = RetryStats()
+        with pytest.raises(faults.InjectedOOM):
+            self._policy(max_attempts=3).call(always, stats=st)
+        assert st.attempts == 3
+        assert st.gave_up_reason == "attempts_exhausted(3)"
+
+    def test_backoff_exponential_jittered_seeded(self):
+        p1 = self._policy(base_delay_s=0.1, max_delay_s=10.0,
+                          jitter=0.25, seed=11)
+        p2 = self._policy(base_delay_s=0.1, max_delay_s=10.0,
+                          jitter=0.25, seed=11)
+        p3 = self._policy(base_delay_s=0.1, max_delay_s=10.0,
+                          jitter=0.25, seed=12)
+        d1 = [p1.delay_for(i) for i in range(5)]
+        assert d1 == [p2.delay_for(i) for i in range(5)]  # seeded
+        assert d1 != [p3.delay_for(i) for i in range(5)]
+        for i, d in enumerate(d1):
+            base = 0.1 * 2 ** i
+            assert base <= d <= base * 1.25     # exp + bounded jitter
+        # the cap clamps the base term
+        assert self._policy(base_delay_s=1.0, max_delay_s=2.0,
+                            jitter=0.0).delay_for(6) == 2.0
+
+    def test_deadline_stops_retrying(self):
+        t = {"now": 0.0}
+
+        def clock():
+            return t["now"]
+
+        def sleep(d):
+            t["now"] += d
+
+        def always():
+            t["now"] += 1.0
+            raise faults.InjectedOOM("s", "injected oom")
+
+        st = RetryStats()
+        p = RetryPolicy(max_attempts=100, base_delay_s=0.5,
+                        jitter=0.0, deadline_s=2.0, sleep=sleep,
+                        clock=clock)
+        before = obs_metrics.snapshot()
+        with pytest.raises(faults.InjectedOOM):
+            p.call(always, stats=st)
+        assert st.gave_up_reason == "deadline"
+        assert st.deadline_exceeded
+        assert st.attempts < 100
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["query_deadline_exceeded_total"] == 1
+
+    def test_success_past_deadline_is_flagged(self):
+        t = {"now": 0.0}
+
+        def slow():
+            t["now"] += 5.0
+            return 42
+
+        st = RetryStats()
+        p = RetryPolicy(deadline_s=1.0, clock=lambda: t["now"],
+                        sleep=lambda d: None)
+        assert p.call(slow, stats=st) == 42
+        assert st.deadline_exceeded and st.gave_up_reason is None
+
+    def test_from_config(self):
+        cfg = EngineConfig(overrides={
+            "engine.retry.max_attempts": "5",
+            "engine.retry.base_delay_s": "0.5",
+            "engine.retry.max_delay_s": "9",
+            "engine.retry.jitter": "0",
+            "engine.query_deadline_s": "30",
+        })
+        p = RetryPolicy.from_config(cfg)
+        assert p.max_attempts == 5 and p.base_delay_s == 0.5
+        assert p.max_delay_s == 9 and p.deadline_s == 30.0
+        # absent/zero deadline means none
+        assert RetryPolicy.from_config(EngineConfig()).deadline_s is None
+
+    def test_attempts_iterator_sleeps_between(self):
+        slept = []
+        p = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.0,
+                        sleep=slept.append)
+        assert list(p.attempts()) == [0, 1, 2, 3]
+        assert slept == [0.1, 0.2, 0.4]
+
+    def test_with_attempts_preserves_everything_else(self):
+        slept = []
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.2,
+                        max_delay_s=7.0, jitter=0.5, deadline_s=30.0,
+                        seed=3, sleep=slept.append)
+        q = p.with_attempts(2)
+        assert q.max_attempts == 2
+        assert (q.base_delay_s, q.max_delay_s, q.jitter, q.deadline_s,
+                q.seed) == (0.2, 7.0, 0.5, 30.0, 3)
+        assert q._sleep is p._sleep and q._clock is p._clock
+
+
+# ----------------------------------------------- failure collector
+
+class TestTaskFailureCollector:
+    def test_concurrent_notify_and_dedup(self):
+        from nds_tpu.utils.report import TaskFailureCollector
+        col = TaskFailureCollector()
+        col.register()
+        try:
+            def hammer(i):
+                for _ in range(50):
+                    TaskFailureCollector.notify("overflow retry")
+                TaskFailureCollector.notify(f"unique-{i}")
+
+            threads = [threading.Thread(target=hammer, args=(i,))
+                       for i in range(8)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        finally:
+            col.unregister()
+        # deduplicated: one entry for the repeated reason + 8 uniques
+        assert col.failures.count("overflow retry") == 1
+        assert len(col.failures) == 9
+        fmt = col.formatted()
+        assert "overflow retry (x400)" in fmt
+        assert "unique-3" in fmt
+
+    def test_report_carries_dedup_counts(self):
+        from nds_tpu.utils.report import BenchReport, TaskFailureCollector
+
+        def body():
+            for _ in range(3):
+                TaskFailureCollector.notify("slack retry")
+
+        rep = BenchReport("q")
+        s = rep.report_on(body)
+        assert s["queryStatus"] == ["CompletedWithTaskFailures"]
+        assert s["exceptions"] == ["slack retry (x3)"]
+
+
+# ------------------------------------------------------ NDS108 lint
+
+def _lint(src: str, enabled=None):
+    return lint_rules.lint_sources({"nds_tpu/x.py": src},
+                                   enabled=enabled)
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+class TestNakedRetryRule:
+    def test_uncapped_while_true_flags(self):
+        src = ("import time\n"
+               "def f(op):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return op()\n"
+               "        except Exception:\n"
+               "            time.sleep(1)\n")
+        assert _rules(_lint(src, enabled={"NDS108"}).violations) \
+            == {"NDS108"}
+
+    def test_constant_sleep_in_capped_loop_flags(self):
+        src = ("import time\n"
+               "def f(op):\n"
+               "    for i in range(5):\n"
+               "        try:\n"
+               "            return op()\n"
+               "        except Exception:\n"
+               "            time.sleep(0.5)\n")
+        assert _rules(_lint(src, enabled={"NDS108"}).violations) \
+            == {"NDS108"}
+
+    def test_backoff_and_cap_is_clean(self):
+        src = ("import time\n"
+               "def f(op):\n"
+               "    delay = 0.1\n"
+               "    for i in range(5):\n"
+               "        try:\n"
+               "            return op()\n"
+               "        except Exception:\n"
+               "            time.sleep(delay)\n"
+               "            delay *= 2\n")
+        assert _lint(src, enabled={"NDS108"}).violations == []
+
+    def test_loop_without_sleep_is_clean(self):
+        src = ("def f(op):\n"
+               "    for i in range(3):\n"
+               "        try:\n"
+               "            return op()\n"
+               "        except Exception:\n"
+               "            pass\n")
+        assert _lint(src, enabled={"NDS108"}).violations == []
+
+    def test_waiver_applies(self):
+        # the standalone waiver covers the next line (the flagged
+        # `while True`)
+        src = ("import time\n"
+               "def f(op):\n"
+               "    # ndslint: waive[NDS108] -- external rate limit "
+               "mandates a fixed poll interval\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return op()\n"
+               "        except Exception:\n"
+               "            time.sleep(1)\n")
+        res = _lint(src, enabled={"NDS108"})
+        assert res.violations == [] and len(res.waived) == 1
+
+    def test_in_default_rules(self):
+        assert any(r.id == "NDS108"
+                   for r in lint_rules.default_rules())
+
+
+# ------------------------------------------------------ phase journal
+
+class TestPhaseJournal:
+    def test_round_trip_and_digest_guard(self, tmp_path):
+        path = str(tmp_path / "bench_state.json")
+        dg = config_digest({"scale": 1})
+        j = PhaseJournal(path, dg)
+        j.reset()
+        j.complete("load_test", load_time_s=5.5, rngseed=99)
+        j2 = PhaseJournal(path, dg)
+        assert j2.load()
+        assert j2.done("load_test") and not j2.done("power_test")
+        assert j2.timings("load_test") == {"load_time_s": 5.5,
+                                           "rngseed": 99}
+        with pytest.raises(JournalMismatch):
+            PhaseJournal(path, config_digest({"scale": 2})).load()
+
+    def test_reset_drops_prior_state(self, tmp_path):
+        path = str(tmp_path / "bench_state.json")
+        j = PhaseJournal(path, "d")
+        j.complete("power_test", power_time_s=1.0)
+        j.reset()
+        j2 = PhaseJournal(path, "d")
+        assert not j2.load()
+
+    def test_write_is_atomic(self, tmp_path):
+        path = str(tmp_path / "bench_state.json")
+        j = PhaseJournal(path, "d")
+        j.complete("a", x=1)
+        assert not os.path.exists(path + ".tmp")
+        assert json.load(open(path))["phases"]["a"]["timings"] == {"x": 1}
+
+    def test_missing_file_loads_empty(self, tmp_path):
+        assert not PhaseJournal(str(tmp_path / "nope.json"), "d").load()
+
+
+# --------------------------------------- power loop integration (cpu)
+
+def _run_stream(mini_wh, tmp_path, overrides=None, subset=None,
+                warmup=0):
+    from nds_tpu.nds.power import SUITE
+    cfg = EngineConfig(overrides={"engine.backend": "cpu",
+                                  "engine.retry.base_delay_s": "0.01",
+                                  **(overrides or {})})
+    jsons = str(tmp_path / "json")
+    failures = power_core.run_query_stream(
+        SUITE, mini_wh["raw"], mini_wh["stream"],
+        str(tmp_path / "time.csv"), config=cfg, input_format="raw",
+        json_summary_folder=jsons, query_subset=subset, warmup=warmup)
+    summaries = {}
+    for f in os.listdir(jsons):
+        with open(os.path.join(jsons, f)) as fh:
+            s = json.load(fh)
+        summaries[s["query"]] = s
+    return failures, summaries
+
+
+class TestPowerLoopResilience:
+    def test_transient_oom_retried_to_completion(self, mini_wh,
+                                                 tmp_path):
+        faults.install("device.execute:oom@query7")
+        failures, sums = _run_stream(mini_wh, tmp_path)
+        assert failures == 0
+        assert sums["query7"]["queryStatus"] == ["Completed"]
+        assert sums["query7"]["retries"] == 1
+        assert sums["query7"]["retry_backoff_s"] > 0
+        assert sums["query96"]["retries"] == 0
+
+    def test_plan_fault_fails_fast(self, mini_wh, tmp_path):
+        faults.install("plan:deterministic@query96")
+        failures, sums = _run_stream(mini_wh, tmp_path)
+        assert failures == 1
+        s = sums["query96"]
+        assert s["queryStatus"] == ["Failed"]
+        assert s["retries"] == 0
+        assert s["gave_up_reason"] == "deterministic"
+        assert any("injected deterministic" in e
+                   for e in s["exceptions"])
+        # the stream kept going past the failure
+        assert sums["query7"]["queryStatus"] == ["Completed"]
+
+    def test_plan_fault_fires_despite_warmup_plan_cache(self, mini_wh,
+                                                        tmp_path):
+        """The suppressed warmup pass plans and CACHES the query; the
+        timed pass takes the plan-cache hit — the plan chaos site must
+        still fire there (Session fires it on cache hits too)."""
+        faults.install("plan:deterministic@query96")
+        failures, sums = _run_stream(mini_wh, tmp_path,
+                                     subset=["query96"], warmup=1)
+        assert failures == 1
+        assert sums["query96"]["queryStatus"] == ["Failed"]
+        assert sums["query96"]["gave_up_reason"] == "deterministic"
+
+    def test_query_deadline_flagged(self, mini_wh, tmp_path):
+        before = obs_metrics.snapshot()
+        failures, sums = _run_stream(
+            mini_wh, tmp_path,
+            overrides={"engine.query_deadline_s": "0.000001"},
+            subset=["query96"])
+        assert failures == 0
+        assert sums["query96"]["deadline_exceeded"] is True
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["query_deadline_exceeded_total"] >= 1
+
+    def test_fallback_to_cpu_after_repeated_device_failure(
+            self, mini_wh, tmp_path):
+        # tpu backend on the virtual-CPU mesh: both early queries
+        # exhaust their attempts on injected OOM, the streak trips
+        # engine.fallback=cpu, and the LAST query completes on the
+        # CPU oracle
+        faults.install("device.execute:oom*99@query96,"
+                       "device.execute:oom*99@query7")
+        before = obs_metrics.snapshot()
+        failures, sums = _run_stream(
+            mini_wh, tmp_path,
+            overrides={"engine.backend": "tpu",
+                       "engine.fallback": "cpu"})
+        assert failures == 2
+        assert sums["query96"]["gave_up_reason"] == \
+            "attempts_exhausted(3)"
+        assert sums["query7"]["gave_up_reason"] == \
+            "attempts_exhausted(3)"
+        assert sums["query93"]["queryStatus"] == ["Completed"]
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["engine_fallbacks_total"] == 1
+
+    def test_allow_failure_exit_code_contract(self, mini_wh, tmp_path,
+                                              monkeypatch):
+        """--allow_failure end-to-end through the driver main: one
+        injected deterministic failure exits 1 without the flag, 0
+        with it, and the TimeLog CSV carries every query either way."""
+        from nds_tpu.nds.power import main
+        from nds_tpu.utils.timelog import TimeLog
+        monkeypatch.setenv(faults.FAULTS_ENV,
+                           "plan:deterministic@query96")
+        faults.clear()  # drop any cached env plan
+
+        def drive(tag, *extra):
+            tlog = str(tmp_path / f"{tag}.csv")
+            jsons = str(tmp_path / f"json_{tag}")
+            with pytest.raises(SystemExit) as ei:
+                main([mini_wh["raw"], mini_wh["stream"], tlog,
+                      "--backend", "cpu", "--input_format", "raw",
+                      "--json_summary_folder", jsons, *extra])
+            names = [q for _a, q, _ms in TimeLog.read(tlog)]
+            failed = 0
+            for f in os.listdir(jsons):
+                with open(os.path.join(jsons, f)) as fh:
+                    if json.load(fh)["queryStatus"] == ["Failed"]:
+                        failed += 1
+            return ei.value.code, names, failed
+
+        faults.clear()
+        code, names, failed = drive("strict")
+        assert code == 1 and failed == 1
+        assert {"query96", "query7", "query93"} <= set(names)
+        faults.clear()  # fresh budget for the second run
+        code, names, failed = drive("lenient", "--allow_failure")
+        assert code == 0 and failed == 1
+        assert {"query96", "query7", "query93"} <= set(names)
+
+
+# ------------------------------------------- chunked OOM degradation
+
+def _chunked_session(mini_wh, chunk_rows):
+    from nds_tpu.engine.chunked_exec import make_chunked_factory
+    from nds_tpu.engine.session import Session
+    from nds_tpu.io import csv_io
+    from nds_tpu.nds.schema import get_schemas
+
+    schema = get_schemas()["store_sales"]
+    paths = [os.path.join(mini_wh["raw"], "store_sales", f)
+             for f in sorted(os.listdir(
+                 os.path.join(mini_wh["raw"], "store_sales")))]
+    table = csv_io.read_tbl(paths, "store_sales", schema)
+    sess = Session.for_nds(
+        make_chunked_factory(stream_bytes=1, chunk_rows=chunk_rows))
+    sess.register_table(table)
+    return sess, table
+
+
+def test_chunked_executor_halves_chunks_on_oom(mini_wh):
+    sess, table = _chunked_session(mini_wh, chunk_rows=1 << 14)
+    before = obs_metrics.snapshot()
+    faults.install("device.execute:oom*2@*")
+    res = sess.sql("select count(*) c from store_sales").to_pandas()
+    assert int(res["c"][0]) == table.nrows
+    ex = sess._executor_factory(sess.tables)
+    # two OOMs -> two halvings before the third attempt succeeded
+    assert ex.chunk_rows == 1 << 12
+    d = obs_metrics.delta(before, obs_metrics.snapshot())
+    assert d["counters"]["chunk_shrink_total"] == 2
+
+
+def test_chunked_oom_at_floor_falls_back_to_full_upload(mini_wh):
+    """With chunk_rows already at the halving floor, a partial-agg OOM
+    must fall back to the full-upload phase B (the pre-resilience
+    behavior), not fail the query."""
+    sess, table = _chunked_session(mini_wh, chunk_rows=1 << 12)
+    before = obs_metrics.snapshot()
+    faults.install("device.execute:oom@*")
+    res = sess.sql("select count(*) c from store_sales").to_pandas()
+    assert int(res["c"][0]) == table.nrows
+    ex = sess._executor_factory(sess.tables)
+    assert ex.chunk_rows == 1 << 12     # no halving happened
+    d = obs_metrics.delta(before, obs_metrics.snapshot())
+    assert "chunk_shrink_total" not in d.get("counters", {})
+
+
+# --------------------------------------- throughput stream reports
+
+class TestThroughputResilience:
+    @pytest.fixture(scope="class")
+    def tstreams(self, mini_wh, tmp_path_factory):
+        sdir = str(tmp_path_factory.mktemp("tstreams"))
+        return streams.generate_query_streams(
+            sdir, 2, rng_seed=7, templates=[96, 7],
+            qualification=False)
+
+    def _reports(self, out):
+        reps = {}
+        for f in os.listdir(out):
+            if f.endswith(".json"):
+                with open(os.path.join(out, f)) as fh:
+                    s = json.load(fh)
+                reps[s["query"]] = s
+        return reps
+
+    def test_clean_run_writes_stream_reports(self, mini_wh, tstreams,
+                                             tmp_path):
+        from nds_tpu.nds.throughput import run_streams_inprocess
+        out = str(tmp_path / "tp")
+        elapse, fails = run_streams_inprocess(
+            mini_wh["raw"], tstreams, out, backend="cpu",
+            input_format="raw")
+        assert fails == [0, 0]
+        reps = self._reports(out)
+        assert set(reps) == {"query_0", "query_1"}
+        for r in reps.values():
+            assert r["queryStatus"] == ["Completed"] * 2
+            assert r["exceptions"] == [] and r["retries"] == 0
+
+    def test_transient_fault_retried_in_stream(self, mini_wh,
+                                               tstreams, tmp_path):
+        from nds_tpu.nds.throughput import run_streams_inprocess
+        faults.install("device.execute:oom@query7")
+        out = str(tmp_path / "tp")
+        _elapse, fails = run_streams_inprocess(
+            mini_wh["raw"], tstreams, out, backend="cpu",
+            input_format="raw")
+        assert fails == [0, 0]
+        reps = self._reports(out)
+        assert sum(r["retries"] for r in reps.values()) == 1
+        for r in reps.values():
+            assert r["queryStatus"] == ["Completed"] * 2
+
+    def test_failure_text_lands_in_stream_report(self, mini_wh,
+                                                 tstreams, tmp_path):
+        from nds_tpu.nds.throughput import run_streams_inprocess
+        faults.install("plan:deterministic@query96")
+        out = str(tmp_path / "tp")
+        _elapse, fails = run_streams_inprocess(
+            mini_wh["raw"], tstreams, out, backend="cpu",
+            input_format="raw")
+        assert sum(fails) == 1
+        reps = self._reports(out)
+        failed = [r for r in reps.values() if "Failed" in
+                  r["queryStatus"]]
+        assert len(failed) == 1
+        assert any("injected deterministic" in e
+                   for e in failed[0]["exceptions"])
+
+
+# --------------------------------------------------- resumable bench
+
+class TestBenchResume:
+    @staticmethod
+    def _fake_phases(monkeypatch, calls):
+        """Replace every subprocess phase with a recorder that writes
+        the artifact the orchestrator reads back."""
+        from nds_tpu.nds import bench as bench_mod
+        from nds_tpu.utils.timelog import TimeLog
+
+        def fake_run(cmd, backend=None):
+            calls.append(cmd[2])
+            mod = cmd[2]
+            if mod == "nds_tpu.nds.transcode":
+                with open(cmd[5], "w") as f:
+                    f.write("Total conversion time for 24 tables was "
+                            "5.0s\nRNGSEED used: 123\n")
+            elif mod == "nds_tpu.nds.power":
+                t = TimeLog("fake")
+                t.add("Power Test Time", 2000)
+                t.write(cmd[5])
+            elif mod == "nds_tpu.nds.maintenance":
+                t = TimeLog("fake")
+                t.add("Data Maintenance Time", 1500)
+                t.write(cmd[5])
+
+        def fake_streams(*a, **kw):
+            calls.append("stream_gen")
+
+        def fake_tp(*a, **kw):
+            calls.append("throughput")
+            return 3.0, [0]
+
+        monkeypatch.setattr(bench_mod, "_run", fake_run)
+        import nds_tpu.nds.streams as streams_mod
+        import nds_tpu.nds.throughput as tp_mod
+        monkeypatch.setattr(streams_mod, "generate_query_streams",
+                            fake_streams)
+        monkeypatch.setattr(tp_mod, "run_streams", fake_tp)
+        monkeypatch.setattr(tp_mod, "run_streams_inprocess", fake_tp)
+
+    def _cfg(self, tmp_path):
+        work = tmp_path / "w"
+        return {
+            "scale_factor": 0.01, "parallel": 2, "num_streams": 1,
+            "backend": "cpu",
+            "paths": {
+                "raw_data": str(work / "raw"),
+                "warehouse": str(work / "wh"),
+                "streams": str(work / "streams"),
+                "reports": str(work / "reports"),
+            },
+            "skip": {},
+        }
+
+    def test_resume_skips_completed_phases(self, tmp_path,
+                                           monkeypatch):
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        m1 = run_full_bench(cfg)
+        assert m1["metric"] is not None and m1["metric"] > 0
+        assert calls  # everything ran
+        state = json.load(open(os.path.join(cfg["paths"]["reports"],
+                                            "bench_state.json")))
+        assert set(state["phases"]) == {
+            "data_gen", "load_test", "stream_gen", "power_test",
+            "throughput_1", "maintenance_1", "throughput_2",
+            "maintenance_2"}
+        # resumed run: NOTHING re-executes, identical metric
+        calls.clear()
+        m2 = run_full_bench(cfg, resume=True)
+        assert calls == []
+        assert m2["metric"] == m1["metric"]
+
+    def test_resume_after_crash_reruns_only_the_tail(self, tmp_path,
+                                                     monkeypatch):
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        m1 = run_full_bench(cfg)
+        # simulate a crash during throughput round 2: drop it and
+        # everything after from the journal
+        jpath = os.path.join(cfg["paths"]["reports"],
+                             "bench_state.json")
+        state = json.load(open(jpath))
+        for ph in ("throughput_2", "maintenance_2"):
+            del state["phases"][ph]
+        with open(jpath, "w") as f:
+            json.dump(state, f)
+        calls.clear()
+        m2 = run_full_bench(cfg, resume=True)
+        # load+power replayed from the journal (no transcode/power
+        # subprocess), only the crashed tail re-ran
+        assert "nds_tpu.nds.transcode" not in calls
+        assert "nds_tpu.nds.power" not in calls
+        assert calls.count("throughput") == 1
+        assert calls.count("nds_tpu.nds.maintenance") == 1
+        assert m2["metric"] == m1["metric"]
+
+    def test_resume_refuses_config_drift(self, tmp_path, monkeypatch):
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        run_full_bench(cfg)
+        cfg2 = dict(cfg)
+        cfg2["scale_factor"] = 3000
+        with pytest.raises(JournalMismatch):
+            run_full_bench(cfg2, resume=True)
+
+    def test_fresh_run_resets_stale_journal(self, tmp_path,
+                                            monkeypatch):
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        run_full_bench(cfg)
+        n = len(calls)
+        calls.clear()
+        run_full_bench(cfg)  # NOT resume: everything re-runs
+        assert len(calls) == n

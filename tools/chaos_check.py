@@ -1,0 +1,152 @@
+"""Deterministic chaos gate: a tiny NDS power stream under injected
+faults, asserted end-to-end.
+
+tier-1 (via tools/static_checks.py) runs a 3-query NDS power stream on
+the CPU backend with a FIXED fault schedule — one transient
+device.execute OOM (must be retried and succeed, ``retries=1``,
+status ``Completed``) and one deterministic plan fault (must fail
+FAST: one attempt, ``gave_up_reason=deterministic``) — then checks the
+per-query JSON summaries, the TimeLog CSV (the stream never aborts),
+the resilience metrics counters, and the PhaseJournal resume
+round-trip. The schedule is seeded, so every CI run replays the exact
+same failure sequence; a regression in classification, retry
+accounting, or journaling fails here before any differential tier
+spins up a device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCALE = 0.01
+TEMPLATES = [96, 7, 93]
+# query7 dies once with an injected device OOM (transient: retried);
+# query93 dies at plan time (deterministic: never retried)
+SCHEDULE = "device.execute:oom@query7,plan:deterministic@query93"
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def run_chaos_stream(workdir: str) -> int:
+    from nds_tpu.nds import gen_data, streams
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.resilience import faults
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    from nds_tpu.utils.timelog import TimeLog
+
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "streams")
+    jsons = os.path.join(workdir, "json")
+    tlog = os.path.join(workdir, "time.csv")
+    gen_data.generate_data_local(SCALE, 2, raw, workers=2)
+    streams.generate_query_streams(sdir, 1, templates=TEMPLATES)
+
+    cfg = EngineConfig(overrides={
+        "engine.backend": "cpu",
+        "engine.retry.base_delay_s": "0.01",
+        "engine.retry.max_attempts": "3",
+    })
+    before = obs_metrics.snapshot()
+    plan = faults.install(SCHEDULE, seed=7)
+    try:
+        failures = power_core.run_query_stream(
+            SUITE, raw, os.path.join(sdir, "query_0.sql"), tlog,
+            config=cfg, input_format="raw",
+            json_summary_folder=jsons)
+    finally:
+        faults.clear()
+    delta = obs_metrics.delta(before, obs_metrics.snapshot())
+    counters = delta.get("counters", {})
+
+    if failures != 1:
+        return _fail(f"expected exactly the deterministic failure, "
+                     f"got {failures}")
+    summaries = {}
+    for f in os.listdir(jsons):
+        with open(os.path.join(jsons, f)) as fh:
+            s = json.load(fh)
+        summaries[s["query"]] = s
+    q96, q7, q93 = (summaries.get(f"query{n}") for n in TEMPLATES)
+    if not (q96 and q7 and q93):
+        return _fail(f"missing summaries: {sorted(summaries)}")
+    if q96["queryStatus"] != ["Completed"] or q96.get("retries") != 0:
+        return _fail(f"query96 should complete untouched: {q96}")
+    if q7["queryStatus"] != ["Completed"] or q7.get("retries") != 1:
+        return _fail(f"query7 should complete after ONE retry: "
+                     f"status={q7['queryStatus']} "
+                     f"retries={q7.get('retries')}")
+    if (q93["queryStatus"] != ["Failed"]
+            or q93.get("gave_up_reason") != "deterministic"
+            or q93.get("retries") != 0):
+        return _fail(f"query93 should fail fast without retry: {q93}")
+    if "injected deterministic fault" not in " ".join(q93["exceptions"]):
+        return _fail(f"query93 exception text lost: {q93['exceptions']}")
+    # the stream never aborts: every query has a TimeLog row
+    names = [q for _a, q, _ms in TimeLog.read(tlog)]
+    for n in TEMPLATES:
+        if f"query{n}" not in names:
+            return _fail(f"query{n} missing from TimeLog {names}")
+    if counters.get("query_retries_total") != 1:
+        return _fail(f"query_retries_total delta: {counters}")
+    if counters.get("faults_injected_total") != 2:
+        return _fail(f"faults_injected_total delta: {counters}")
+    fired = {(sp.site, sp.fired) for sp in plan.specs}
+    if fired != {("device.execute", 1), ("plan", 1)}:
+        return _fail(f"unexpected firing counts {fired}")
+    print("OK: chaos stream (1 transient retried, 1 deterministic "
+          "fail-fast, stream completed)")
+    return 0
+
+
+def run_journal_check(workdir: str) -> int:
+    from nds_tpu.resilience.journal import (
+        JournalMismatch, PhaseJournal, config_digest,
+    )
+    path = os.path.join(workdir, "bench_state.json")
+    digest = config_digest({"scale_factor": 0.01, "backend": "cpu"})
+    j = PhaseJournal(path, digest)
+    j.reset()
+    j.complete("load_test", load_time_s=12.5, rngseed=42)
+    j.complete("power_test", power_time_s=3.25)
+    # a fresh journal object (the resumed process) replays the state
+    j2 = PhaseJournal(path, digest)
+    if not j2.load():
+        return _fail("journal did not persist")
+    if not (j2.done("load_test") and j2.done("power_test")):
+        return _fail(f"phases lost: {j2.state}")
+    if j2.done("throughput_1"):
+        return _fail("phantom phase in journal")
+    if j2.timings("load_test") != {"load_time_s": 12.5, "rngseed": 42}:
+        return _fail(f"timings drifted: {j2.timings('load_test')}")
+    # a different config must refuse to resume (digest guard)
+    j3 = PhaseJournal(path, config_digest({"scale_factor": 3000}))
+    try:
+        j3.load()
+    except JournalMismatch:
+        pass
+    else:
+        return _fail("journal accepted a mismatched config digest")
+    print("OK: phase journal round-trip + config-digest guard")
+    return 0
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="nds_chaos_") as workdir:
+        rc = run_chaos_stream(workdir)
+        rc |= run_journal_check(workdir)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,11 @@ the one to run locally before pushing:
   4. ndsverify          plan + verify all 103 NDS and 22 NDS-H
                         statements on CPU (invariants:
                         nds_tpu/analysis/plan_verify.py)
+  5. chaos              3-query NDS power stream on CPU under a fixed
+                        fault schedule: one transient injection must
+                        retry and complete, one deterministic must
+                        fail fast; plus the resume-journal round-trip
+                        (tools/chaos_check.py)
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -28,6 +33,7 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
 import ndslint  # noqa: E402
@@ -70,6 +76,7 @@ def main() -> int:
         ("trace-schema", run_trace_schema_check),
         ("ndslint", lambda: ndslint.run(repo)),
         ("ndsverify", lambda: ndsverify.main([])),
+        ("chaos", chaos_check.main),
     ]
     failed = []
     for name, fn in sections:
