@@ -7,7 +7,11 @@ LAST line of stdout (the driver's contract). That line is the combined
 two-leg power total; per-leg metrics (`nds_h_sf*_power_total`,
 `nds_sf*_power_total`) are carried in its "legs" object and are also
 printed as standalone partial lines while each leg runs, so a timeout
-mid-run still leaves the best-known metric on stdout.
+mid-run still leaves the best-known metric on stdout. A "per_query"
+block carries every completed query's device seconds plus the worst-5
+regressions vs BASELINE.json's optional "per_query" map (computed by
+nds_tpu/obs/analyze.diff_times), so rounds are comparable query-by-
+query, not only by the opaque total.
 
 Methodology follows the reference power run (bracketed wall-clock around
 execute+collect per query, `nds/PysparkBenchReport.py:87-105`): each
@@ -79,6 +83,38 @@ def _metric_name(leg: str) -> str:
             else f"nds_sf{SF_DS:g}_power_total")
 
 
+def _per_query_block() -> dict | None:
+    """Worst-5 per-query regressions vs BASELINE.json's optional
+    ``per_query`` map ({"leg:qname": seconds}), via the run-analysis
+    diff code (nds_tpu/obs/analyze.py) — plus the current per-query
+    device times, so a BENCH round is a promotable baseline and not an
+    opaque scalar. Never raises: this runs inside the SIGTERM path."""
+    try:
+        cur = {f"{leg}:{qn}": round(r["device_s"], 4)
+               for (leg, qn), r in BANK.items() if "device_s" in r}
+        if not cur:
+            return None
+        block: dict = {"times": cur}
+        try:
+            with open(os.path.join(HERE, "BASELINE.json")) as f:
+                base = json.load(f).get("per_query") or {}
+        except (OSError, ValueError):
+            base = {}
+        if base:
+            from nds_tpu.obs.analyze import diff_times
+            d = diff_times({q: s * 1000.0 for q, s in base.items()},
+                           {q: s * 1000.0 for q, s in cur.items()},
+                           pct=10.0, abs_ms=50.0)
+            block["baseline_compared"] = (
+                len(d["regressions"]) + len(d["improvements"])
+                + len(d["noise"]))
+            block["worst_regressions"] = d["regressions"][:5]
+            block["improvements_n"] = len(d["improvements"])
+        return block
+    except Exception:  # noqa: BLE001 - metric line must always emit
+        return None
+
+
 def _combined_dict() -> dict:
     legs = {}
     dev = cpu = completed = total = 0
@@ -89,7 +125,7 @@ def _combined_dict() -> dict:
         cpu += line["value"] * line["vs_baseline"]
         completed += line["queries_completed"]
         total += line["queries_total"]
-    return {
+    out = {
         "metric": "nds+nds_h_power_total",
         "value": round(dev, 4),
         "unit": "s",
@@ -98,6 +134,10 @@ def _combined_dict() -> dict:
         "queries_total": total,
         "legs": legs,
     }
+    pq = _per_query_block()
+    if pq:
+        out["per_query"] = pq
+    return out
 
 
 def _combined_line() -> str:

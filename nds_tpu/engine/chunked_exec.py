@@ -41,6 +41,7 @@ from nds_tpu.engine.types import (
     INT64, DecimalType, FloatType, Schema, StringType,
 )
 from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
+from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience.retry import RetryPolicy, is_oom
@@ -513,29 +514,41 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     if bkey + "#v" in bufs:
                         bufs[bkey + "#v"] = jnp.asarray(
                             col.null_mask[s:e])
-                # overflow-retry on the shared policy (slack-doubling
-                # shape, no backoff sleep — same as dist_exec)
-                overflow_policy = RetryPolicy(max_attempts=4,
-                                              base_delay_s=0.0)
-                for attempt in overflow_policy.attempts():
-                    row, outs, overflow = compiled(bufs)
-                    row_h, outs_h, over_h = jax.device_get(
-                        (row, outs, overflow))
-                    if int(over_h) == 0:
-                        break
-                    if attempt == overflow_policy.max_attempts - 1:
-                        raise dx.DeviceExecError(
-                            "partial-agg chunk overflow persisted")
-                    # skewed chunk expands past the chunk-0-sized join
-                    # capacity: double slack and recompile, same as the
-                    # executor's own overflow-retry contract
-                    from nds_tpu.utils.report import TaskFailureCollector
-                    slack *= 2
-                    TaskFailureCollector.notify(
-                        f"partial-agg chunk [{s}:{e}] overflow; "
-                        f"recompiling with slack={slack}")
-                    jitted, side = ex._compile(planned_a, slack)
-                    compiled = jitted.lower(bufs).compile()
+                # per-chunk memory window: swapped chunk buffers are
+                # the only per-iteration live set (obs/memwatch)
+                win = sum(getattr(b, "nbytes", 0)
+                          for b in bufs.values())
+                memwatch.add_live(win)
+                try:
+                    # overflow-retry on the shared policy
+                    # (slack-doubling shape, no backoff sleep — same
+                    # as dist_exec)
+                    overflow_policy = RetryPolicy(max_attempts=4,
+                                                  base_delay_s=0.0)
+                    for attempt in overflow_policy.attempts():
+                        row, outs, overflow = compiled(bufs)
+                        row_h, outs_h, over_h = jax.device_get(
+                            (row, outs, overflow))
+                        if int(over_h) == 0:
+                            break
+                        if attempt == overflow_policy.max_attempts - 1:
+                            raise dx.DeviceExecError(
+                                "partial-agg chunk overflow persisted")
+                        # skewed chunk expands past the chunk-0-sized
+                        # join capacity: double slack and recompile,
+                        # same as the executor's own overflow-retry
+                        # contract
+                        from nds_tpu.utils.report import (
+                            TaskFailureCollector,
+                        )
+                        slack *= 2
+                        TaskFailureCollector.notify(
+                            f"partial-agg chunk [{s}:{e}] overflow; "
+                            f"recompiling with slack={slack}")
+                        jitted, side = ex._compile(planned_a, slack)
+                        compiled = jitted.lower(bufs).compile()
+                finally:
+                    memwatch.sub_live(win)
                 parts.append(ex._materialize(planned_a, row_h, outs_h,
                                              side))
         return parts
@@ -666,8 +679,16 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     bufs[name] = jnp.asarray(sl)
                     if m is not None:
                         bufs[name + "#v"] = jnp.asarray(m)
-                keep_np[start:stop] = np.asarray(
-                    jitted(bufs, jnp.int32(stop - start)))[:stop - start]
+                # per-chunk memory window (obs/memwatch fallback
+                # accounting): only one chunk's buffers live at a time
+                win = sum(b.nbytes for b in bufs.values())
+                memwatch.add_live(win)
+                try:
+                    keep_np[start:stop] = np.asarray(
+                        jitted(bufs,
+                               jnp.int32(stop - start)))[:stop - start]
+                finally:
+                    memwatch.sub_live(win)
             if skipped:
                 from nds_tpu.utils.report import TaskFailureCollector
                 TaskFailureCollector.notify(

@@ -135,6 +135,23 @@ class CpuExecutor:
         # chaos site shared with the device executors: CPU-backend runs
         # exercise the retry/fallback machinery without a chip
         faults.fault_point("device.execute", executor="CpuExecutor")
+        # memory HWM (obs/memwatch): the oracle has no allocator to
+        # sample — account the scanned tables' host bytes instead so
+        # CPU runs still report a per-query working-set gauge
+        from nds_tpu.obs import memwatch
+        scanned = {node.table
+                   for root in [planned.root, *planned.scalar_subplans]
+                   for node in P.walk_plan(root)
+                   if isinstance(node, P.Scan)}
+        scan_bytes = sum(memwatch.table_bytes(self.tables[t])
+                         for t in scanned if t in self.tables)
+        memwatch.add_live(scan_bytes)
+        try:
+            return self._execute_inner(planned)
+        finally:
+            memwatch.sub_live(scan_bytes)
+
+    def _execute_inner(self, planned: P.PlannedQuery):
         self._node_cache.clear()
         self.scalars.clear()
         for i, sub in enumerate(planned.scalar_subplans):

@@ -60,6 +60,7 @@ from nds_tpu.engine.types import (  # noqa: E402
     BoolType, DateType, DecimalType, DType, FloatType, IntType, StringType,
 )
 from nds_tpu.io.host_table import HostTable  # noqa: E402
+from nds_tpu.obs import memwatch  # noqa: E402
 from nds_tpu.obs import metrics as obs_metrics  # noqa: E402
 from nds_tpu.obs.trace import get_tracer  # noqa: E402
 from nds_tpu.sql import ir  # noqa: E402
@@ -579,6 +580,11 @@ class DeviceExecutor:
             # success; a failing main program must not leave a sub's
             # span masquerading as the whole query's
             self.last_query_span = None
+            # release the accounted scan bytes a failed dispatch added
+            # (pop: a pre-upload failure — or a stale dict from the
+            # previous, already-released query — releases 0)
+            memwatch.sub_live(
+                (self.last_timings or {}).pop("__live_bytes", 0.0))
             if qspan and qspan.t1 is None:
                 qspan.set(error=f"{type(exc).__name__}: {exc}").end()
             raise
@@ -633,6 +639,15 @@ class DeviceExecutor:
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
+            # memory HWM (obs/memwatch): scan buffers go live here and
+            # release in _finish; the device-stats sample around the
+            # execute bracket dominates the accounting when available.
+            # __live_bytes is the release token: every release POPS it,
+            # so the success/failure paths can never double-release
+            # (stripped from all published timings)
+            memwatch.add_live(timings["bytes_scanned"])
+            timings["__live_bytes"] = timings["bytes_scanned"]
+            memwatch.sample_device()
             # ndslint: waive[NDS102] -- execute bracket opens here; _finish_traced closes it after device_get
             t1 = _time.perf_counter()
             row, outs, overflow = entry["compiled"](bufs)
@@ -720,6 +735,12 @@ class DeviceExecutor:
             if span and span.t1 is None:
                 span.set(error=f"{type(exc).__name__}: {exc}").end()
             raise
+        finally:
+            # the dispatch's accounted scan bytes release when the
+            # query completes either way (overflow retries re-add
+            # through execute_async and release through THEIR finish;
+            # pop makes a second release a no-op)
+            memwatch.sub_live(timings.pop("__live_bytes", 0.0))
 
     def _finish_traced(self, planned, key, entry, timings, t1, devs,
                        attempt, span, tracer):
@@ -754,11 +775,18 @@ class DeviceExecutor:
                                         entry["side"])
             # ndslint: waive[NDS102] -- host materialize endpoint; the device.materialize span brackets the same region
             t3 = _time.perf_counter()
+            # post-materialize allocator sample: results + scan buffers
+            # are all resident here, the per-query memory peak
+            memwatch.sample_device()
             timings["execute_ms"] = (t2 - t1) * 1000
             timings["materialize_ms"] = (t3 - t2) * 1000
             self._finalize_timings(timings, key)
             if span:
-                span.set(timings=dict(timings)).end()
+                # dunder keys are internal accounting state (e.g. the
+                # __live_bytes release token), not part of the
+                # published timings vocabulary
+                span.set(timings={k: v for k, v in timings.items()
+                                  if not k.startswith("__")}).end()
                 self.last_query_span = span
             return out
         if attempt >= 3:

@@ -22,6 +22,13 @@ completed phases from the journal instead of re-running them, so a
 crash in throughput round 2 costs only that round — the journal guards
 against config drift via a digest, and the resumed run computes the
 SAME composite metric an uninterrupted one would.
+
+Observability (README "Observability"): power and throughput phases
+leave ``analysis.json`` + ``report.html`` (per-query time attribution,
+nds_tpu/obs/analyze.py) next to their BenchReport JSONs, and a
+``metrics_snap: {dir, interval}`` YAML block threads
+``NDS_TPU_METRICS_SNAP`` into every engine phase so long runs publish
+live metrics snapshots while in flight.
 """
 
 from __future__ import annotations
@@ -40,10 +47,31 @@ from nds_tpu.resilience.journal import PhaseJournal, config_digest
 from nds_tpu.utils.timelog import TimeLog
 
 
-def _run(cmd: list[str], backend: str | None = None) -> None:
+def _run(cmd: list[str], backend: str | None = None,
+         extra_env: dict | None = None) -> None:
     from nds_tpu.utils.power_core import subprocess_env
     print("+", " ".join(cmd))
-    subprocess.run(cmd, check=True, env=subprocess_env(backend))
+    env = subprocess_env(backend)
+    if extra_env:
+        env.update(extra_env)
+    subprocess.run(cmd, check=True, env=env)
+
+
+def _analyze_phase(phase_name: str, run_dir: str) -> None:
+    """Post-phase run analysis (nds_tpu/obs/analyze.py): write
+    ``analysis.json`` + ``report.html`` next to the phase's BenchReport
+    JSONs so every bench round leaves a per-query attribution
+    breakdown, not just composite-metric inputs. Best-effort — a phase
+    that wrote no summaries (skipped via cfg['skip']) is not an
+    error."""
+    try:
+        from nds_tpu.obs import analyze
+        paths = analyze.write_outputs(analyze.analyze_run(run_dir),
+                                      run_dir)
+        print(f"[{phase_name}] analysis: {paths['report']}")
+    except Exception as exc:  # noqa: BLE001 - reporting only
+        print(f"[{phase_name}] run analysis skipped: "
+              f"{type(exc).__name__}: {exc}")
 
 
 def get_power_time(time_log_path: str) -> float:
@@ -102,6 +130,21 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
     os.makedirs(report_dir, exist_ok=True)
     load_report = os.path.join(report_dir, "load_report.txt")
     metrics: dict = {"scale": scale, "streams": num_streams}
+
+    # live metrics snapshots (README "Observability"): YAML
+    # ``metrics_snap: {dir: ..., interval: 5}`` threads
+    # NDS_TPU_METRICS_SNAP into every engine phase subprocess, one
+    # snapshot file per phase
+    snap_cfg = cfg.get("metrics_snap") or {}
+
+    def _snap_env(phase_name: str) -> dict | None:
+        snap_dir = snap_cfg.get("dir")
+        if not snap_dir:
+            return None
+        os.makedirs(snap_dir, exist_ok=True)
+        interval = snap_cfg.get("interval", 5)
+        return {"NDS_TPU_METRICS_SNAP":
+                f"{os.path.join(snap_dir, phase_name)}.json:{interval}"}
 
     journal = PhaseJournal(os.path.join(report_dir, "bench_state.json"),
                            config_digest(cfg))
@@ -173,7 +216,8 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
                   power_log, "--backend", backend,
                   "--json_summary_folder",
                   os.path.join(report_dir, "json")],
-                 backend=backend)
+                 backend=backend, extra_env=_snap_env("power"))
+            _analyze_phase("power", os.path.join(report_dir, "json"))
         return {"power_time_s": get_power_time(power_log)}
 
     metrics["power_time_s"] = tpt = phase(
@@ -193,12 +237,26 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
         mode = cfg.get("throughput_mode",
                        "inprocess" if backend == "tpu"
                        else "subprocess")
-        if mode == "inprocess":
-            ttt, codes = run_streams_inprocess(
-                wh_dir, tstreams, tdir, backend=backend)
-        else:
-            ttt, codes = run_streams(
-                wh_dir, tstreams, tdir, backend=backend)
+        # in-process mode starts its own emitter in THIS process;
+        # subprocess mode inherits the var (run_streams re-points it
+        # per stream). Save/restore so a user's own setting survives.
+        snap_env = _snap_env(f"throughput{round_no}") or {}
+        saved = {k: os.environ.get(k) for k in snap_env}
+        os.environ.update(snap_env)
+        try:
+            if mode == "inprocess":
+                ttt, codes = run_streams_inprocess(
+                    wh_dir, tstreams, tdir, backend=backend)
+            else:
+                ttt, codes = run_streams(
+                    wh_dir, tstreams, tdir, backend=backend)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        _analyze_phase(f"throughput{round_no}", tdir)
         if any(codes):
             raise SystemExit(
                 f"throughput {round_no} streams failed: {codes}")

@@ -35,8 +35,17 @@ def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                "--input_format", input_format]
         if allow_failure:
             cmd.append("--allow_failure")
+        from nds_tpu.obs.snapshot import SNAP_ENV, parse_spec
         from nds_tpu.utils.power_core import subprocess_env
-        procs.append(subprocess.Popen(cmd, env=subprocess_env(backend)))
+        env = subprocess_env(backend)
+        if env.get(SNAP_ENV):
+            # one snapshot file PER STREAM: N subprocesses inheriting
+            # the same path would race on it (and on its .tmp),
+            # exactly what the atomic-write contract forbids
+            path, interval = parse_spec(env[SNAP_ENV])
+            root, ext = os.path.splitext(path)
+            env[SNAP_ENV] = f"{root}_{name}{ext or '.json'}:{interval}"
+        procs.append(subprocess.Popen(cmd, env=env))
     codes = [p.wait() for p in procs]
     elapse = time.time() - start
     # round up to 0.1 s, the reference's Ttt granularity
@@ -58,7 +67,30 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
     — streams differ in parameter bindings, so each still compiles its
     own programs), and queries interleave round-robin so all streams
     progress together the way the xargs -P fan-out does. Per-stream time
-    logs keep the reference format. Returns (elapse_s, failure counts)."""
+    logs keep the reference format. Returns (elapse_s, failure counts).
+
+    ``NDS_TPU_METRICS_SNAP`` is honored here too: this mode never
+    enters ``run_query_stream`` (it drives ``session.sql_async``
+    directly), so it owns its own snapshot emitter."""
+    from nds_tpu.obs.snapshot import MetricsSnapshotter
+    progress = {"mode": "throughput-inprocess",
+                "streams": len(stream_paths),
+                "queries_completed": 0, "current_query": None}
+    snap = MetricsSnapshotter.from_env(progress)
+    if snap:
+        snap.start()
+    try:
+        return _run_streams_inprocess(data_dir, stream_paths, out_dir,
+                                      backend, input_format, progress)
+    finally:
+        if snap:
+            progress["current_query"] = None
+            snap.stop()
+
+
+def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
+                           input_format, progress
+                           ) -> tuple[float, list[int]]:
     from nds_tpu.nds.power import SUITE
     from nds_tpu.resilience import faults
     from nds_tpu.resilience.retry import (
@@ -150,6 +182,7 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
         else:
             s["statuses"].append("Completed")
         done = time.time()
+        progress["queries_completed"] += 1
         # dispatch->result bracket; queue wait from pipelining is
         # inherent to a time-shared chip, exactly as a query inside a
         # reference throughput stream waits on cluster resources
@@ -159,6 +192,7 @@ def run_streams_inprocess(data_dir: str, stream_paths: list[str],
         s["last_done"] = done
 
     for s, qname, sql in interleaved:
+        progress["current_query"] = f"{s['name']}/{qname}"
         t0 = time.time()
         handle, err = None, None
         try:

@@ -1,17 +1,33 @@
-"""Unified observability layer: span tracing + metrics.
+"""Unified observability layer: tracing, metrics, memory, analysis.
 
 ``nds_tpu.obs.trace`` — nestable wall-clock spans with Chrome-trace
 JSONL export (``NDS_TPU_TRACE=path``); ``nds_tpu.obs.metrics`` — the
-global counter/gauge/histogram registry.  ``query_timings`` is the
-span-fed replacement for scraping ``executor.last_timings`` by hand.
+global counter/gauge/histogram registry; ``nds_tpu.obs.memwatch`` —
+per-query device-memory high-water marks; ``nds_tpu.obs.snapshot`` —
+the live metrics emitter (``NDS_TPU_METRICS_SNAP``);
+``nds_tpu.obs.analyze`` — run-dir ingestion, time attribution, the
+cross-run regression gate, and the HTML report behind
+``tools/ndsreport.py``.  ``query_timings`` is the span-fed replacement
+for scraping ``executor.last_timings`` by hand.
+
+``analyze``/``snapshot`` import lazily on attribute access — the hot
+engine path pays for spans and counters only.
 """
 
 from __future__ import annotations
 
-from nds_tpu.obs import metrics, trace
+from nds_tpu.obs import memwatch, metrics, trace
 from nds_tpu.obs.trace import get_tracer
 
-__all__ = ["metrics", "trace", "get_tracer", "query_timings"]
+__all__ = ["analyze", "memwatch", "metrics", "snapshot", "trace",
+           "get_tracer", "query_timings"]
+
+
+def __getattr__(name: str):
+    if name in ("analyze", "snapshot"):
+        import importlib
+        return importlib.import_module(f"nds_tpu.obs.{name}")
+    raise AttributeError(name)
 
 
 def query_timings(executor) -> dict:

@@ -1,0 +1,615 @@
+"""Run analysis: time attribution, cross-run diff/regression gate, HTML.
+
+PR 1 made every run EMIT spans and per-query metric deltas; nothing in
+the repo consumed them — rounds were compared by eyeballing one scalar.
+This module is the consumer.  It ingests a *run directory* (the
+``json_summary_folder`` a power/throughput run writes: one BenchReport
+JSON per query, plus any ``*.jsonl`` Chrome trace the run exported) and
+produces three artifacts:
+
+- **Time attribution** (``attribute_query``): each query's wall-clock
+  decomposed over fixed categories — parse/plan, compile, device
+  execute, materialize, host staging, exchange, retry backoff — by
+  walking the span tree with *exclusive* (self-time) accounting: a
+  span's self time bills to its own category, or to its nearest
+  categorized ancestor (so a staged sub-program's dispatch overhead
+  bills to host_staging, not nowhere).  Whatever no span covers lands
+  in an explicit ``residual_ms``, so categories + residual sum to the
+  reported wall-clock BY CONSTRUCTION — the breakdown can never
+  quietly overlap or undercount ("Query Processing on Tensor
+  Computation Runtimes" attributes TCR cost the same way: compile
+  amortization vs steady-state must be separable or the numbers lie).
+- **Cross-run diff + gate** (``diff_runs`` / ``diff_times``): compare
+  two runs query-by-query on *steady-state* time (wall minus compile
+  minus retry backoff), ignore sub-threshold absolute deltas as noise,
+  flag compile-count changes separately, and report added/removed
+  queries.  ``tools/ndsreport.py diff A B --gate pct=10`` exits
+  non-zero on regression, so CI and future bench rounds gate on it.
+- **HTML report** (``render_html``): self-contained stdlib HTML —
+  per-query stacked attribution bars, slowest-N table, metrics, and a
+  stream-overlap timeline from the trace JSONL for throughput runs.
+
+No new dependencies; everything here is stdlib + the repo's own JSON
+shapes (README "Observability" documents them; ``tools/
+check_trace_schema.py --summary`` validates them).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+import os
+
+# attribution categories, in display order (retry_backoff comes from
+# the summary's retry accounting, not from spans; residual is computed)
+CATEGORIES = ("parse_plan", "compile", "execute", "materialize",
+              "host_staging", "exchange", "retry_backoff")
+
+# span name -> category (exact names; see README span taxonomy)
+_SPAN_CATEGORY = {
+    "sql.parse": "parse_plan",
+    "sql.plan": "parse_plan",
+    "device.compile": "compile",
+    "device.run": "execute",
+    "device.materialize": "materialize",
+    "stage.sub": "host_staging",
+    "chunk.partial_agg": "host_staging",
+    "chunk.reduce": "host_staging",
+}
+
+# summary files that live in run dirs but are not BenchReports
+_IGNORE_BASENAMES = {"analysis.json", "bench_state.json"}
+
+
+def span_category(name: str) -> str | None:
+    cat = _SPAN_CATEGORY.get(name)
+    if cat is None and name.startswith("exchange"):
+        return "exchange"
+    return cat
+
+
+def is_report_basename(name: str) -> bool:
+    """Whether a run-dir file name can be a BenchReport summary (the
+    single place that decision lives — static_checks' fixture gate and
+    load_summaries both use it)."""
+    return name.endswith(".json") and name not in _IGNORE_BASENAMES
+
+
+# ---------------------------------------------------------- attribution
+
+def _accumulate(node: dict, inherited: str | None, acc: dict) -> None:
+    """Exclusive-time walk: each span's self time (dur minus direct
+    children) bills to its own category, else to the nearest
+    categorized ancestor, else nowhere (-> residual)."""
+    cat = span_category(node.get("name", "")) or inherited
+    kids = node.get("children") or []
+    self_ms = (node.get("dur_ms") or 0.0) - sum(
+        (k.get("dur_ms") or 0.0) for k in kids)
+    if cat and self_ms > 0:
+        acc[cat] += self_ms
+    for k in kids:
+        _accumulate(k, cat, acc)
+
+
+def attribute_query(summary: dict) -> dict:
+    """One BenchReport summary -> attribution row. Invariant:
+    ``sum(categories.values()) + residual_ms == wall_ms`` exactly
+    (residual is DEFINED as the difference — negative residual means
+    span totals exceeded the bracket, a clock-skew signal worth seeing,
+    not hiding)."""
+    times = summary.get("queryTimes") or [0]
+    wall_ms = float(times[-1])
+    cats = {c: 0.0 for c in CATEGORIES}
+    spans = summary.get("spans")
+    if isinstance(spans, dict):
+        _accumulate(spans, None, cats)
+    cats["retry_backoff"] = float(
+        summary.get("retry_backoff_s", 0.0)) * 1000.0
+    counters = (summary.get("metrics") or {}).get("counters", {})
+    status = summary.get("queryStatus") or ["Unknown"]
+    row = {
+        "query": summary.get("query", "?"),
+        "status": status[-1],
+        "start_time": summary.get("startTime"),
+        "wall_ms": wall_ms,
+        "categories": cats,
+        "residual_ms": wall_ms - sum(cats.values()),
+        "compiles": int(counters.get("compiles_total", 0)
+                        + counters.get("recompiles_total", 0)),
+        "retries": int(summary.get("retries", 0)),
+    }
+    mem = summary.get("memory")
+    if isinstance(mem, dict) and "device_hwm_bytes" in mem:
+        row["hwm_bytes"] = int(mem["device_hwm_bytes"])
+    return row
+
+
+def steady_ms(row: dict) -> float:
+    """Steady-state time: wall minus compile minus retry backoff — the
+    quantity the regression gate compares (compile-count changes are
+    flagged separately; a run that merely recompiled more is a
+    different finding than one whose execution got slower)."""
+    return (row["wall_ms"] - row["categories"]["compile"]
+            - row["categories"]["retry_backoff"])
+
+
+# ------------------------------------------------------------ ingestion
+
+def load_summaries(run_dir: str) -> list[dict]:
+    """Every BenchReport JSON under ``run_dir`` (recursive), in
+    startTime order. Non-report JSONs (journals, analysis output,
+    unparseable files) are skipped silently — run dirs are shared."""
+    out = []
+    for root, _dirs, files in os.walk(run_dir):
+        for fname in sorted(files):
+            if not is_report_basename(fname):
+                continue
+            try:
+                with open(os.path.join(root, fname)) as f:
+                    obj = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if (isinstance(obj, dict) and "queryStatus" in obj
+                    and "query" in obj):
+                out.append(obj)
+    out.sort(key=lambda s: (s.get("startTime") or 0))
+    return out
+
+
+def load_trace_events(run_dir: str) -> list[dict]:
+    """All Chrome trace events from ``*.jsonl`` files under
+    ``run_dir`` (the power loop's NDS_TPU_TRACE export)."""
+    events = []
+    for root, _dirs, files in os.walk(run_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".jsonl"):
+                continue
+            try:
+                with open(os.path.join(root, fname)) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(ev, dict) and ev.get("ph") == "X":
+                            events.append(ev)
+            except OSError:
+                continue
+    return events
+
+
+def _dedupe_names(rows: list[dict]) -> None:
+    """Throughput dirs repeat query names across streams; suffix
+    repeats (#2, #3...) so per-name maps stay lossless. Suffixes are
+    assigned by wall-clock RANK, not arrival order: stream-scheduling
+    jitter must not re-label instances between two runs, or diff_runs
+    would pair mismatched instances and report phantom regressions —
+    rank pairing compares fastest-to-fastest, slowest-to-slowest."""
+    groups: dict[str, list] = {}
+    for row in rows:
+        groups.setdefault(row["query"], []).append(row)
+    for name, g in groups.items():
+        if len(g) > 1:
+            ranked = sorted(g, key=lambda r: (r["wall_ms"],
+                                              r["start_time"] or 0))
+            for i, row in enumerate(ranked[1:], 2):
+                row["query"] = f"{name}#{i}"
+
+
+def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
+    """Full run analysis: attribution rows, category totals, slowest-N,
+    run-level metric aggregates, and trace events for the timeline.
+    ``with_trace=False`` skips parsing the (potentially huge) trace
+    JSONL — the diff gate only needs the BenchReport-derived rows."""
+    summaries = load_summaries(run_dir)
+    if not summaries:
+        raise ValueError(f"no BenchReport JSONs under {run_dir!r}")
+    rows = [attribute_query(s) for s in summaries]
+    _dedupe_names(rows)
+    totals = {c: 0.0 for c in CATEGORIES}
+    residual = 0.0
+    for row in rows:
+        for c in CATEGORIES:
+            totals[c] += row["categories"][c]
+        residual += row["residual_ms"]
+    counters: dict = {}
+    hists: dict = {}
+    for s in summaries:
+        m = s.get("metrics") or {}
+        for name, v in m.get("counters", {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, h in m.get("histograms", {}).items():
+            agg = hists.setdefault(name, {"count": 0, "sum": 0.0})
+            agg["count"] += h.get("count", 0)
+            agg["sum"] += h.get("sum", 0.0)
+            # quantiles are point-in-time: keep the latest reported
+            agg.update({k: h[k] for k in ("p50", "p95", "p99")
+                        if k in h})
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "queries": rows,
+        "totals": {"wall_ms": sum(r["wall_ms"] for r in rows),
+                   "categories": totals, "residual_ms": residual},
+        "slowest": [r["query"] for r in sorted(
+            rows, key=lambda r: -r["wall_ms"])],
+        "failed": [r["query"] for r in rows
+                   if r["status"] != "Completed"],
+        "metrics": {"counters": counters, "histograms": hists},
+        "trace_events": (load_trace_events(run_dir) if with_trace
+                         else []),
+    }
+
+
+# ------------------------------------------------------------- CLI text
+
+def format_attribution(analysis: dict, top: int | None = None) -> str:
+    """Fixed-width per-query attribution table (the ``ndsreport
+    analyze`` stdout contract): categories + residual per query, sum
+    column provably equal to wall-clock."""
+    short = {"parse_plan": "parse", "compile": "compile",
+             "execute": "exec", "materialize": "mat",
+             "host_staging": "stage", "exchange": "exch",
+             "retry_backoff": "retry"}
+    rows = analysis["queries"]
+    if top:
+        order = {q: i for i, q in enumerate(analysis["slowest"])}
+        rows = sorted(rows, key=lambda r: order[r["query"]])[:top]
+    w = max([len(r["query"]) for r in rows] + [5])
+    cols = list(CATEGORIES) + ["residual", "wall"]
+    head = (f"{'query':<{w}} " + " ".join(
+        f"{short.get(c, c):>9}" for c in cols) + "  status")
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        vals = [r["categories"][c] for c in CATEGORIES]
+        vals += [r["residual_ms"], r["wall_ms"]]
+        lines.append(
+            f"{r['query']:<{w}} "
+            + " ".join(f"{v:>9.1f}" for v in vals)
+            + f"  {r['status']}")
+    t = analysis["totals"]
+    tvals = [t["categories"][c] for c in CATEGORIES]
+    tvals += [t["residual_ms"], t["wall_ms"]]
+    lines.append("-" * len(head))
+    lines.append(f"{'TOTAL':<{w}} "
+                 + " ".join(f"{v:>9.1f}" for v in tvals) + "  (ms)")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ diff/gate
+
+def parse_gate(spec: str | None) -> dict:
+    """``pct=10`` / ``pct=10,abs_ms=50`` -> thresholds dict.  A delta
+    must exceed BOTH the relative and the absolute floor to count —
+    that's the noise model (sub-threshold absolute wobble on fast
+    queries must not fail a gate)."""
+    gate = {"pct": 10.0, "abs_ms": 50.0}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        if key not in gate:
+            raise ValueError(f"unknown gate key {key!r} "
+                             f"(known: {sorted(gate)})")
+        gate[key] = float(val)
+    return gate
+
+
+def diff_times(base: dict, cur: dict, pct: float = 10.0,
+               abs_ms: float = 50.0) -> dict:
+    """Core noise-aware comparison over two {name: ms} maps (the same
+    code path gates fixture run-dirs in CI and the round bench's
+    per-query block). Regression: cur exceeds base by BOTH >pct% and
+    >=abs_ms. Symmetric for improvements; everything else is noise."""
+    regressions, improvements, noise = [], [], []
+    for name in sorted(set(base) & set(cur)):
+        b, c = float(base[name]), float(cur[name])
+        d = c - b
+        entry = {"query": name, "base_ms": round(b, 3),
+                 "cur_ms": round(c, 3), "delta_ms": round(d, 3),
+                 "pct": round(d / b * 100.0, 2) if b > 0 else None}
+        # a zero/negative baseline (clock-skew steady-state, zeroed
+        # BASELINE entry) makes the relative test vacuous: any growth
+        # past the absolute floor is then a regression, not noise
+        if d >= abs_ms and (b <= 0 or c > b * (1 + pct / 100.0)):
+            regressions.append(entry)
+        elif -d >= abs_ms and b > 0 and c < b * (1 - pct / 100.0):
+            improvements.append(entry)
+        else:
+            noise.append(entry)
+    regressions.sort(
+        key=lambda e: -(e["pct"] if e["pct"] is not None
+                        else float("inf")))
+    improvements.sort(key=lambda e: (e["pct"] or 0))
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "noise": noise,
+        "added": sorted(set(cur) - set(base)),
+        "removed": sorted(set(base) - set(cur)),
+        "gate": {"pct": pct, "abs_ms": abs_ms},
+    }
+
+
+def diff_runs(base: dict, cur: dict, pct: float = 10.0,
+              abs_ms: float = 50.0) -> dict:
+    """Query-by-query diff of two ``analyze_run`` results, gated on
+    STEADY-STATE time; compile-count and compile-time changes are
+    reported in their own ``compile_changes`` list so a recompile
+    shows up as what it is, not as an execution regression.  The gate
+    fails (``passed=False``) on any steady-state regression or any
+    removed query (a query that vanished is strictly worse than one
+    that got slower)."""
+    b_rows = {r["query"]: r for r in base["queries"]}
+    c_rows = {r["query"]: r for r in cur["queries"]}
+    d = diff_times({q: steady_ms(r) for q, r in b_rows.items()},
+                   {q: steady_ms(r) for q, r in c_rows.items()},
+                   pct=pct, abs_ms=abs_ms)
+    compile_changes = []
+    for name in sorted(set(b_rows) & set(c_rows)):
+        b, c = b_rows[name], c_rows[name]
+        if (b["compiles"] != c["compiles"]
+                or abs(b["categories"]["compile"]
+                       - c["categories"]["compile"]) >= abs_ms):
+            compile_changes.append({
+                "query": name,
+                "base_compiles": b["compiles"],
+                "cur_compiles": c["compiles"],
+                "base_compile_ms": round(b["categories"]["compile"], 3),
+                "cur_compile_ms": round(c["categories"]["compile"], 3),
+            })
+    newly_failed = sorted(
+        set(cur.get("failed", [])) - set(base.get("failed", [])))
+    d.update({
+        "base_dir": base.get("run_dir"),
+        "cur_dir": cur.get("run_dir"),
+        "compile_changes": compile_changes,
+        "newly_failed": newly_failed,
+        "passed": not d["regressions"] and not d["removed"]
+                  and not newly_failed,
+    })
+    return d
+
+
+def format_diff(d: dict) -> str:
+    lines = [f"gate: >{d['gate']['pct']:g}% and "
+             f">={d['gate']['abs_ms']:g} ms (steady-state)"]
+    for label, key, sign in (("REGRESSION", "regressions", "+"),
+                             ("improvement", "improvements", "")):
+        for e in d[key]:
+            rel = ("n/a" if e["pct"] is None
+                   else f"{sign}{e['pct']:g}%")
+            lines.append(
+                f"  {label:<11} {e['query']:<14} "
+                f"{e['base_ms']:>10.1f} -> {e['cur_ms']:>10.1f} ms "
+                f"({rel})")
+    for q in d["removed"]:
+        lines.append(f"  REMOVED     {q}")
+    for q in d.get("newly_failed", []):
+        lines.append(f"  NEWLY-FAILED {q}")
+    for q in d["added"]:
+        lines.append(f"  added       {q}")
+    for e in d["compile_changes"]:
+        lines.append(
+            f"  compile     {e['query']:<14} "
+            f"{e['base_compiles']} compile(s)/"
+            f"{e['base_compile_ms']:.0f} ms -> {e['cur_compiles']}/"
+            f"{e['cur_compile_ms']:.0f} ms")
+    lines.append(f"  {len(d['noise'])} querie(s) within noise threshold")
+    lines.append("DIFF " + ("OK" if d["passed"] else "FAILED"))
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- HTML
+
+# categorical slots (documented default palette, fixed order — the
+# 7-slot adjacent sequence passes the CVD/normal-vision gates in both
+# modes per the palette doc); residual wears neutral gray, not a
+# series hue
+_LIGHT = {"parse_plan": "#2a78d6", "compile": "#eb6834",
+          "execute": "#1baf7a", "materialize": "#eda100",
+          "host_staging": "#e87ba4", "exchange": "#008300",
+          "retry_backoff": "#4a3aa7", "residual": "#b9b8b3"}
+_DARK = {"parse_plan": "#3987e5", "compile": "#d95926",
+         "execute": "#199e70", "materialize": "#c98500",
+         "host_staging": "#d55181", "exchange": "#008300",
+         "retry_backoff": "#9085e9", "residual": "#6e6d69"}
+
+_CSS = """
+:root { color-scheme: light dark; }
+body { font: 13px/1.45 system-ui, sans-serif; margin: 24px;
+       background: #fcfcfb; color: #0b0b0b; }
+h1 { font-size: 18px; } h2 { font-size: 15px; margin-top: 28px; }
+table { border-collapse: collapse; margin: 8px 0; }
+th, td { padding: 3px 10px; text-align: right;
+         border-bottom: 1px solid #e4e3df; }
+th { color: #52514e; font-weight: 600; }
+td.q, th.q { text-align: left; font-family: ui-monospace, monospace; }
+.bar { display: flex; width: 620px; height: 14px; gap: 2px; }
+.bar span { display: block; height: 100%; border-radius: 3px;
+            min-width: 0; }
+.legend { display: flex; gap: 16px; flex-wrap: wrap; margin: 8px 0;
+          color: #52514e; }
+.legend i { display: inline-block; width: 10px; height: 10px;
+            border-radius: 3px; margin-right: 5px; }
+.lane { position: relative; height: 18px; margin: 3px 0;
+        background: #f0efec; border-radius: 3px; }
+.lane b { position: absolute; top: 2px; bottom: 2px;
+          border-radius: 3px; opacity: 0.9; }
+.muted { color: #52514e; }
+%LIGHT%
+@media (prefers-color-scheme: dark) {
+  body { background: #1a1a19; color: #ffffff; }
+  th { color: #c3c2b7; } th, td { border-color: #383835; }
+  .legend { color: #c3c2b7; } .lane { background: #242423; }
+  .muted { color: #c3c2b7; }
+  %DARK%
+}
+"""
+
+
+def _css_vars() -> str:
+    light = " ".join(f".c-{k} {{ background: {v}; }}"
+                     for k, v in _LIGHT.items())
+    dark = " ".join(f".c-{k} {{ background: {v}; }}"
+                    for k, v in _DARK.items())
+    return _CSS.replace("%LIGHT%", light).replace("%DARK%", dark)
+
+
+def _esc(s) -> str:
+    return _html.escape(str(s))
+
+
+def _bar(row: dict) -> str:
+    wall = max(row["wall_ms"], 1e-9)
+    segs = []
+    parts = list(row["categories"].items())
+    parts.append(("residual", max(row["residual_ms"], 0.0)))
+    for cat, ms in parts:
+        if ms <= 0:
+            continue
+        pct = 100.0 * ms / wall
+        segs.append(
+            f'<span class="c-{cat}" style="width:{pct:.2f}%" '
+            f'title="{_esc(row["query"])} {cat}: {ms:.1f} ms '
+            f'({pct:.1f}%)"></span>')
+    return f'<div class="bar">{"".join(segs)}</div>'
+
+
+def _legend() -> str:
+    items = "".join(
+        f'<span><i class="c-{c}"></i>{c}</span>'
+        for c in list(CATEGORIES) + ["residual"])
+    return f'<div class="legend">{items}</div>'
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return ""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024
+
+
+def _timeline(events: list[dict]) -> str:
+    """Stream-overlap timeline: one lane per (pid, tid), one bar per
+    root ``query`` event — concurrency (throughput streams) is visible
+    as vertical overlap. Single-lane power runs render too (a gap map
+    is still informative)."""
+    qevents = [e for e in events if e.get("name") == "query"
+               and isinstance(e.get("ts"), (int, float))]
+    if not qevents:
+        return ""
+    t0 = min(e["ts"] for e in qevents)
+    t1 = max(e["ts"] + e.get("dur", 0) for e in qevents)
+    span_us = max(t1 - t0, 1.0)
+    lanes: dict = {}
+    for e in qevents:
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    rows = []
+    for i, (lane, evs) in enumerate(sorted(lanes.items()), 1):
+        bars = "".join(
+            f'<b class="c-execute" '
+            f'style="left:{100.0 * (e["ts"] - t0) / span_us:.2f}%;'
+            f'width:{max(100.0 * e.get("dur", 0) / span_us, 0.15):.2f}%"'
+            f' title="{_esc(e.get("args", {}).get("query", "?"))}'
+            f' {e.get("dur", 0) / 1000.0:.1f} ms"></b>'
+            for e in sorted(evs, key=lambda e: e["ts"]))
+        rows.append(
+            f'<div class="lane" title="stream {i}">{bars}</div>')
+    return (f"<h2>Stream overlap timeline</h2>"
+            f'<p class="muted">{len(lanes)} lane(s), '
+            f"{span_us / 1e6:.2f} s span; hover a bar for the query."
+            f"</p>{''.join(rows)}")
+
+
+def render_html(analysis: dict, diff: dict | None = None,
+                top: int = 10) -> str:
+    """Self-contained report (no external assets, stdlib only)."""
+    t = analysis["totals"]
+    out = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        "<title>ndsreport</title>",
+        f"<style>{_css_vars()}</style></head><body>",
+        f"<h1>Run analysis — {_esc(analysis['run_dir'])}</h1>",
+        f"<p class='muted'>{len(analysis['queries'])} quer(ies), "
+        f"{t['wall_ms'] / 1000.0:.2f} s total wall-clock, "
+        f"{len(analysis['failed'])} failed</p>",
+        "<h2>Per-query time attribution</h2>", _legend(),
+        "<table><tr><th class='q'>query</th><th>wall ms</th>"
+        "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
+        "<th>retries</th><th>mem HWM</th><th>status</th></tr>",
+    ]
+    for row in analysis["queries"]:
+        out.append(
+            f"<tr><td class='q'>{_esc(row['query'])}</td>"
+            f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
+            f"<td>{row['residual_ms']:.1f}</td>"
+            f"<td>{row['compiles']}</td><td>{row['retries']}</td>"
+            f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
+            f"<td>{_esc(row['status'])}</td></tr>")
+    out.append("</table>")
+    out.append(f"<h2>Slowest {min(top, len(analysis['queries']))}</h2>")
+    out.append("<table><tr><th class='q'>query</th><th>wall ms</th>"
+               "<th>steady ms</th><th>compile ms</th></tr>")
+    by_name = {r["query"]: r for r in analysis["queries"]}
+    for q in analysis["slowest"][:top]:
+        r = by_name[q]
+        out.append(f"<tr><td class='q'>{_esc(q)}</td>"
+                   f"<td>{r['wall_ms']:.1f}</td>"
+                   f"<td>{steady_ms(r):.1f}</td>"
+                   f"<td>{r['categories']['compile']:.1f}</td></tr>")
+    out.append("</table>")
+    if diff:
+        out.append("<h2>Diff vs "
+                   f"{_esc(diff.get('base_dir') or 'baseline')}</h2>")
+        out.append(f"<pre>{_esc(format_diff(diff))}</pre>")
+    m = analysis["metrics"]
+    if m["counters"] or m["histograms"]:
+        out.append("<h2>Metrics</h2>")
+        out.append("<table><tr><th class='q'>counter</th>"
+                   "<th>total</th></tr>")
+        for name, v in sorted(m["counters"].items()):
+            out.append(f"<tr><td class='q'>{_esc(name)}</td>"
+                       f"<td>{v:g}</td></tr>")
+        out.append("</table>")
+        if m["histograms"]:
+            out.append("<table><tr><th class='q'>histogram</th>"
+                       "<th>count</th><th>sum</th><th>p50</th>"
+                       "<th>p95</th><th>p99</th></tr>")
+            for name, h in sorted(m["histograms"].items()):
+                cells = "".join(
+                    f"<td>{h.get(k):g}</td>" if h.get(k) is not None
+                    else "<td></td>"
+                    for k in ("count", "sum", "p50", "p95", "p99"))
+                out.append(f"<tr><td class='q'>{_esc(name)}</td>"
+                           f"{cells}</tr>")
+            out.append("</table>")
+    out.append(_timeline(analysis["trace_events"]))
+    out.append("</body></html>")
+    return "".join(out)
+
+
+# ------------------------------------------------------------ artifacts
+
+def write_outputs(analysis: dict, out_dir: str,
+                  diff: dict | None = None) -> dict:
+    """Persist ``analysis.json`` + ``report.html`` into ``out_dir``;
+    returns {kind: path}. Trace events stay out of the JSON (they are
+    already on disk next to it)."""
+    os.makedirs(out_dir, exist_ok=True)
+    doc = {k: v for k, v in analysis.items() if k != "trace_events"}
+    if diff:
+        doc["diff"] = diff
+    paths = {"analysis": os.path.join(out_dir, "analysis.json"),
+             "report": os.path.join(out_dir, "report.html")}
+    with open(paths["analysis"], "w") as f:
+        json.dump(doc, f, indent=2)
+    with open(paths["report"], "w") as f:
+        f.write(render_html(analysis, diff))
+    return paths

@@ -1,0 +1,154 @@
+"""Per-query device-memory high-water-mark tracking.
+
+"Large Scale Distributed Linear Algebra With Tensor Processing Units"
+(PAPERS.md) plans its whole decomposition around explicit per-chip
+memory budgets; this engine had no per-query memory signal at all — an
+OOM was the first and only indication a query was near the edge.  This
+module is the gauge: a process-global tracker the executors feed, read
+out once per query by the power loop into the BenchReport ``memory``
+block (``{"device_hwm_bytes": int, "source": "device"|"accounted"}``).
+
+Two signal sources, best available wins:
+
+- **Device stats** (``source="device"``): ``jax`` device
+  ``memory_stats()["bytes_in_use"]`` summed across addressable
+  devices, sampled at the bracketing points the executors already own
+  (post-dispatch, post-materialize).  Only consulted when the jax
+  backend is ALREADY initialized — the reporter's rule (utils/report.py)
+  that observability must never force platform discovery (a dead
+  remote-TPU tunnel blocks forever) applies here too.
+- **Live-buffer accounting** (``source="accounted"``): executors
+  ``add_live``/``sub_live`` the bytes they upload (scan buffers, chunk
+  windows); the high-water mark is the max concurrent total.  This is
+  the fallback on backends without allocator stats (CPU, virtual mesh)
+  and the only signal the pure-pandas CPU oracle has.
+
+The HWM is monotone within a query and resets between queries
+(``reset_query()`` in the power loop); the current value also lands on
+the ``device_hwm_bytes`` metrics gauge so live snapshots
+(obs/snapshot.py) expose it mid-run.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+
+
+def table_bytes(table) -> int:
+    """Host-side byte size of a HostTable (values + null masks) — the
+    unit of live-buffer accounting for executors that never upload."""
+    total = 0
+    for c in table.columns.values():
+        total += c.values.nbytes
+        if c.null_mask is not None:
+            total += c.null_mask.nbytes
+    return total
+
+
+def _device_bytes_in_use() -> int | None:
+    """Sum of ``bytes_in_use`` across already-initialized jax devices,
+    or None when stats are unavailable. NEVER initializes a backend
+    (the utils/report.py rule: discovery can block forever on a dead
+    chip tunnel)."""
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+        if not getattr(_xb, "_backends", None):
+            return None
+        total, seen = 0, False
+        for d in jax.devices():
+            stats = getattr(d, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if stats and "bytes_in_use" in stats:
+                total += int(stats["bytes_in_use"])
+                seen = True
+        return total if seen else None
+    except Exception:  # noqa: BLE001 - gauge must never fail a query
+        return None
+
+
+class MemoryTracker:
+    """Monotone-within-query high-water mark over both signal
+    sources."""
+
+    def __init__(self) -> None:
+        self._live = 0          # accounted live-buffer bytes
+        self._hwm = 0
+        self._source = "accounted"
+
+    # ------------------------------------------------------- accounting
+
+    def reset_query(self) -> None:
+        """Start a fresh query window. Accounted live bytes CARRY OVER
+        (session-pooled scan buffers outlive queries); only the
+        high-water mark resets — to the current live level, so the new
+        query's HWM reflects what is resident while IT runs."""
+        with _LOCK:
+            self._hwm = self._live
+            self._source = "accounted"
+            self._publish()
+
+    def add_live(self, nbytes: float) -> None:
+        with _LOCK:
+            self._live += int(nbytes)
+            if self._live > self._hwm:
+                self._hwm = self._live
+                self._publish()
+
+    def sub_live(self, nbytes: float) -> None:
+        with _LOCK:
+            self._live = max(0, self._live - int(nbytes))
+
+    def sample_device(self) -> None:
+        """Fold an allocator reading into the HWM (device stats
+        dominate accounting whenever available)."""
+        v = _device_bytes_in_use()
+        if v is None:
+            return
+        with _LOCK:
+            self._source = "device"
+            if v > self._hwm:
+                self._hwm = v
+                self._publish()
+
+    def _publish(self) -> None:
+        # inside _LOCK; the metrics registry has its own lock and never
+        # takes this one, so the ordering cannot deadlock
+        from nds_tpu.obs import metrics as obs_metrics
+        obs_metrics.gauge("device_hwm_bytes").set(self._hwm)
+
+    # ---------------------------------------------------------- readout
+
+    def high_water(self) -> dict | None:
+        """BenchReport ``memory`` block, or None when the query touched
+        no tracked memory (the harness-only paths)."""
+        with _LOCK:
+            if self._hwm <= 0:
+                return None
+            return {"device_hwm_bytes": self._hwm,
+                    "source": self._source}
+
+
+TRACKER = MemoryTracker()
+
+
+def reset_query() -> None:
+    TRACKER.reset_query()
+
+
+def add_live(nbytes: float) -> None:
+    TRACKER.add_live(nbytes)
+
+
+def sub_live(nbytes: float) -> None:
+    TRACKER.sub_live(nbytes)
+
+
+def sample_device() -> None:
+    TRACKER.sample_device()
+
+
+def high_water() -> dict | None:
+    return TRACKER.high_water()

@@ -34,6 +34,7 @@ JSON under ``metrics``.
 from __future__ import annotations
 
 import threading
+from collections import deque
 
 
 class Counter:
@@ -67,10 +68,17 @@ class Gauge:
 
 
 class Histogram:
-    """count/sum/min/max summary — enough for latency distributions at
-    query granularity without bucket-boundary bikeshedding."""
+    """count/sum/min/max plus p50/p95/p99 — latency distributions at
+    query granularity without bucket-boundary bikeshedding. Quantiles
+    come from a bounded window of the most recent observations (a
+    99-query power run fits entirely; beyond that the tail quantiles
+    track recent behavior, which is what a live snapshot wants)."""
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+    # recent-observation window the quantiles are computed over
+    WINDOW = 2048
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_samples",
+                 "_lock")
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
@@ -78,6 +86,7 @@ class Histogram:
         self.sum = 0.0
         self.min: float | None = None
         self.max: float | None = None
+        self._samples: deque = deque(maxlen=self.WINDOW)
         self._lock = lock
 
     def observe(self, v: float) -> None:
@@ -86,10 +95,22 @@ class Histogram:
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            self._samples.append(v)
+
+    def percentiles(self) -> dict:
+        """Nearest-rank p50/p95/p99 over the recent-sample window
+        ({} before the first observation)."""
+        s = sorted(self._samples)
+        if not s:
+            return {}
+        n = len(s)
+        return {f"p{q}": s[min(n - 1, max(0, (q * n + 99) // 100 - 1))]
+                for q in (50, 95, 99)}
 
     def summary(self) -> dict:
         return {"count": self.count, "sum": self.sum,
-                "min": self.min, "max": self.max}
+                "min": self.min, "max": self.max,
+                **self.percentiles()}
 
 
 class MetricsRegistry:
@@ -165,7 +186,13 @@ def delta(before: dict, after: dict) -> dict:
             name, {"count": 0, "sum": 0.0})
         dc = h["count"] - b["count"]
         if dc:
-            hists[name] = {"count": dc, "sum": h["sum"] - b["sum"]}
+            entry = {"count": dc, "sum": h["sum"] - b["sum"]}
+            # quantiles are distribution state, not increments: carry
+            # the AFTER snapshot's values so each BenchReport shows the
+            # latency distribution as of that query
+            entry.update({k: h[k] for k in ("p50", "p95", "p99")
+                          if k in h})
+            hists[name] = entry
     if hists:
         out["histograms"] = hists
     return out

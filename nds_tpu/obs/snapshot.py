@@ -1,0 +1,206 @@
+"""Live metrics snapshot emitter: observable runs, not just post-mortems.
+
+A 99-query power run or a 4-stream throughput round can hold the
+terminal for an hour; until now the only signals were stdout lines and
+the artifacts written AFTER the run.  ``NDS_TPU_METRICS_SNAP=
+path[:interval]`` starts a daemon thread in the power loop that every
+``interval`` seconds (default 5) writes the global metrics registry to:
+
+- ``path`` — one JSON object (atomic tmp+rename, so a watcher never
+  reads a torn file): ``{"ts", "progress", "counters", "gauges",
+  "histograms"}``;
+- the sibling OpenMetrics text file (``path`` with its extension
+  replaced by ``.om``) — counter/gauge/summary families with
+  ``nds_tpu_`` prefixes and a terminating ``# EOF``, scrapeable by
+  anything Prometheus-shaped without new dependencies.
+
+``progress`` is a caller-owned dict the power loop mutates in place
+(current query, completed count), so the snapshot answers "where is it
+and is it moving" — the two questions a stuck run raises first.  The
+emitter is pure stdlib, failure-isolated (an unwritable path degrades
+to a warning, never a query failure), and always writes one final
+snapshot on ``stop()`` so short runs still leave a file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+SNAP_ENV = "NDS_TPU_METRICS_SNAP"
+DEFAULT_INTERVAL_S = 5.0
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def parse_spec(spec: str) -> tuple[str, float]:
+    """``path[:interval_s]`` -> (path, interval). A trailing segment
+    that doesn't parse as a number is part of the path (Windows-style
+    or exotic paths keep working)."""
+    path, sep, tail = spec.rpartition(":")
+    if sep:
+        try:
+            return path, max(0.05, float(tail))
+        except ValueError:
+            pass
+    return spec, DEFAULT_INTERVAL_S
+
+
+def om_path_for(json_path: str) -> str:
+    root, ext = os.path.splitext(json_path)
+    return (root if ext else json_path) + ".om"
+
+
+def _metric_name(name: str) -> str:
+    return "nds_tpu_" + _NAME_RE.sub("_", name)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def to_openmetrics(snap: dict) -> str:
+    """Render one registry snapshot as OpenMetrics text: counters (the
+    ``_total`` suffix moves from family name to sample name), gauges,
+    and histograms as summary families (count/sum + quantile samples
+    from the p50/p95/p99 window)."""
+    lines: list[str] = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        fam = _metric_name(name)
+        fam = fam[:-len("_total")] if fam.endswith("_total") else fam
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam}_total {_fmt(v)}")
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        fam = _metric_name(name)
+        lines.append(f"# TYPE {fam} gauge")
+        lines.append(f"{fam} {_fmt(v)}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        fam = _metric_name(name)
+        lines.append(f"# TYPE {fam} summary")
+        for q in ("p50", "p95", "p99"):
+            if h.get(q) is not None:
+                lines.append(
+                    f'{fam}{{quantile="0.{q[1:]}"}} {_fmt(h[q])}')
+        lines.append(f"{fam}_count {_fmt(h.get('count', 0))}")
+        lines.append(f"{fam}_sum {_fmt(h.get('sum', 0.0))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"            # metric name
+    r"(\{[a-zA-Z0-9_]+=\"[^\"\\]*\"(,[a-zA-Z0-9_]+=\"[^\"\\]*\")*\})?"
+    r" -?[0-9][0-9eE.+-]*$")                # value
+
+
+def validate_openmetrics(text: str) -> list[str]:
+    """Schema errors for an OpenMetrics exposition ([] = valid): every
+    line is a ``# TYPE``/``# HELP`` comment or a sample matching the
+    declared families, and the document ends with ``# EOF``."""
+    errors: list[str] = []
+    lines = text.splitlines()
+    if not lines or lines[-1] != "# EOF":
+        errors.append("missing terminating '# EOF' line")
+    families: set[str] = set()
+    for i, line in enumerate(lines, 1):
+        if not line:
+            errors.append(f"line {i}: blank line")
+            continue
+        if line == "# EOF":
+            if i != len(lines):
+                errors.append(f"line {i}: '# EOF' before end of file")
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[1] not in ("TYPE", "HELP"):
+                errors.append(f"line {i}: malformed comment {line!r}")
+            elif parts[1] == "TYPE":
+                families.add(parts[2])
+            continue
+        if not _SAMPLE_RE.match(line):
+            errors.append(f"line {i}: malformed sample {line!r}")
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        base = name
+        for suffix in ("_total", "_count", "_sum"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                break
+        if name not in families and base not in families:
+            errors.append(f"line {i}: sample {name!r} has no # TYPE")
+    return errors
+
+
+class MetricsSnapshotter:
+    """Daemon-thread periodic writer over the global registry."""
+
+    def __init__(self, path: str, interval_s: float = DEFAULT_INTERVAL_S,
+                 registry=None, progress: dict | None = None):
+        from nds_tpu.obs import metrics as obs_metrics
+        self.path = path
+        self.interval_s = interval_s
+        self.registry = registry or obs_metrics.REGISTRY
+        self.progress = progress if progress is not None else {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._warned = False
+
+    @classmethod
+    def from_env(cls, progress: dict | None = None
+                 ) -> "MetricsSnapshotter | None":
+        spec = os.environ.get(SNAP_ENV)
+        if not spec:
+            return None
+        path, interval = parse_spec(spec)
+        return cls(path, interval, progress=progress)
+
+    def write_once(self) -> None:
+        snap = self.registry.snapshot()
+        doc = {"ts": time.time(), "progress": dict(self.progress),
+               **snap}
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # pid-suffixed tmp: two processes pointed at the same
+            # snapshot path (mis-threaded env) must still each rename
+            # a COMPLETE file into place, never interleave one tmp
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+            om = om_path_for(self.path)
+            with open(f"{om}.{os.getpid()}.tmp", "w") as f:
+                f.write(to_openmetrics(snap))
+            os.replace(f"{om}.{os.getpid()}.tmp", om)
+        except OSError as exc:
+            if not self._warned:  # observability must not fail the run
+                self._warned = True
+                print(f"[obs] metrics snapshot write failed: {exc}")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write_once()
+
+    def start(self) -> "MetricsSnapshotter":
+        if self._thread is None:
+            self.write_once()  # a file exists from t=0, not t=interval
+            self._thread = threading.Thread(
+                target=self._loop, name="nds-tpu-metrics-snap",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.write_once()  # final state always lands

@@ -32,6 +32,7 @@ and enforced by ``tools/check_trace_schema.py``.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -222,6 +223,10 @@ class Tracer:
         # span-vs-TimeLog agreement; the loop flushes after the bracket
         self.defer_exports = False
         self._pending: list = []
+        # root spans begun but not yet ended: an abnormal exit (crash,
+        # deadline kill that unwinds) salvages these as a truncated
+        # trace instead of losing the in-flight query entirely
+        self._open_roots: set = set()
 
     # ------------------------------------------------------------- stack
 
@@ -252,7 +257,10 @@ class Tracer:
         span."""
         if not self.enabled:
             return NOOP_SPAN
-        return Span(self, name, self.current(), attrs)
+        s = Span(self, name, self.current(), attrs)
+        if s.parent is None:
+            self._open_roots.add(s)
+        return s
 
     def begin(self, name: str, parent: "Span | None | object" = _CURRENT,
               t0: float | None = None, **attrs):
@@ -265,7 +273,10 @@ class Tracer:
             parent = self.current()
         elif isinstance(parent, _NoopSpan):
             parent = None
-        return Span(self, name, parent, attrs, t0=t0)
+        s = Span(self, name, parent, attrs, t0=t0)
+        if s.parent is None:
+            self._open_roots.add(s)
+        return s
 
     def attach(self, span) -> _Attach:
         """Make an owned span current for a ``with`` block (no end on
@@ -275,6 +286,7 @@ class Tracer:
     # ------------------------------------------------------------ export
 
     def _finish_root(self, root: Span) -> None:
+        self._open_roots.discard(root)
         self.last_roots.append(root)
         path = os.environ.get(TRACE_ENV)
         if not path:
@@ -287,14 +299,34 @@ class Tracer:
         except OSError:  # tracing must never fail the query
             pass
 
-    def flush_exports(self) -> None:
-        """Write every parked root tree (defer_exports mode)."""
+    def flush_exports(self, close_roots: bool = False) -> None:
+        """Write every parked root tree (defer_exports mode).
+        Idempotent — the pending list drains on the first call, and a
+        second call is a no-op. ``close_roots=True`` (the atexit path)
+        first ends any still-open root span so a crashed or
+        deadline-killed run leaves a readable, truncated trace instead
+        of losing the in-flight tree."""
+        if close_roots:
+            self.defer_exports = False  # nothing re-parks at exit
+            for root in list(self._open_roots):
+                try:
+                    root.set(truncated=True).end()
+                except Exception:  # noqa: BLE001 - exit path
+                    self._open_roots.discard(root)
         pending, self._pending = self._pending, []
         for root, path in pending:
             try:
                 export_chrome(root, path)
             except OSError:
                 pass
+        if close_roots:
+            with _EXPORT_LOCK:
+                for f in _EXPORT_FILES.values():
+                    try:
+                        if not f.closed:
+                            f.flush()
+                    except OSError:
+                        pass
 
 
 # held-open export handles, one per trace path: the export runs inside
@@ -349,6 +381,13 @@ def timings_from_span(root) -> dict:
 
 
 _TRACER = Tracer()
+
+# exit-time flush for the GLOBAL tracer only (per-instance registration
+# would pin every test-constructed tracer and its span trees forever):
+# a crashed/deadline-killed run keeps whatever the buffer held, and any
+# still-open root exports as a truncated tree (idempotent — a clean run
+# flushes nothing twice)
+atexit.register(_TRACER.flush_exports, close_roots=True)
 
 
 def get_tracer() -> Tracer:

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P_
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
 from nds_tpu.io.host_table import HostTable
+from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.parallel.exchange import exchange, exchange_hierarchical
@@ -263,6 +264,12 @@ class DistributedExecutor(dx.DeviceExecutor):
                 # a staged sub's span must not survive as the failed
                 # query's (subs set last_query_span on their success)
                 self.last_query_span = None
+                # release the attempt's accounted scan bytes (success
+                # and overflow paths release inline by popping the same
+                # token, so this covers ONLY raises between the add and
+                # either release — never a second release)
+                memwatch.sub_live(
+                    (self.last_timings or {}).pop("__live_bytes", 0.0))
                 qspan.set(error=f"{type(exc).__name__}: {exc}").end()
                 raise
         qspan.set(timings=dict(timings)).end()
@@ -332,6 +339,13 @@ class DistributedExecutor(dx.DeviceExecutor):
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
+            # memory HWM (obs/memwatch): accounted scan bytes go live
+            # for this attempt; device stats dominate when available.
+            # __live_bytes is the pop-once release token (a failure
+            # after an inline release must not release twice)
+            memwatch.add_live(timings["bytes_scanned"])
+            timings["__live_bytes"] = timings["bytes_scanned"]
+            memwatch.sample_device()
             # ndslint: waive[NDS102] -- execute bracket start; closed below after device_get
             t1 = _time.perf_counter()
             row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
@@ -347,10 +361,13 @@ class DistributedExecutor(dx.DeviceExecutor):
                                             side)
                 # ndslint: waive[NDS102] -- host materialize endpoint bracketed by the device.materialize span
                 t3 = _time.perf_counter()
+                memwatch.sample_device()
+                memwatch.sub_live(timings.pop("__live_bytes", 0.0))
                 timings["execute_ms"] = (t2 - t1) * 1000
                 timings["materialize_ms"] = (t3 - t2) * 1000
                 self._finalize_timings(timings, key)
                 return out, timings
+            memwatch.sub_live(timings.pop("__live_bytes", 0.0))
             n_over = int(overflow_h)
             TaskFailureCollector.notify(
                 f"exchange overflow ({n_over} rows) at slack="

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 
 from nds_tpu import obs
 from nds_tpu.engine.session import Session
+from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience import faults
@@ -218,7 +219,33 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     regardless of earlier failures (the reference never aborts
     mid-stream; ``--allow_failure`` only downgrades the exit code,
     `nds/nds_power.py:391-393` — handled by the driver mains). Returns
-    the number of failed queries."""
+    the number of failed queries.
+
+    With ``NDS_TPU_METRICS_SNAP=path[:interval]`` set, a snapshot
+    emitter (nds_tpu/obs/snapshot.py) publishes the metrics registry +
+    run progress periodically while the stream runs, so long runs are
+    observable in flight, not only post-mortem."""
+    from nds_tpu.obs.snapshot import MetricsSnapshotter
+    progress = {"suite": suite.name, "stream": stream_path,
+                "queries_completed": 0, "current_query": None}
+    snap = MetricsSnapshotter.from_env(progress)
+    if snap:
+        snap.start()
+    try:
+        return _run_query_stream(
+            suite, data_dir, stream_path, time_log_path, config,
+            input_format, json_summary_folder, output_prefix, warmup,
+            query_subset, profile_dir, extra_time_log, progress)
+    finally:
+        if snap:
+            progress["current_query"] = None
+            snap.stop()
+
+
+def _run_query_stream(suite, data_dir, stream_path, time_log_path,
+                      config, input_format, json_summary_folder,
+                      output_prefix, warmup, query_subset, profile_dir,
+                      extra_time_log, progress) -> int:
     config = config or EngineConfig()
     session = make_session(suite, config)
     backend = config.get("engine.backend", "cpu")
@@ -242,6 +269,8 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     if query_subset:
         queries = type(queries)(
             (q, s) for q, s in queries.items() if q in query_subset)
+    progress["app_id"] = app_id
+    progress["queries_total"] = len(queries)
     if json_summary_folder:
         os.makedirs(json_summary_folder, exist_ok=True)
     profiler_cm = None
@@ -277,6 +306,11 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                             break
             finally:
                 wtracer.enabled = was_enabled
+        progress["current_query"] = qname
+        # fresh per-query memory window (obs/memwatch): the HWM is
+        # monotone within the query and resets here, so each summary's
+        # ``memory`` block reflects what was resident while IT ran
+        memwatch.reset_query()
         report = BenchReport(qname, config.as_dict())
         out_pref = output_prefix if primary else None
         # a query that fails BEFORE reaching the executor (parse/plan
@@ -329,12 +363,16 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         executor = session._executor_factory(session.tables)
         timings = obs.query_timings(executor)
         if timings:
+            # dunder keys are executor-internal accounting state (the
+            # memwatch release token), never part of the summary
             summary["engineTimings"] = {k: round(v, 3)
-                                        for k, v in timings.items()}
+                                        for k, v in timings.items()
+                                        if not k.startswith("__")}
         qspan = qhold.get("span")
         if qspan:
             summary["spans"] = qspan.to_dict()
         report.attach_retry(rstats)
+        report.attach_memory(memwatch.high_water())
         elapsed_ms = summary["queryTimes"][-1]
         obs_metrics.counter("queries_total").inc()
         obs_metrics.histogram("query_seconds").observe(
@@ -369,6 +407,7 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         if mdelta:
             summary["metrics"] = mdelta
         tlog.add(qname, elapsed_ms)
+        progress["queries_completed"] += 1
         print(f"====== Run {qname} ======")
         print(f"Time taken: {elapsed_ms} millis for {qname}")
         if json_summary_folder and primary:

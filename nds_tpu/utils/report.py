@@ -18,7 +18,10 @@ nds_tpu/obs/trace.py) and ``metrics`` (the per-query delta of the
 global counter registry) to each summary; both are absent when the
 corresponding subsystem recorded nothing. The resilience layer
 (README "Resilience") adds ``retries`` plus, when set,
-``gave_up_reason`` and ``deadline_exceeded`` via ``attach_retry``.
+``gave_up_reason`` and ``deadline_exceeded`` via ``attach_retry``;
+``attach_memory`` adds the per-query device-memory high-water mark
+(``memory``, fed by obs/memwatch.py). ``tools/check_trace_schema.py
+--summary`` validates the full shape.
 """
 
 from __future__ import annotations
@@ -205,6 +208,15 @@ class BenchReport:
             self.summary["gave_up_reason"] = stats.gave_up_reason
         if stats.deadline_exceeded:
             self.summary["deadline_exceeded"] = True
+
+    def attach_memory(self, hwm: dict | None) -> None:
+        """Record the per-query device-memory high-water mark
+        (obs/memwatch.py) as the ``memory`` block:
+        ``{"device_hwm_bytes": int, "source": "device"|"accounted"}``.
+        Absent when the query touched no tracked memory (README
+        "Observability" schema)."""
+        if hwm:
+            self.summary["memory"] = dict(hwm)
 
     def write_summary(self, prefix: str = "",
                       out_dir: str | None = None) -> str:

@@ -218,7 +218,8 @@ class TestMetrics:
         assert snap["counters"]["c"] == 3.5
         assert snap["gauges"]["g"] == 7
         assert snap["histograms"]["h"] == {
-            "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0}
+            "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0,
+            "p50": 1.0, "p95": 3.0, "p99": 3.0}
 
     def test_delta(self):
         reg = obs_metrics.MetricsRegistry()
@@ -232,7 +233,11 @@ class TestMetrics:
         d = obs_metrics.delta(before, reg.snapshot())
         assert d["counters"] == {"a": 3, "b": 1}
         assert d["gauges"] == {"g": 1}
-        assert d["histograms"]["h"] == {"count": 1, "sum": 4.0}
+        # count/sum are deltas; the quantiles are the AFTER snapshot's
+        # distribution state
+        assert d["histograms"]["h"] == {"count": 1, "sum": 4.0,
+                                        "p50": 2.0, "p95": 4.0,
+                                        "p99": 4.0}
         assert obs_metrics.delta(before, before) == {}
 
     def test_counter_thread_safety(self):

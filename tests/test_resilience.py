@@ -704,7 +704,7 @@ class TestBenchResume:
         from nds_tpu.nds import bench as bench_mod
         from nds_tpu.utils.timelog import TimeLog
 
-        def fake_run(cmd, backend=None):
+        def fake_run(cmd, backend=None, extra_env=None):
             calls.append(cmd[2])
             mod = cmd[2]
             if mod == "nds_tpu.nds.transcode":

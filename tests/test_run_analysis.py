@@ -1,0 +1,482 @@
+"""Run-analysis layer tests (nds_tpu/obs/analyze.py + friends): the
+attribution-sums-to-wall-clock invariant on a REAL 3-query CPU power
+run, the noise-aware diff gate on the committed golden run-dirs
+(regression / improvement / noise / added / removed), HTML report
+smoke-parse, per-query memory HWM monotonicity + reset, the live
+snapshot emitter's OpenMetrics validity, the BenchReport summary
+schema gate, and the tracer's abnormal-exit flush."""
+
+import html.parser
+import json
+import os
+import time
+
+import pytest
+
+from nds_tpu.obs import analyze, memwatch
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs.snapshot import (
+    MetricsSnapshotter, om_path_for, parse_spec, to_openmetrics,
+    validate_openmetrics,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+RUN_A = os.path.join(FIXTURES, "run_a")
+RUN_B = os.path.join(FIXTURES, "run_b")
+
+
+# ------------------------------------------------- attribution (units)
+
+class TestAttribution:
+    def test_categories_plus_residual_sum_exactly(self):
+        for run in (RUN_A, RUN_B):
+            a = analyze.analyze_run(run)
+            for row in a["queries"]:
+                total = (sum(row["categories"].values())
+                         + row["residual_ms"])
+                assert total == pytest.approx(row["wall_ms"], abs=1e-9)
+
+    def test_unmapped_self_time_bills_nearest_ancestor(self):
+        # a child with an unmapped name inside stage.sub bills its
+        # self-time to host_staging, not to residual
+        summary = {
+            "query": "q", "queryStatus": ["Completed"],
+            "queryTimes": [100], "startTime": 1,
+            "spans": {"name": "query", "dur_ms": 95.0, "children": [
+                {"name": "stage.sub", "dur_ms": 40.0, "children": [
+                    {"name": "device.execute", "dur_ms": 30.0,
+                     "children": [
+                         {"name": "device.run", "dur_ms": 25.0,
+                          "children": []}]}]}]},
+        }
+        row = analyze.attribute_query(summary)
+        cats = row["categories"]
+        # stage.sub self 10 + device.execute self 5 -> host_staging
+        assert cats["host_staging"] == pytest.approx(15.0)
+        assert cats["execute"] == pytest.approx(25.0)
+        # query self-time (95-40=55) has no categorized ancestor
+        assert row["residual_ms"] == pytest.approx(100 - 40.0)
+
+    def test_retry_backoff_is_its_own_category(self):
+        summary = {"query": "q", "queryStatus": ["Completed"],
+                   "queryTimes": [1000], "startTime": 1,
+                   "retry_backoff_s": 0.25}
+        row = analyze.attribute_query(summary)
+        assert row["categories"]["retry_backoff"] == pytest.approx(250.0)
+        assert row["residual_ms"] == pytest.approx(750.0)
+
+    def test_dedupe_suffixes_by_wall_rank_not_arrival(self):
+        # stream-scheduling jitter must not re-label instances: the
+        # slower instance gets #2 regardless of which started first
+        def rows(order):
+            return [{"query": "q1", "wall_ms": w, "start_time": t,
+                     "categories": {}, "residual_ms": 0.0}
+                    for t, w in order]
+        a = rows([(1, 500.0), (2, 1500.0)])
+        b = rows([(1, 1500.0), (2, 500.0)])  # flipped start order
+        analyze._dedupe_names(a)
+        analyze._dedupe_names(b)
+        assert {r["query"]: r["wall_ms"] for r in a} \
+            == {r["query"]: r["wall_ms"] for r in b} \
+            == {"q1": 500.0, "q1#2": 1500.0}
+
+    def test_spanless_failed_query_is_all_residual(self):
+        row = analyze.attribute_query(
+            {"query": "q", "queryStatus": ["Failed"],
+             "queryTimes": [321], "startTime": 1})
+        assert row["status"] == "Failed"
+        assert row["residual_ms"] == pytest.approx(321.0)
+
+
+# ----------------------------------------------------------- diff gate
+
+class TestDiffGate:
+    def test_golden_run_dirs(self):
+        a = analyze.analyze_run(RUN_A)
+        b = analyze.analyze_run(RUN_B)
+        d = analyze.diff_runs(a, b, pct=10.0, abs_ms=50.0)
+        assert not d["passed"]
+        assert [e["query"] for e in d["regressions"]] == ["query1"]
+        assert [e["query"] for e in d["improvements"]] == ["query2"]
+        # query3's +5 ms is below the absolute floor: noise
+        assert any(e["query"] == "query3" for e in d["noise"])
+        assert d["removed"] == ["query4"]
+        assert d["added"] == ["query5"]
+        # query2 recompiled (1 -> 2) but is NOT a regression
+        assert any(e["query"] == "query2"
+                   for e in d["compile_changes"])
+
+    def test_identity_diff_passes(self):
+        a = analyze.analyze_run(RUN_A)
+        assert analyze.diff_runs(a, a)["passed"]
+
+    def test_gate_thresholds_are_conjunctive(self):
+        base = {"q": 100.0}
+        # +30% but only 30 ms absolute: below abs floor -> noise
+        d = analyze.diff_times(base, {"q": 130.0}, pct=10, abs_ms=50)
+        assert not d["regressions"]
+        # +60 ms but only 6%: below pct floor -> noise
+        d = analyze.diff_times({"q": 1000.0}, {"q": 1060.0},
+                               pct=10, abs_ms=50)
+        assert not d["regressions"]
+        # both floors exceeded -> regression
+        d = analyze.diff_times(base, {"q": 200.0}, pct=10, abs_ms=50)
+        assert [e["query"] for e in d["regressions"]] == ["q"]
+
+    def test_zero_baseline_regression_not_noise(self):
+        # b=0 makes the relative test vacuous; absolute growth must
+        # still fail the gate (and format without a pct)
+        d = analyze.diff_times({"q": 0.0}, {"q": 5000.0},
+                               pct=10, abs_ms=50)
+        assert [e["query"] for e in d["regressions"]] == ["q"]
+        assert d["regressions"][0]["pct"] is None
+        assert "n/a" in analyze.format_diff(
+            {**d, "compile_changes": [], "newly_failed": [],
+             "passed": False})
+        assert analyze.diff_times({"q": 0.0}, {"q": 0.0},
+                                  pct=10, abs_ms=50)["regressions"] \
+            == []
+
+    def test_parse_gate(self):
+        assert analyze.parse_gate(None) == {"pct": 10.0, "abs_ms": 50.0}
+        assert analyze.parse_gate("pct=5,abs_ms=1") == {
+            "pct": 5.0, "abs_ms": 1.0}
+        with pytest.raises(ValueError):
+            analyze.parse_gate("bogus=1")
+
+    def test_newly_failed_query_fails_gate(self):
+        a = analyze.analyze_run(RUN_A)
+        b = analyze.analyze_run(RUN_A)
+        b["queries"][0] = dict(b["queries"][0], status="Failed")
+        b["failed"] = [b["queries"][0]["query"]]
+        d = analyze.diff_runs(a, b)
+        assert d["newly_failed"] == [b["queries"][0]["query"]]
+        assert not d["passed"]
+
+    def test_cli_exit_codes(self, capsys):
+        import tools.ndsreport as ndsreport
+        assert ndsreport.main(["diff", RUN_A, RUN_B,
+                               "--gate", "pct=10"]) == 1
+        assert ndsreport.main(["diff", RUN_A, RUN_A]) == 0
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "DIFF FAILED" in out
+
+
+# -------------------------------------------------------------- report
+
+class _TagBalance(html.parser.HTMLParser):
+    VOID = {"meta", "br", "hr", "img", "input", "link"}
+
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.stack, self.errors = [], []
+
+    def handle_starttag(self, tag, attrs):
+        if tag not in self.VOID:
+            self.stack.append(tag)
+
+    def handle_endtag(self, tag):
+        if not self.stack or self.stack.pop() != tag:
+            self.errors.append(tag)
+
+
+class TestHtmlReport:
+    def test_report_smoke_parses(self, tmp_path):
+        a = analyze.analyze_run(RUN_A)
+        d = analyze.diff_runs(a, analyze.analyze_run(RUN_B))
+        paths = analyze.write_outputs(a, str(tmp_path), diff=d)
+        text = open(paths["report"]).read()
+        p = _TagBalance()
+        p.feed(text)
+        p.close()
+        assert not p.errors and not p.stack
+        # per-query bars, slowest table, diff, metrics, timeline all
+        # rendered (run_a ships a 2-lane trace.jsonl)
+        for marker in ("time attribution", "Slowest", "Diff vs",
+                       "Metrics", "Stream overlap timeline",
+                       "query1"):
+            assert marker in text, marker
+        doc = json.load(open(paths["analysis"]))
+        assert "trace_events" not in doc
+        assert doc["diff"]["regressions"]
+
+    def test_analysis_json_ignored_on_reingest(self, tmp_path):
+        # writing artifacts INTO the run dir must not change a second
+        # analysis of the same dir
+        import shutil
+        run = tmp_path / "run"
+        shutil.copytree(RUN_A, run)
+        first = analyze.analyze_run(str(run))
+        analyze.write_outputs(first, str(run))
+        second = analyze.analyze_run(str(run))
+        assert len(second["queries"]) == len(first["queries"])
+
+
+# ------------------------------------------------- real CPU power run
+
+@pytest.fixture(scope="module")
+def cpu_power_run(tmp_path_factory):
+    """A real 3-query NDS power run on the CPU backend, producing an
+    honest run dir (summaries + trace + time log)."""
+    from nds_tpu.nds import gen_data, streams
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    root = tmp_path_factory.mktemp("run_analysis_power")
+    raw = str(root / "raw")
+    sdir = str(root / "streams")
+    jsons = str(root / "json")
+    gen_data.generate_data_local(0.01, 2, raw, workers=2)
+    streams.generate_query_streams(sdir, 1, templates=[96, 7, 93])
+    trace = str(root / "json" / "trace.jsonl")
+    os.makedirs(jsons, exist_ok=True)
+    os.environ["NDS_TPU_TRACE"] = trace
+    try:
+        failures = power_core.run_query_stream(
+            SUITE, raw, os.path.join(sdir, "query_0.sql"),
+            str(root / "time.csv"),
+            config=EngineConfig(overrides={"engine.backend": "cpu"}),
+            input_format="raw", json_summary_folder=jsons)
+    finally:
+        os.environ.pop("NDS_TPU_TRACE", None)
+    assert failures == 0
+    return jsons
+
+
+class TestRealRun:
+    def test_attribution_sums_within_1ms(self, cpu_power_run):
+        """The ISSUE acceptance criterion: on a fresh 3-query CPU power
+        run, every query's categories + residual sum to the reported
+        wall-clock within 1 ms."""
+        a = analyze.analyze_run(cpu_power_run)
+        assert len(a["queries"]) == 3
+        for row in a["queries"]:
+            total = (sum(row["categories"].values())
+                     + row["residual_ms"])
+            assert abs(total - row["wall_ms"]) <= 1.0
+            # CPU oracle queries still attribute their parse time
+            assert row["categories"]["parse_plan"] > 0
+
+    def test_summaries_carry_memory_and_percentiles(self,
+                                                    cpu_power_run):
+        a = analyze.analyze_run(cpu_power_run)
+        rows_with_mem = [r for r in a["queries"] if "hwm_bytes" in r]
+        assert rows_with_mem, "no summary carried a memory block"
+        assert all(r["hwm_bytes"] > 0 for r in rows_with_mem)
+        h = a["metrics"]["histograms"].get("query_seconds")
+        assert h and "p50" in h
+
+    def test_summaries_validate_against_schema(self, cpu_power_run):
+        from tools.check_trace_schema import validate_summary_file
+        files = [f for f in os.listdir(cpu_power_run)
+                 if f.endswith(".json") and f != "analysis.json"]
+        assert files
+        for f in files:
+            assert validate_summary_file(
+                os.path.join(cpu_power_run, f)) == []
+
+    def test_cli_analyze_prints_table(self, cpu_power_run, tmp_path,
+                                      capsys):
+        import tools.ndsreport as ndsreport
+        rc = ndsreport.main(["analyze", cpu_power_run,
+                             "--out", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "TOTAL" in out and "query96" in out
+        assert (tmp_path / "report.html").exists()
+        assert (tmp_path / "analysis.json").exists()
+
+
+# ------------------------------------------------------------ memwatch
+
+class TestMemwatch:
+    def test_hwm_monotone_within_query(self):
+        tr = memwatch.MemoryTracker()
+        tr.reset_query()
+        tr.add_live(100)
+        assert tr.high_water()["device_hwm_bytes"] == 100
+        tr.add_live(50)
+        assert tr.high_water()["device_hwm_bytes"] == 150
+        tr.sub_live(120)
+        # releasing never lowers the mark
+        assert tr.high_water()["device_hwm_bytes"] == 150
+        tr.add_live(10)
+        assert tr.high_water()["device_hwm_bytes"] == 150
+
+    def test_hwm_resets_between_queries(self):
+        tr = memwatch.MemoryTracker()
+        tr.reset_query()
+        tr.add_live(1000)
+        tr.sub_live(1000)
+        assert tr.high_water()["device_hwm_bytes"] == 1000
+        tr.reset_query()
+        # new query window: the old peak is gone, pooled live bytes
+        # (none here) carry over
+        assert tr.high_water() is None
+        tr.add_live(10)
+        assert tr.high_water() == {"device_hwm_bytes": 10,
+                                   "source": "accounted"}
+
+    def test_sub_live_clamps_at_zero(self):
+        tr = memwatch.MemoryTracker()
+        tr.reset_query()
+        tr.add_live(5)
+        tr.sub_live(50)
+        tr.add_live(7)
+        assert tr.high_water()["device_hwm_bytes"] == 7
+
+    def test_gauge_mirrors_hwm(self):
+        before = obs_metrics.snapshot()
+        memwatch.TRACKER.reset_query()
+        memwatch.add_live(1 << 20)
+        try:
+            assert (obs_metrics.gauge("device_hwm_bytes").value
+                    >= 1 << 20)
+        finally:
+            memwatch.sub_live(1 << 20)
+            memwatch.TRACKER.reset_query()
+        del before
+
+    def test_table_bytes(self):
+        import numpy as np
+        from nds_tpu.engine.types import Schema
+        from nds_tpu.io.host_table import HostColumn, HostTable
+        col = HostColumn(None, np.zeros(8, dtype=np.int64), None,
+                         np.ones(8, dtype=bool))
+        t = HostTable("t", Schema.of(), {"c": col})
+        assert memwatch.table_bytes(t) == 8 * 8 + 8
+
+
+# ------------------------------------------------------------ snapshot
+
+class TestSnapshotEmitter:
+    def test_parse_spec(self):
+        assert parse_spec("/tmp/m.json:2.5") == ("/tmp/m.json", 2.5)
+        assert parse_spec("/tmp/m.json") == ("/tmp/m.json", 5.0)
+        assert om_path_for("/tmp/m.json") == "/tmp/m.om"
+        assert om_path_for("/tmp/m") == "/tmp/m.om"
+
+    def test_emitter_writes_valid_openmetrics(self, tmp_path):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("queries_total").inc(3)
+        reg.gauge("device_hwm_bytes").set(123456)
+        for v in (0.1, 0.2, 0.9):
+            reg.histogram("query_seconds").observe(v)
+        path = str(tmp_path / "snap.json")
+        progress = {"current_query": "query7"}
+        snap = MetricsSnapshotter(path, 0.05, registry=reg,
+                                  progress=progress)
+        snap.start()
+        time.sleep(0.15)
+        progress["current_query"] = "query93"
+        snap.stop()
+        doc = json.load(open(path))
+        assert doc["counters"]["queries_total"] == 3
+        # the final stop() write saw the mutated progress dict
+        assert doc["progress"]["current_query"] == "query93"
+        om = open(om_path_for(path)).read()
+        assert validate_openmetrics(om) == []
+        assert "nds_tpu_queries_total 3" in om
+        assert 'nds_tpu_query_seconds{quantile="0.50"}' in om
+        assert om.rstrip().endswith("# EOF")
+
+    def test_validator_rejects_malformed(self):
+        assert validate_openmetrics("nds_tpu_x 1\n") != []  # no EOF
+        bad = "# TYPE nds_tpu_x counter\nnds_tpu_x_total NaNish\n# EOF"
+        assert validate_openmetrics(bad) != []
+        good = to_openmetrics({"counters": {"a_total": 1},
+                               "gauges": {"g": 2.5},
+                               "histograms": {"h": {
+                                   "count": 1, "sum": 2.0,
+                                   "p50": 2.0, "p95": 2.0,
+                                   "p99": 2.0}}})
+        assert validate_openmetrics(good) == []
+
+    def test_power_loop_env_integration(self, tmp_path, monkeypatch):
+        # from_env + the power loop's start/stop contract: a run with
+        # the env set leaves a final snapshot even if shorter than the
+        # interval
+        path = str(tmp_path / "live.json")
+        monkeypatch.setenv("NDS_TPU_METRICS_SNAP", f"{path}:60")
+        snap = MetricsSnapshotter.from_env({"queries_completed": 0})
+        assert snap is not None and snap.interval_s == 60.0
+        snap.start()
+        snap.stop()
+        assert json.load(open(path))["progress"] == {
+            "queries_completed": 0}
+        assert validate_openmetrics(open(om_path_for(path)).read()) \
+            == []
+
+
+# ----------------------------------------------- summary schema gate
+
+class TestSummarySchema:
+    def test_rejects_malformed_summaries(self):
+        from tools.check_trace_schema import validate_summary
+        assert validate_summary([]) != []
+        assert validate_summary({"query": "q"}) != []
+        base = {"query": "q", "queryStatus": ["Completed"],
+                "queryTimes": [10], "startTime": 1, "env": {}}
+        assert validate_summary(base) == []
+        assert validate_summary(
+            {**base, "queryStatus": ["Exploded"]}) != []
+        assert validate_summary(
+            {**base, "memory": {"device_hwm_bytes": -1,
+                                "source": "device"}}) != []
+        assert validate_summary(
+            {**base, "memory": {"device_hwm_bytes": 5,
+                                "source": "martian"}}) != []
+        assert validate_summary(
+            {**base, "spans": {"name": "", "dur_ms": 1}}) != []
+        assert validate_summary(
+            {**base, "metrics": {"histograms": {"h": {"count": 1}}}}
+        ) != []
+        ok = {**base,
+              "spans": {"name": "query", "dur_ms": 9.0,
+                        "attrs": {}, "children": []},
+              "metrics": {"counters": {"c": 1},
+                          "histograms": {"h": {"count": 1, "sum": 2.0,
+                                               "p99": 2.0}}},
+              "memory": {"device_hwm_bytes": 5, "source": "accounted"},
+              "retries": 0}
+        assert validate_summary(ok) == []
+
+
+# ------------------------------------------------- tracer atexit flush
+
+class TestTraceFlush:
+    def test_flush_salvages_open_roots(self, tmp_path, monkeypatch):
+        from nds_tpu.obs.trace import Tracer
+        trace_path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv("NDS_TPU_TRACE", trace_path)
+        tracer = Tracer(enabled=True)
+        span = tracer.begin("query", parent=None, query="doomed")
+        tracer.begin("device.run", parent=span)
+        # simulated crash: nothing ended, nothing exported yet
+        assert not os.path.exists(trace_path)
+        tracer.flush_exports(close_roots=True)
+        events = [json.loads(ln) for ln in open(trace_path)]
+        names = {e["name"] for e in events}
+        assert {"query", "device.run"} <= names
+        root_ev = next(e for e in events if e["name"] == "query")
+        assert root_ev["args"]["truncated"] is True
+        # idempotent: a second flush appends nothing
+        n = len(events)
+        tracer.flush_exports(close_roots=True)
+        assert len(open(trace_path).readlines()) == n
+
+    def test_deferred_exports_flush_on_close(self, tmp_path,
+                                             monkeypatch):
+        from nds_tpu.obs.trace import Tracer
+        trace_path = str(tmp_path / "d.jsonl")
+        monkeypatch.setenv("NDS_TPU_TRACE", trace_path)
+        tracer = Tracer(enabled=True)
+        tracer.defer_exports = True
+        with tracer.span("query", query="parked"):
+            pass
+        assert not os.path.exists(trace_path)  # parked, not written
+        tracer.flush_exports(close_roots=True)
+        assert os.path.exists(trace_path)
+        tracer.flush_exports()  # idempotent
+        assert len(open(trace_path).readlines()) == 1

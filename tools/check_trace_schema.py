@@ -1,17 +1,27 @@
-"""CI gate for the Chrome trace-event JSONL the engine emits via
-``NDS_TPU_TRACE`` (nds_tpu/obs/trace.py): every line must be one JSON
-object matching the documented event schema (README "Observability"),
-so downstream consumers — Perfetto after array-wrapping, or anything
-parsing the JSONL directly — never meet a malformed event.
+"""CI gate for the observability JSON the engine emits: the Chrome
+trace-event JSONL (``NDS_TPU_TRACE``, nds_tpu/obs/trace.py) and the
+per-query BenchReport summaries (utils/report.py) the run-analysis
+layer (obs/analyze.py, tools/ndsreport.py) consumes. Every documented
+shape is validated here so downstream consumers — Perfetto after
+array-wrapping, ndsreport, or anything parsing the files directly —
+never meet a malformed record.
 
-Schema (one event per line):
+Trace event schema (one event per line):
   name: non-empty str      ph:  "X" (complete event)
   cat:  str                ts:  number >= 0 (microseconds)
   dur:  number >= 0        pid: int        tid: int
   args: object (optional)
 
-Exit 0 when every line validates; prints each offending line otherwise.
-Run by tests/test_observability.py as a tier-1 gate.
+BenchReport summary schema (``--summary``, README "Observability"):
+  query/queryStatus/queryTimes/startTime/env required; optional blocks
+  — spans (name/dur_ms/attrs/children tree), metrics (counters/gauges/
+  histograms with count+sum and optional p50/p95/p99), memory
+  (device_hwm_bytes + source), retries / retry_backoff_s /
+  gave_up_reason / deadline_exceeded.
+
+Exit 0 when every record validates; prints each offense otherwise.
+Run by tests/test_observability.py and tools/static_checks.py as a
+tier-1 gate.
 """
 
 from __future__ import annotations
@@ -78,16 +88,116 @@ def validate_file(path: str) -> list[str]:
     return errors
 
 
+_STATUS_VOCAB = {"Completed", "CompletedWithTaskFailures", "Failed"}
+_HWM_SOURCES = {"device", "accounted"}
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _validate_span_tree(node: object, path: str) -> list[str]:
+    if not isinstance(node, dict):
+        return [f"{path}: span node is {type(node).__name__}"]
+    errs = []
+    if not node.get("name") or not isinstance(node.get("name"), str):
+        errs.append(f"{path}: missing/empty span name")
+    if not _num(node.get("dur_ms")) or node.get("dur_ms", 0) < 0:
+        errs.append(f"{path}: bad dur_ms {node.get('dur_ms')!r}")
+    if "attrs" in node and not isinstance(node["attrs"], dict):
+        errs.append(f"{path}: attrs is not an object")
+    kids = node.get("children", [])
+    if not isinstance(kids, list):
+        errs.append(f"{path}: children is not a list")
+        kids = []
+    for i, k in enumerate(kids):
+        errs.extend(_validate_span_tree(k, f"{path}.children[{i}]"))
+    return errs
+
+
+def validate_summary(obj: object) -> list[str]:
+    """Schema errors for one BenchReport summary dict ([] = valid)."""
+    if not isinstance(obj, dict):
+        return [f"summary is {type(obj).__name__}, not an object"]
+    errs = []
+    if not isinstance(obj.get("query"), str) or not obj.get("query"):
+        errs.append("missing/empty 'query'")
+    status = obj.get("queryStatus")
+    if (not isinstance(status, list) or not status
+            or any(s not in _STATUS_VOCAB for s in status)):
+        errs.append(f"bad queryStatus {status!r}")
+    times = obj.get("queryTimes")
+    if (not isinstance(times, list) or not times
+            or any(not _num(t) or t < 0 for t in times)):
+        errs.append(f"bad queryTimes {times!r}")
+    if not isinstance(obj.get("startTime"), int):
+        errs.append("missing/invalid startTime")
+    if not isinstance(obj.get("env"), dict):
+        errs.append("missing env object")
+    if "spans" in obj:
+        errs.extend(_validate_span_tree(obj["spans"], "spans"))
+    m = obj.get("metrics", {})
+    if not isinstance(m, dict):
+        errs.append("metrics is not an object")
+    else:
+        for block in ("counters", "gauges"):
+            vals = m.get(block, {})
+            if not isinstance(vals, dict) or any(
+                    not _num(v) for v in vals.values()):
+                errs.append(f"metrics.{block} has non-numeric values")
+        for name, h in (m.get("histograms") or {}).items():
+            if (not isinstance(h, dict) or not _num(h.get("count"))
+                    or not _num(h.get("sum"))):
+                errs.append(f"metrics.histograms[{name!r}] lacks "
+                            f"numeric count/sum")
+            elif any(k in h and not _num(h[k])
+                     for k in ("p50", "p95", "p99")):
+                errs.append(f"metrics.histograms[{name!r}] has "
+                            f"non-numeric percentile")
+    mem = obj.get("memory")
+    if mem is not None:
+        if (not isinstance(mem, dict)
+                or not isinstance(mem.get("device_hwm_bytes"), int)
+                or mem["device_hwm_bytes"] < 0
+                or mem.get("source") not in _HWM_SOURCES):
+            errs.append(f"bad memory block {mem!r}")
+    if "retries" in obj and (not isinstance(obj["retries"], int)
+                             or obj["retries"] < 0):
+        errs.append(f"bad retries {obj['retries']!r}")
+    if "retry_backoff_s" in obj and (
+            not _num(obj["retry_backoff_s"])
+            or obj["retry_backoff_s"] < 0):
+        errs.append(f"bad retry_backoff_s {obj['retry_backoff_s']!r}")
+    if "deadline_exceeded" in obj and not isinstance(
+            obj["deadline_exceeded"], bool):
+        errs.append("deadline_exceeded is not a bool")
+    return errs
+
+
+def validate_summary_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"{path}: not JSON ({exc})"]
+    return [f"{path}: {e}" for e in validate_summary(obj)]
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1:
-        print("usage: check_trace_schema.py TRACE_JSONL")
+    if len(argv) == 2 and argv[0] == "--summary":
+        errors = validate_summary_file(argv[1])
+        target = argv[1]
+    elif len(argv) == 1:
+        errors = validate_file(argv[0])
+        target = argv[0]
+    else:
+        print("usage: check_trace_schema.py [--summary] FILE")
         return 2
-    errors = validate_file(argv[0])
     for e in errors:
         print(e)
     print(f"{'FAIL' if errors else 'OK'}: {len(errors)} schema error(s) "
-          f"in {argv[0]}")
+          f"in {target}")
     return 1 if errors else 0
 
 

@@ -1,0 +1,141 @@
+"""CLI for the run-analysis layer (nds_tpu/obs/analyze.py).
+
+Two verbs over run directories (a run dir = the folder a power or
+throughput run wrote its per-query BenchReport JSONs into, plus any
+Chrome-trace ``*.jsonl``):
+
+  python tools/ndsreport.py analyze RUN_DIR [--out DIR] [--top N]
+      Print the per-query time-attribution table (categories +
+      residual sum to wall-clock by construction) and write
+      ``analysis.json`` + self-contained ``report.html`` to --out
+      (default: RUN_DIR).
+
+  python tools/ndsreport.py diff BASE_DIR CUR_DIR [--gate pct=10,abs_ms=50]
+      Query-by-query steady-state comparison with a noise-aware
+      regression gate. Exit 0 when the gate passes, 1 on regression /
+      removed query / newly-failed query — so CI and bench rounds can
+      gate on it directly.
+
+``self_check()`` is the tier-1 entry (tools/static_checks.py section
+6): analyze + diff over the committed fixture run-dirs under
+``tests/fixtures/`` — the attribution-sum invariant and both gate
+verdicts are asserted against known-good data on every run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nds_tpu.obs import analyze  # noqa: E402
+
+
+def cmd_analyze(args) -> int:
+    a = analyze.analyze_run(args.run_dir)
+    print(analyze.format_attribution(a, top=args.top))
+    for name, h in sorted(a["metrics"]["histograms"].items()):
+        qs = "".join(f" {k}={h[k]:g}" for k in ("p50", "p95", "p99")
+                     if h.get(k) is not None)
+        print(f"hist {name}: count={h['count']:g} "
+              f"sum={h['sum']:g}{qs}")
+    out_dir = args.out or args.run_dir
+    paths = analyze.write_outputs(a, out_dir)
+    print(f"wrote {paths['analysis']} and {paths['report']}")
+    return 1 if a["failed"] and args.strict else 0
+
+
+def cmd_diff(args) -> int:
+    gate = analyze.parse_gate(args.gate)
+    # the gate only compares BenchReport-derived rows; parsing two
+    # full Chrome traces would double its wall-clock for nothing —
+    # load the current run's trace only when writing the HTML report
+    base = analyze.analyze_run(args.base_dir, with_trace=False)
+    cur = analyze.analyze_run(args.cur_dir,
+                              with_trace=bool(args.out))
+    d = analyze.diff_runs(base, cur, **gate)
+    print(analyze.format_diff(d))
+    if args.out:
+        paths = analyze.write_outputs(cur, args.out, diff=d)
+        print(f"wrote {paths['analysis']} and {paths['report']}")
+    return 0 if d["passed"] else 1
+
+
+def self_check(repo_root: str | None = None) -> int:
+    """Tier-1 gate over the committed fixtures: the attribution
+    invariant holds, the regression pair fails the gate for the right
+    reasons, and the identity diff passes."""
+    repo = repo_root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    run_a = os.path.join(repo, "tests", "fixtures", "run_a")
+    run_b = os.path.join(repo, "tests", "fixtures", "run_b")
+    errors = []
+    try:
+        a = analyze.analyze_run(run_a)
+        b = analyze.analyze_run(run_b)
+    except Exception as exc:  # noqa: BLE001 - report, don't crash CI
+        print(f"FAIL: fixture analysis raised {type(exc).__name__}: "
+              f"{exc}")
+        return 1
+    for run in (a, b):
+        for row in run["queries"]:
+            total = (sum(row["categories"].values())
+                     + row["residual_ms"])
+            if abs(total - row["wall_ms"]) > 1e-6:
+                errors.append(
+                    f"{row['query']}: categories+residual "
+                    f"{total:.3f} != wall {row['wall_ms']:.3f}")
+    html = analyze.render_html(a)
+    if "</html>" not in html or "attribution" not in html:
+        errors.append("render_html produced no report body")
+    d = analyze.diff_runs(a, b, pct=10.0, abs_ms=50.0)
+    if d["passed"]:
+        errors.append("regression fixture pair PASSED the gate")
+    if not any(e["query"] == "query1" for e in d["regressions"]):
+        errors.append("query1 regression not detected")
+    if any(e["query"] == "query3" for e in
+           d["regressions"] + d["improvements"]):
+        errors.append("query3 noise misclassified as signal")
+    ident = analyze.diff_runs(a, a, pct=10.0, abs_ms=50.0)
+    if not ident["passed"]:
+        errors.append("identity diff failed the gate")
+    for e in errors:
+        print(f"FAIL: {e}")
+    print(f"{'FAIL' if errors else 'OK'}: ndsreport self-check, "
+          f"{len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="analyze/diff benchmark run directories")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pa = sub.add_parser("analyze", help="attribution table + report")
+    pa.add_argument("run_dir")
+    pa.add_argument("--out", help="artifact dir (default: run_dir)")
+    pa.add_argument("--top", type=int, default=None,
+                    help="only the N slowest queries in the table")
+    pa.add_argument("--strict", action="store_true",
+                    help="exit 1 when any query failed")
+    pd = sub.add_parser("diff", help="cross-run regression gate")
+    pd.add_argument("base_dir")
+    pd.add_argument("cur_dir")
+    pd.add_argument("--gate", default=None,
+                    help="thresholds, e.g. pct=10,abs_ms=50")
+    pd.add_argument("--out",
+                    help="also write analysis.json/report.html with "
+                         "the diff embedded")
+    sub.add_parser("self-check", help="fixture-based CI self-check")
+    args = p.parse_args(argv)
+    if args.cmd == "analyze":
+        return cmd_analyze(args)
+    if args.cmd == "diff":
+        return cmd_diff(args)
+    return self_check()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,13 @@ the one to run locally before pushing:
                         retry and complete, one deterministic must
                         fail fast; plus the resume-journal round-trip
                         (tools/chaos_check.py)
+  6. ndsreport          run-analysis self-check over the committed
+                        fixture run-dirs (tests/fixtures/run_a|b):
+                        attribution sums to wall-clock, the regression
+                        pair fails the gate, the identity diff passes,
+                        and every fixture BenchReport validates against
+                        the summary schema (tools/ndsreport.py,
+                        nds_tpu/obs/analyze.py)
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -37,6 +44,7 @@ import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
 import ndslint  # noqa: E402
+import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
 
 
@@ -68,6 +76,30 @@ def run_trace_schema_check() -> int:
         os.unlink(path)
 
 
+def run_ndsreport_check() -> int:
+    """Section 6: analyze + diff over the committed fixtures, plus the
+    BenchReport summary-schema gate over every fixture report."""
+    import glob
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    rc = ndsreport.self_check(str(repo))
+    errors = []
+    from nds_tpu.obs import analyze
+    for path in sorted(glob.glob(
+            str(repo / "tests" / "fixtures" / "run_*" / "*.json"))):
+        # a local `ndsreport analyze tests/fixtures/run_a` drops its
+        # analysis.json into the run dir — an artifact, not a fixture
+        if not analyze.is_report_basename(os.path.basename(path)):
+            continue
+        errors.extend(check_trace_schema.validate_summary_file(path))
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"FAIL: {len(errors)} summary schema error(s) in "
+              f"fixtures")
+    return 1 if (rc or errors) else 0
+
+
 def main() -> int:
     import pathlib
     repo = pathlib.Path(__file__).resolve().parent.parent
@@ -77,6 +109,7 @@ def main() -> int:
         ("ndslint", lambda: ndslint.run(repo)),
         ("ndsverify", lambda: ndsverify.main([])),
         ("chaos", chaos_check.main),
+        ("ndsreport", run_ndsreport_check),
     ]
     failed = []
     for name, fn in sections:
