@@ -34,6 +34,15 @@ fails CI instead of waiting for a human audit:
                             fixed-interval uncapped retry herd is the
                             outage amplifier — use
                             ``resilience.retry.RetryPolicy``.
+- NDS109 non-atomic-json    ``json.dump`` into a handle opened ``"w"``
+                            on the final path, in a function that never
+                            calls ``os.replace``/``os.rename``: a crash
+                            mid-write leaves a TORN report/journal/
+                            manifest a later reader crashes on or —
+                            worse — half-trusts. Write via
+                            ``io.integrity.write_json_atomic`` (tmp +
+                            rename), or waive with why a torn read is
+                            impossible for that artifact.
 
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
@@ -433,10 +442,89 @@ class NakedRetryRule(Rule):
         return out
 
 
+class NonAtomicJsonWriteRule(Rule):
+    """NDS109: ``json.dump(obj, f)`` where ``f`` was opened ``"w"``
+    directly on the destination path and the enclosing function never
+    calls ``os.replace``/``os.rename`` — the torn-artifact shape.
+    Functions that DO rename are presumed to be writing a tmp file
+    first (the journal/snapshot/integrity writers), so they don't
+    flag."""
+
+    id = "NDS109"
+    name = "non-atomic-json-write"
+    paths = ("nds_tpu/",)
+
+    @staticmethod
+    def _renames_atomically(fn: ast.AST) -> bool:
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("replace", "rename")
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "os"):
+                return True
+        return False
+
+    @staticmethod
+    def _write_handles(fn: ast.AST) -> set:
+        """Names bound by ``with open(path, "w"...) as f``."""
+        out = set()
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.With):
+                continue
+            for item in n.items:
+                c = item.context_expr
+                if not (isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Name)
+                        and c.func.id == "open"):
+                    continue
+                mode = None
+                if len(c.args) > 1 and isinstance(c.args[1],
+                                                  ast.Constant):
+                    mode = c.args[1].value
+                for kw in c.keywords:
+                    if (kw.arg == "mode"
+                            and isinstance(kw.value, ast.Constant)):
+                        mode = kw.value.value
+                if (isinstance(mode, str) and "w" in mode
+                        and isinstance(item.optional_vars, ast.Name)):
+                    out.add(item.optional_vars.id)
+        return out
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _walk_funcs(tree):
+            if self._renames_atomically(fn):
+                continue
+            handles = self._write_handles(fn)
+            if not handles:
+                continue
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "dump"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "json"):
+                    continue
+                fp = (n.args[1] if len(n.args) > 1
+                      else next((kw.value for kw in n.keywords
+                                 if kw.arg == "fp"), None))
+                if isinstance(fp, ast.Name) and fp.id in handles:
+                    out.append(LintViolation(
+                        self.id, path, n.lineno,
+                        "non-atomic JSON artifact write: json.dump "
+                        "into open(.., 'w') without tmp+os.replace — "
+                        "a crash leaves a torn file; use "
+                        "io.integrity.write_json_atomic (or waive "
+                        "with why a torn read is impossible)"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
-            MutableDefaultRule(), BareExceptRule(), NakedRetryRule()]
+            MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
+            NonAtomicJsonWriteRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -44,7 +44,10 @@ from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
-from nds_tpu.resilience.retry import RetryPolicy, is_oom
+from nds_tpu.resilience import watchdog
+from nds_tpu.resilience.retry import (
+    QueryDeadlineExceeded, RetryPolicy, check_deadline, is_oom,
+)
 from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
 
@@ -290,6 +293,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 sub = self._try_partial_agg(
                     planned, big[0], scans[big[0]][0], reduced)
             except Exception as exc:  # noqa: BLE001 - fall back
+                if isinstance(exc, QueryDeadlineExceeded):
+                    # a deadlined query must ABORT, not fall back to a
+                    # full upload that takes even longer
+                    raise
                 if (is_oom(exc)
                         and self.chunk_rows // 2 >= self.MIN_CHUNK_ROWS):
                     # the chunk-halving loop can still shrink phase A;
@@ -491,6 +498,13 @@ class ChunkedExecutor(dx.DeviceExecutor):
              for name in big.columns})
         parts = []
         for size, group in by_size.items():
+            # between-chunk control point: the per-query deadline is
+            # enforced INSIDE the attempt (a 200-chunk scan must stop
+            # at the next boundary, not finish a doomed pass), and the
+            # heartbeat shows per-chunk liveness to the hang watchdog
+            check_deadline()
+            watchdog.beat("engine", phase="chunk.partial_agg",
+                          table=table)
             s0, e0 = group[0]
             # every per-plan table (reduced variants + the chunked one)
             # stays executor-local; only immutable full tables share
@@ -504,6 +518,9 @@ class ChunkedExecutor(dx.DeviceExecutor):
             compiled, side = entry["compiled"], entry["side"]
             slack = entry["slack"]
             for s, e in group[1:]:
+                check_deadline()
+                watchdog.beat("engine", phase="chunk.partial_agg",
+                              table=table)
                 bufs = ex._collect_buffers(planned_a)
                 for name in big.columns:
                     bkey = f"{table}.{name}"
@@ -661,6 +678,11 @@ class ChunkedExecutor(dx.DeviceExecutor):
             jitted = jax.jit(fn)
             keep_np = np.empty(n, dtype=bool)
             for start in range(0, n, C):
+                # same between-chunk control point as the partial-agg
+                # loop: deadline stops a doomed scan at the next chunk,
+                # the beat keeps the watchdog fed during long scans
+                check_deadline()
+                watchdog.beat("engine", phase="chunk.scan", table=table)
                 obs_metrics.counter("chunk_scans_total").inc()
                 stop = min(start + C, n)
                 bufs = {}
@@ -697,6 +719,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     f"({type(skipped[0][1]).__name__})")
             return keep_np
         except Exception as exc:  # noqa: BLE001 - conservative fallback
+            if isinstance(exc, QueryDeadlineExceeded):
+                # deadlined queries abort; "keep all rows" would turn a
+                # timeout into an even slower full-table phase B
+                raise
             from nds_tpu.utils.report import TaskFailureCollector
             obs_metrics.counter("chunk_fallbacks_total").inc()
             TaskFailureCollector.notify(

@@ -131,10 +131,12 @@ class CpuExecutor:
     # ----------------------------------------------------------------- API
 
     def execute(self, planned: P.PlannedQuery):
-        from nds_tpu.resilience import faults
+        from nds_tpu.resilience import faults, watchdog
         # chaos site shared with the device executors: CPU-backend runs
         # exercise the retry/fallback machinery without a chip
         faults.fault_point("device.execute", executor="CpuExecutor")
+        watchdog.beat("engine", phase="device.execute",
+                      executor="CpuExecutor")
         # memory HWM (obs/memwatch): the oracle has no allocator to
         # sample — account the scanned tables' host bytes instead so
         # CPU runs still report a per-query working-set gauge

@@ -559,9 +559,13 @@ class DeviceExecutor:
         analog of spark.rapids.sql.concurrentGpuTasks,
         `nds/power_run_gpu.template:38`) and overlap device execution
         with host-side materialization of earlier results."""
-        from nds_tpu.resilience import faults
+        from nds_tpu.resilience import faults, watchdog
         faults.fault_point("device.execute",
                            executor=type(self).__name__)
+        # engine-side heartbeat: a query inside compile/execute still
+        # shows liveness to the hang watchdog at every dispatch
+        watchdog.beat("engine", phase="device.execute",
+                      executor=type(self).__name__)
         key = key if key is not None else id(planned)
         orig = planned
         tracer = get_tracer()

@@ -42,16 +42,25 @@ def _arrow_read_type(dtype) -> pa.DataType:
 def read_tbl(paths: list[str] | str, name: str, schema: Schema,
              trailing_delimiter: bool = True) -> HostTable:
     """Read one table from one or more '|'-delimited files."""
+    from nds_tpu.io import integrity
     from nds_tpu.resilience import faults
-    faults.fault_point("io.read", table=name)
     if isinstance(paths, str):
         paths = [paths]
+    faults.fault_point("io.read", table=name, paths=paths)
+    # digest verification (io.verify_digests / NDS_TPU_VERIFY_DIGESTS):
+    # a flipped bit in a raw chunk fails HERE with CorruptArtifact —
+    # deterministic, never retried — instead of loading wrong rows
+    integrity.verify_paths(paths, name)
     names = schema.names + (["_trailing"] if trailing_delimiter else [])
     types = {f.name: _arrow_read_type(f.dtype) for f in schema}
     if trailing_delimiter:
         types["_trailing"] = pa.string()
+    from nds_tpu.resilience import watchdog
     tables = []
     for p in paths:
+        # per-chunk heartbeat: multi-chunk fact reads on a loaded box
+        # must not look like a hang to the watchdog
+        watchdog.beat("engine", phase="io.read", table=name)
         if os.path.getsize(p) == 0:
             continue  # zero-row chunks are legitimate (fixed tables)
         t = pacsv.read_csv(
@@ -266,9 +275,18 @@ def read_paths_auto(paths: list[str], name: str, schema: Schema,
 
 def read_table_fmt(paths: list[str] | str, name: str, schema: Schema,
                    fmt: str) -> HostTable:
-    """Read a warehouse table written by ``write_table`` in any format."""
+    """Read a warehouse table written by ``write_table`` in any format.
+
+    When digest verification is on (io/integrity.py), every file is
+    re-hashed against its table's ``_manifest.json`` before parsing:
+    corruption surfaces as a fail-fast CorruptArtifact naming the file
+    and both digests, never as silently wrong query output."""
+    from nds_tpu.io import integrity
     from nds_tpu.resilience import faults
-    faults.fault_point("io.read", table=name, fmt=fmt)
+    if isinstance(paths, str):
+        paths = [paths]
+    faults.fault_point("io.read", table=name, fmt=fmt, paths=paths)
+    integrity.verify_paths(paths, name)
     if fmt == "parquet":
         return read_parquet(paths, name, schema)
     if fmt == "avro":

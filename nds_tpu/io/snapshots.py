@@ -8,6 +8,12 @@ each committed version to {table: [parquet files]}. Mutations write new
 files and append a manifest entry; nothing is rewritten in place, so
 rolling back is truncating the manifest (old files remain valid).
 
+The manifest payload is CRC-stamped (io/integrity.py): a torn or
+corrupted ``_snapshots.json`` is detected on open and degrades to the
+on-disk baseline (version 0) with a warning — committed version files
+are never rewritten, so the baseline is always still valid — instead
+of crashing the run or silently serving a spliced version map.
+
 Layout:
   warehouse/
     _snapshots.json                  # [{version, timestamp, tables}]
@@ -20,6 +26,8 @@ from __future__ import annotations
 import json
 import os
 import time
+
+from nds_tpu.io import integrity
 
 MANIFEST = "_snapshots.json"
 
@@ -36,17 +44,32 @@ class SnapshotLog:
     def __init__(self, warehouse_dir: str):
         self.dir = warehouse_dir
         self.path = os.path.join(warehouse_dir, MANIFEST)
-        if os.path.exists(self.path):
-            with open(self.path) as f:
-                self.entries = json.load(f)
-        else:
-            self.entries = []
+        self.entries = self._read(self.path)
+
+    @staticmethod
+    def _read(path: str) -> list:
+        if not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            doc = None
+        if isinstance(doc, list):
+            return doc  # legacy unstamped manifest: still trusted
+        if isinstance(doc, dict) and integrity.check_crc(doc):
+            return doc.get("entries", [])
+        # torn/corrupt: committed version files are immutable, so the
+        # on-disk baseline (version 0) is always a valid fallback
+        print(f"WARNING: snapshot manifest {path} is torn/corrupt — "
+              f"falling back to the version-0 baseline")
+        return []
 
     def _write(self) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.entries, f, indent=1)
-        os.replace(tmp, self.path)
+        integrity.write_json_atomic(
+            self.path,
+            integrity.stamp_crc({"version": 1, "entries": self.entries}),
+            indent=1)
 
     def baseline(self, tables: list[str]) -> dict:
         """Version-0 file map discovered from the transcode layout."""

@@ -31,6 +31,10 @@ def save_table(dirpath: str, table: HostTable) -> str:
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **payload)
     os.replace(tmp, path)
+    # digest into the cache dir's manifest so reuse across runs detects
+    # on-disk rot (io/integrity.py; verification gated on load)
+    from nds_tpu.io import integrity
+    integrity.update_manifest(dirpath, [f"{table.name}.npz"])
     return path
 
 
@@ -38,6 +42,8 @@ def load_table(dirpath: str, name: str, schema: Schema) -> HostTable | None:
     path = os.path.join(dirpath, f"{name}.npz")
     if not os.path.exists(path):
         return None
+    from nds_tpu.io import integrity
+    integrity.verify_paths([path], name)
     data = np.load(path, allow_pickle=False)
     cols: dict[str, HostColumn] = {}
     for f in schema:

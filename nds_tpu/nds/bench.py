@@ -248,8 +248,12 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
                 ttt, codes = run_streams_inprocess(
                     wh_dir, tstreams, tdir, backend=backend)
             else:
+                # YAML ``watchdog: {stall_s: ...}`` arms subprocess
+                # stream supervision (kill + restart-once; README
+                # Resilience)
                 ttt, codes = run_streams(
-                    wh_dir, tstreams, tdir, backend=backend)
+                    wh_dir, tstreams, tdir, backend=backend,
+                    stall_s=(cfg.get("watchdog") or {}).get("stall_s"))
         finally:
             for k, v in saved.items():
                 if v is None:

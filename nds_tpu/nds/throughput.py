@@ -6,6 +6,14 @@ running the NDS power driver (process isolation keeps per-stream XLA
 compile caches and HBM pools independent — the analog of per-stream
 Spark apps); throughput elapse is max(end) - min(start) rounded up to
 0.1 s (`nds/nds_bench.py:138-157,207-208`).
+
+Subprocess streams run SUPERVISED (resilience/supervise.py): each
+child publishes heartbeats through its per-stream metrics-snapshot
+file, a hung stream is killed (child watchdog self-exit, parent
+SIGTERM→SIGKILL backstop) once ``--stall_s`` is set, a dead stream
+restarts at most once from its last completed query, and exit codes /
+signals / stalls / restarts land in ``throughput_summary.json``
+instead of a bare failure count.
 """
 
 from __future__ import annotations
@@ -13,41 +21,77 @@ from __future__ import annotations
 import argparse
 import math
 import os
-import subprocess
 import sys
 import time
+
+
+def _stream_specs(data_dir: str, stream_paths: list[str], out_dir: str,
+                  backend: str, input_format: str,
+                  allow_failure: bool, module: str, parse_stream):
+    """Supervised-stream specs for a power-driver fleet (shared with
+    NDS-H, which passes its own module + stream parser)."""
+    from nds_tpu.obs.snapshot import SNAP_ENV, parse_spec
+    from nds_tpu.resilience.supervise import StreamSpec
+    from nds_tpu.utils.power_core import subprocess_env
+    specs = []
+    for sp in stream_paths:
+        name = os.path.splitext(os.path.basename(sp))[0]
+        env = subprocess_env(backend)
+        hb = os.path.join(out_dir, f"{name}_hb.json")
+        if env.get(SNAP_ENV):
+            # one snapshot file PER STREAM: N subprocesses inheriting
+            # the same path would race on it (and on its .tmp),
+            # exactly what the atomic-write contract forbids. The
+            # re-pointed file doubles as the supervisor's heartbeat
+            # source
+            path, interval = parse_spec(env[SNAP_ENV])
+            root, ext = os.path.splitext(path)
+            hb = f"{root}_{name}{ext or '.json'}"
+            env[SNAP_ENV] = f"{hb}:{interval}"
+
+        def make_cmd(incarnation, remaining, _sp=sp, _name=name):
+            suffix = "" if incarnation == 0 else f"_r{incarnation}"
+            tlog = os.path.join(out_dir, f"{_name}{suffix}_time.csv")
+            cmd = [sys.executable, "-m", module,
+                   data_dir, _sp, tlog, "--backend", backend,
+                   "--input_format", input_format]
+            if allow_failure:
+                cmd.append("--allow_failure")
+            if remaining:
+                cmd += ["--query_subset", *remaining]
+            return cmd
+
+        specs.append(StreamSpec(
+            name=name, make_cmd=make_cmd, hb_path=hb,
+            queries=list(parse_stream(sp)), env=env))
+    return specs
 
 
 def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                 backend: str = "tpu",
                 input_format: str = "parquet",
-                allow_failure: bool = False) -> tuple[float, list[int]]:
-    """Launch one power-run subprocess per stream; returns
-    (throughput_elapse_seconds, per-stream exit codes)."""
+                allow_failure: bool = False,
+                stall_s: float | None = None) -> tuple[float, list[int]]:
+    """Launch one supervised power-run subprocess per stream; returns
+    (throughput_elapse_seconds, per-stream final exit codes). With
+    ``stall_s`` set, hung streams are killed and restarted once from
+    their last completed query; ``throughput_summary.json`` in
+    ``out_dir`` records the supervision verdicts either way."""
+    from nds_tpu.nds.streams import parse_query_stream
+    from nds_tpu.resilience.supervise import (
+        StreamSupervisor, describe_summary,
+    )
     os.makedirs(out_dir, exist_ok=True)
-    procs = []
-    start = time.time()
-    for sp in stream_paths:
-        name = os.path.splitext(os.path.basename(sp))[0]
-        tlog = os.path.join(out_dir, f"{name}_time.csv")
-        cmd = [sys.executable, "-m", "nds_tpu.nds.power",
-               data_dir, sp, tlog, "--backend", backend,
-               "--input_format", input_format]
-        if allow_failure:
-            cmd.append("--allow_failure")
-        from nds_tpu.obs.snapshot import SNAP_ENV, parse_spec
-        from nds_tpu.utils.power_core import subprocess_env
-        env = subprocess_env(backend)
-        if env.get(SNAP_ENV):
-            # one snapshot file PER STREAM: N subprocesses inheriting
-            # the same path would race on it (and on its .tmp),
-            # exactly what the atomic-write contract forbids
-            path, interval = parse_spec(env[SNAP_ENV])
-            root, ext = os.path.splitext(path)
-            env[SNAP_ENV] = f"{root}_{name}{ext or '.json'}:{interval}"
-        procs.append(subprocess.Popen(cmd, env=env))
-    codes = [p.wait() for p in procs]
-    elapse = time.time() - start
+    specs = _stream_specs(data_dir, stream_paths, out_dir, backend,
+                          input_format, allow_failure,
+                          "nds_tpu.nds.power", parse_query_stream)
+    # restart-once needs the heartbeat plumbing stall_s arms: without
+    # it a completed-with-failures stream (exit 1, no snapshot) would
+    # be indistinguishable from a crash and get re-run
+    sup = StreamSupervisor(specs, out_dir, stall_s=stall_s,
+                           max_restarts=1 if stall_s else 0)
+    elapse, codes, summary = sup.run()
+    print(describe_summary(summary))
     # round up to 0.1 s, the reference's Ttt granularity
     elapse = math.ceil(elapse * 10) / 10.0
     return elapse, codes
@@ -191,8 +235,12 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         s["first_t0"] = min(s.get("first_t0", t0), t0)
         s["last_done"] = done
 
+    from nds_tpu.resilience import watchdog
     for s, qname, sql in interleaved:
         progress["current_query"] = f"{s['name']}/{qname}"
+        # heartbeat per dispatch: the in-process fleet shows liveness
+        # to any armed watchdog exactly like a subprocess stream does
+        watchdog.beat(s["name"], query=qname, phase="dispatch")
         t0 = time.time()
         handle, err = None, None
         try:
@@ -242,6 +290,11 @@ def main(argv=None) -> None:
     p.add_argument("--in_process", action="store_true",
                    help="time-share one device inside a single process "
                         "(required when all streams target one TPU chip)")
+    p.add_argument("--stall_s", type=float, default=None,
+                   help="supervise subprocess streams: kill a stream "
+                        "whose heartbeats stall past this budget and "
+                        "restart it once from its last completed query "
+                        "(README Resilience)")
     args = p.parse_args(argv)
     if args.in_process:
         elapse, codes = run_streams_inprocess(
@@ -250,7 +303,9 @@ def main(argv=None) -> None:
     else:
         elapse, codes = run_streams(args.data_dir, args.streams,
                                     args.out_dir, args.backend,
-                                    args.input_format, args.allow_failure)
+                                    args.input_format,
+                                    args.allow_failure,
+                                    stall_s=args.stall_s)
     print(f"Throughput Time: {elapse} s over {len(args.streams)} streams")
     sys.exit(1 if any(codes) and not args.allow_failure else 0)
 

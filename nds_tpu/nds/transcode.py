@@ -38,10 +38,11 @@ TABLE_PARTITIONING = {
 
 
 def _raw_paths(input_dir: str, name: str) -> list[str]:
+    from nds_tpu.io.integrity import MANIFEST_NAME
     tdir = os.path.join(input_dir, name)
     if os.path.isdir(tdir):
         return sorted(os.path.join(tdir, f) for f in os.listdir(tdir)
-                      if not f.startswith("."))
+                      if not f.startswith(".") and f != MANIFEST_NAME)
     return [os.path.join(input_dir, f"{name}.dat")]
 
 
@@ -81,6 +82,10 @@ def transcode_table(name, schema, input_dir: str, output_dir: str,
         out = os.path.join(output_dir, name, f"part-0{ext}")
         csv_io.write_table(table, out, output_format,
                            compression=compression)
+    # per-table digest manifest: loads can verify every chunk they read
+    # back (io/integrity.py; README "Resilience")
+    from nds_tpu.io import integrity
+    integrity.write_manifest(os.path.join(output_dir, name))
     return time.perf_counter() - t0
 
 

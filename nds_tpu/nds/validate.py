@@ -74,8 +74,10 @@ def update_summary(summary_folder: str, unmatched: list[str]) -> None:
             continue
         status = ("NotMatch" if qname in unmatched else "Match")
         summary["queryValidationStatus"] = [status]
-        with open(path, "w") as f:
-            json.dump(summary, f, indent=2)
+        # atomic (NDS109): this REWRITES an existing summary in place —
+        # a crash mid-dump must not destroy the original report
+        from nds_tpu.io.integrity import write_json_atomic
+        write_json_atomic(path, summary)
 
 
 def main(argv=None) -> None:

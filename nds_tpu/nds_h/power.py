@@ -101,6 +101,9 @@ def main(argv=None) -> None:
     p.add_argument("--allow_failure", action="store_true",
                    help="exit 0 even when queries failed "
                         "(`nds/nds_power.py:391-393`)")
+    p.add_argument("--query_subset", nargs="+",
+                   help="run only these query names (supervised-stream "
+                        "restarts resume with the remaining subset)")
     power_core.add_config_args(p)
     args = p.parse_args(argv)
     config = power_core.config_from_args(args)
@@ -109,7 +112,7 @@ def main(argv=None) -> None:
         config=config, input_format=args.input_format,
         json_summary_folder=args.json_summary_folder,
         output_prefix=args.output_prefix, warmup=args.warmup,
-        profile_dir=args.profile_dir,
+        query_subset=args.query_subset, profile_dir=args.profile_dir,
         extra_time_log=args.extra_time_log)
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 

@@ -6,6 +6,13 @@ running the power driver (process isolation keeps per-stream XLA compile
 caches and HBM pools independent — the analog of per-stream Spark apps),
 and the throughput elapse is max(end) - min(start) rounded up to 0.1 s
 (`nds/nds_bench.py:138-157,207-208`).
+
+Streams run SUPERVISED exactly like the NDS fleet
+(resilience/supervise.py, spec plumbing shared via
+nds_tpu.nds.throughput._stream_specs): heartbeat liveness through the
+per-stream snapshot file, kill + restart-once on stall with
+``--stall_s``, and a ``throughput_summary.json`` recording exit codes,
+signals, stalls and restarts.
 """
 
 from __future__ import annotations
@@ -13,29 +20,30 @@ from __future__ import annotations
 import argparse
 import math
 import os
-import subprocess
 import sys
-import time
 
 
 def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                 backend: str = "tpu",
-                input_format: str = "parquet") -> tuple[float, list[int]]:
-    """Launch one power-run subprocess per stream; returns
-    (throughput_elapse_seconds, per-stream exit codes)."""
+                input_format: str = "parquet",
+                stall_s: float | None = None) -> tuple[float, list[int]]:
+    """Launch one supervised power-run subprocess per stream; returns
+    (throughput_elapse_seconds, per-stream final exit codes)."""
+    from nds_tpu.nds.throughput import _stream_specs
+    from nds_tpu.nds_h.streams import parse_query_stream
+    from nds_tpu.resilience.supervise import (
+        StreamSupervisor, describe_summary,
+    )
     os.makedirs(out_dir, exist_ok=True)
-    procs = []
-    start = time.time()
-    for sp in stream_paths:
-        name = os.path.splitext(os.path.basename(sp))[0]
-        tlog = os.path.join(out_dir, f"{name}_time.csv")
-        cmd = [sys.executable, "-m", "nds_tpu.nds_h.power",
-               data_dir, sp, tlog, "--backend", backend,
-               "--input_format", input_format]
-        from nds_tpu.utils.power_core import subprocess_env
-        procs.append(subprocess.Popen(cmd, env=subprocess_env(backend)))
-    codes = [p.wait() for p in procs]
-    elapse = time.time() - start
+    specs = _stream_specs(data_dir, stream_paths, out_dir, backend,
+                          input_format, False,
+                          "nds_tpu.nds_h.power", parse_query_stream)
+    # restart-once only with the heartbeat plumbing stall_s arms (see
+    # nds_tpu.nds.throughput.run_streams)
+    sup = StreamSupervisor(specs, out_dir, stall_s=stall_s,
+                           max_restarts=1 if stall_s else 0)
+    elapse, codes, summary = sup.run()
+    print(describe_summary(summary))
     # round up to 0.1 s, the reference's Ttt granularity
     elapse = math.ceil(elapse * 10) / 10.0
     return elapse, codes
@@ -49,9 +57,14 @@ def main(argv=None) -> None:
     p.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--input_format", choices=["parquet", "raw"],
                    default="parquet")
+    p.add_argument("--stall_s", type=float, default=None,
+                   help="supervise streams: kill on heartbeat stall "
+                        "past this budget, restart once (README "
+                        "Resilience)")
     args = p.parse_args(argv)
     elapse, codes = run_streams(args.data_dir, args.streams, args.out_dir,
-                                args.backend, args.input_format)
+                                args.backend, args.input_format,
+                                stall_s=args.stall_s)
     print(f"Throughput Time: {elapse} s over {len(args.streams)} streams")
     sys.exit(1 if any(codes) else 0)
 

@@ -28,8 +28,9 @@ def transcode_table(name, schema, input_dir: str, output_dir: str,
     t0 = time.perf_counter()
     tdir = os.path.join(input_dir, name)
     if os.path.isdir(tdir):
+        from nds_tpu.io.integrity import MANIFEST_NAME
         paths = sorted(os.path.join(tdir, f) for f in os.listdir(tdir)
-                       if not f.startswith("."))
+                       if not f.startswith(".") and f != MANIFEST_NAME)
     else:
         single = os.path.join(input_dir, f"{name}.tbl")
         paths = [single]
@@ -37,6 +38,9 @@ def transcode_table(name, schema, input_dir: str, output_dir: str,
     ext = csv_io.FORMAT_EXT[output_format]
     out = os.path.join(output_dir, name, f"part-0{ext}")
     csv_io.write_table(table, out, output_format, compression=compression)
+    # per-table digest manifest for verified loads (io/integrity.py)
+    from nds_tpu.io import integrity
+    integrity.write_manifest(os.path.join(output_dir, name))
     return time.perf_counter() - t0
 
 

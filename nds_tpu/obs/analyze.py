@@ -608,8 +608,14 @@ def write_outputs(analysis: dict, out_dir: str,
         doc["diff"] = diff
     paths = {"analysis": os.path.join(out_dir, "analysis.json"),
              "report": os.path.join(out_dir, "report.html")}
-    with open(paths["analysis"], "w") as f:
-        json.dump(doc, f, indent=2)
-    with open(paths["report"], "w") as f:
+    # atomic (NDS109): live dashboards poll analysis.json while runs
+    # re-analyze; a torn read must be impossible
+    from nds_tpu.io.integrity import write_json_atomic
+    write_json_atomic(paths["analysis"], doc)
+    # pid-suffixed tmp, same as write_json_atomic: two analyzers
+    # re-analyzing one run dir must each rename a COMPLETE file
+    tmp = f"{paths['report']}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         f.write(render_html(analysis, diff))
+    os.replace(tmp, paths["report"])
     return paths

@@ -8,7 +8,8 @@ path[:interval]`` starts a daemon thread in the power loop that every
 
 - ``path`` — one JSON object (atomic tmp+rename, so a watcher never
   reads a torn file): ``{"ts", "progress", "counters", "gauges",
-  "histograms"}``;
+  "histograms"}`` plus ``"heartbeats"`` (per-unit ages from
+  resilience/watchdog.py — what stream supervisors poll for liveness);
 - the sibling OpenMetrics text file (``path`` with its extension
   replaced by ``.om``) — counter/gauge/summary families with
   ``nds_tpu_`` prefixes and a terminating ``# EOF``, scrapeable by
@@ -165,6 +166,13 @@ class MetricsSnapshotter:
         snap = self.registry.snapshot()
         doc = {"ts": time.time(), "progress": dict(self.progress),
                **snap}
+        # heartbeat ages (resilience/watchdog.py): the file mtime alone
+        # is NOT liveness — this daemon keeps writing while the query
+        # loop hangs; the embedded ages are what a supervisor watches
+        from nds_tpu.resilience import watchdog
+        hb = watchdog.snapshot_heartbeats()
+        if hb:
+            doc["heartbeats"] = hb
         try:
             d = os.path.dirname(self.path)
             if d:

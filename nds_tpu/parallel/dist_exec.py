@@ -248,6 +248,9 @@ class DistributedExecutor(dx.DeviceExecutor):
         silently dropped their bill)."""
         faults.fault_point("device.execute",
                            executor=type(self).__name__)
+        from nds_tpu.resilience import watchdog
+        watchdog.beat("engine", phase="device.execute",
+                      executor=type(self).__name__)
         key = key if key is not None else id(planned)
         orig = planned
         tracer = get_tracer()

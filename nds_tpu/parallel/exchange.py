@@ -59,8 +59,11 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     # chaos site (trace time, like the counter below): an injected
     # fault here surfaces during compile, where the executor's retry
     # loop classifies and handles it like a real capacity failure
-    from nds_tpu.resilience import faults
+    from nds_tpu.resilience import faults, watchdog
     faults.fault_point("exchange", n_dev=n_dev)
+    # trace-time heartbeat: big multi-exchange programs show liveness
+    # to the hang watchdog per exchange traced, not just per query
+    watchdog.beat("engine", phase="exchange")
     # trace-time count: how many exchange ops the compiled programs
     # contain (runtime executions multiply by program runs; in-program
     # counting would cost a collective per query for a vanity number)

@@ -6,13 +6,22 @@ transient device OOMs, exchange overflows and mid-run crashes are
 routine events to recover from, not reasons to restart a multi-hour
 benchmark. This package is the shared vocabulary for that recovery:
 
-- ``faults``   seeded, deterministic fault injection at named sites
-               (``NDS_TPU_FAULTS`` schedule; zero-cost no-op when unset)
-- ``retry``    transient-vs-deterministic failure classification plus
-               ``RetryPolicy`` (exponential backoff, jitter, attempt
-               caps, per-query wall-clock deadlines)
-- ``journal``  phase journal for resumable whole-benchmark runs
-               (``bench_state.json`` + ``--resume``)
+- ``faults``    seeded, deterministic fault injection at named sites
+                (``NDS_TPU_FAULTS`` schedule; zero-cost no-op when
+                unset; ``hang``/``corrupt`` kinds make the watchdog
+                and integrity paths testable)
+- ``retry``     transient-vs-deterministic failure classification plus
+                ``RetryPolicy`` (exponential backoff, jitter, attempt
+                caps, per-query wall-clock deadlines enforced between
+                attempts AND at chunk boundaries inside them)
+- ``journal``   phase journal for resumable whole-benchmark runs
+                (``bench_state.json`` + ``--resume``; CRC-stamped, a
+                torn journal degrades to a fresh run)
+- ``watchdog``  process-local heartbeat registry + hang watchdog
+                (stall reports with all-thread stacks,
+                ``engine.watchdog.*`` / ``NDS_TPU_WATCHDOG``)
+- ``supervise`` subprocess stream fleets: heartbeat liveness, kill on
+                stall, restart-once from the last completed query
 
 See README "Resilience" for the schedule syntax and config keys.
 """

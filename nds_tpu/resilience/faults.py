@@ -13,8 +13,15 @@ Registered sites (the call sites live inline in the layer they test):
 - ``device.execute``  every executor's execute/execute_async entry
                       (CPU oracle included, so chaos runs need no chip)
 - ``exchange``        the distributed all_to_all shuffle (trace time)
-- ``io.read``         warehouse table reads (csv/parquet/raw)
-- ``stream.query``    per-query dispatch in the throughput stream loop
+- ``io.read``         warehouse table reads (csv/parquet/raw); the
+                      call passes ``paths`` so ``corrupt`` can bite
+- ``stream.query``    per-query dispatch in the stream loops (the
+                      power loop fires it per ATTEMPT inside the retry
+                      policy; the in-process throughput loop fires it
+                      at dispatch). Supervised subprocess streams add
+                      ``stream=<name>`` (``<name>#rN`` on restart) to
+                      the context, so a schedule can target one stream
+                      — or one incarnation — of a fleet
 
 Schedule syntax (comma-separated entries)::
 
@@ -24,9 +31,18 @@ Schedule syntax (comma-separated entries)::
 
 - ``kind``   ``oom`` (raises InjectedOOM, classified transient),
              ``fault`` (generic transient), ``deterministic`` (never
-             retried), ``delay`` (sleeps ``param`` seconds)
-- ``times``  how many matching calls fire (default 1 for raising
-             kinds — so one retry succeeds — unlimited for ``delay``)
+             retried), ``delay`` (sleeps ``param`` seconds),
+             ``hang`` (interruptible dead-stop of ``param`` seconds at
+             the site — nothing beats, nothing returns — so watchdog /
+             supervisor hang detection is deterministically testable;
+             ``interrupt_hangs()`` releases every pending hang),
+             ``corrupt`` (flips one byte mid-file of the first path in
+             the call's ``paths`` context — registered at ``io.read`` —
+             so digest verification (io/integrity.py) is testable
+             end-to-end; the file on disk IS mutated)
+- ``times``  how many matching calls fire (default 1 for raising and
+             mutating kinds — so one retry succeeds / one file breaks —
+             unlimited for ``delay``)
 - ``prob``   per-match firing probability in [0,1] (default 1); drawn
              from a counter-keyed RNG seeded by ``NDS_TPU_FAULT_SEED``,
              so a chaos run replays EXACTLY from its seed
@@ -87,7 +103,7 @@ _ENTRY_RE = re.compile(
     r"(?:~(?P<prob>[0-9.]+))?"
     r"@(?P<scope>.+)$")
 
-_KINDS = ("oom", "fault", "deterministic", "delay")
+_KINDS = ("oom", "fault", "deterministic", "delay", "hang", "corrupt")
 
 
 @dataclass
@@ -177,6 +193,19 @@ class FaultPlan:
         if spec.kind == "delay":
             time.sleep(spec.param or 0.0)
             return
+        if spec.kind == "hang":
+            # dead-stop: no heartbeat, no return — exactly what a stuck
+            # compile or wedged collective looks like from outside. The
+            # sleep is sliced so interrupt_hangs() (and tests) can
+            # release it without killing the process
+            end = time.monotonic() + (spec.param or 0.0)
+            while (time.monotonic() < end
+                   and not _hang_interrupt.wait(0.05)):
+                pass
+            return
+        if spec.kind == "corrupt":
+            _flip_byte(ctx)
+            return
         if spec.kind == "oom":
             raise InjectedOOM(
                 site, f"injected RESOURCE_EXHAUSTED: out of memory "
@@ -188,6 +217,31 @@ class FaultPlan:
             site, f"injected transient fault ({where})")
 
 
+def _flip_byte(ctx: dict) -> None:
+    """``corrupt`` kind: XOR one byte in the middle of the first
+    existing non-empty file in the call's ``paths`` context (the
+    ``io.read`` sites pass the file list). The mutation is real and
+    persistent — the point is that the NEXT digest verification must
+    catch it."""
+    for p in ctx.get("paths") or ():
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            continue
+        if size == 0:
+            continue
+        pos = size // 2
+        with open(p, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return
+    raise ValueError(
+        "corrupt fault fired at a site with no 'paths' context "
+        "(register it at io.read, or pass paths=[...])")
+
+
 # programmatic plan (tests / chaos_check) beats the env-derived one;
 # the env plan caches on the (schedule, seed) STRINGS so fault_point
 # stays two dict lookups + a compare when nothing changed (and a no-op
@@ -197,6 +251,14 @@ _installed: FaultPlan | None = None
 _env_cache: tuple[tuple | None, FaultPlan | None] = (None, None)
 _suppressed = 0
 _ctx = threading.local()
+_hang_interrupt = threading.Event()
+
+
+def interrupt_hangs() -> None:
+    """Release every in-flight (and future) ``hang`` fault — the
+    in-process escape hatch a test or watchdog action can pull without
+    killing the interpreter. ``clear()`` re-arms hangs."""
+    _hang_interrupt.set()
 
 
 def install(schedule: str, seed: int = 0) -> FaultPlan:
@@ -208,10 +270,12 @@ def install(schedule: str, seed: int = 0) -> FaultPlan:
 
 
 def clear() -> None:
-    """Drop the programmatic plan AND the env cache (tests)."""
+    """Drop the programmatic plan AND the env cache (tests); re-arms
+    the hang kind after an ``interrupt_hangs()``."""
     global _installed, _env_cache
     _installed = None
     _env_cache = (None, None)
+    _hang_interrupt.clear()
 
 
 def _current_plan() -> FaultPlan | None:

@@ -13,7 +13,11 @@ The journal is guarded by a digest of the bench config: resuming
 under a DIFFERENT config would splice timings from two different
 workloads into one metric, so a mismatch refuses loudly. Writes are
 atomic (tmp + rename) — a crash mid-write leaves the previous valid
-journal, never a torn one.
+journal, never a torn one — and the payload is CRC-stamped
+(io/integrity.py): a journal torn by forces outside the writer (full
+disk, copied mid-write, hand-edited) is DETECTED on ``--resume`` and
+degrades to a clean fresh run with a warning, never a crash and never
+a silent splice of half-recorded phases.
 """
 
 from __future__ import annotations
@@ -22,6 +26,8 @@ import hashlib
 import json
 import os
 import time
+
+from nds_tpu.io import integrity
 
 
 class JournalMismatch(RuntimeError):
@@ -51,11 +57,24 @@ class PhaseJournal:
     def load(self) -> bool:
         """Read the journal if present; returns True when prior state
         exists. Raises JournalMismatch when it was written under a
-        different config digest."""
+        different config digest. A TORN journal (truncated JSON, CRC
+        mismatch) is not prior state: warn and return False so the run
+        degrades to a clean fresh start instead of crashing — re-running
+        phases is always correct, replaying spliced ones never is."""
         if not os.path.exists(self.path):
             return False
-        with open(self.path) as f:
-            state = json.load(f)
+        try:
+            with open(self.path) as f:
+                state = json.load(f)
+            torn = not integrity.check_crc(state)
+        except ValueError:
+            torn = True
+            state = None
+        if torn or not isinstance(state, dict):
+            print(f"WARNING: journal {self.path} is torn/corrupt — "
+                  f"ignoring it and starting fresh")
+            return False
+        state.pop("crc", None)
         recorded = state.get("config_digest")
         if (self.digest is not None and recorded is not None
                 and recorded != self.digest):
@@ -82,11 +101,10 @@ class PhaseJournal:
         self.write()
 
     def write(self) -> None:
-        tmp = self.path + ".tmp"
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(self.state, f, indent=2)
-        os.replace(tmp, self.path)
+        # CRC-stamped + atomic: a reader can always tell a complete
+        # journal from a torn one (integrity.py contract)
+        integrity.write_json_atomic(self.path,
+                                    integrity.stamp_crc(self.state))
 
     def reset(self) -> None:
         """Fresh-run entry: drop any prior state on disk (a non-resume

@@ -30,7 +30,9 @@ Config keys (README "Resilience"): ``engine.retry.max_attempts``,
 from __future__ import annotations
 
 import random
+import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -38,6 +40,50 @@ from nds_tpu.resilience import faults as faults_mod
 
 TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
+
+
+class QueryDeadlineExceeded(RuntimeError):
+    """Raised by ``check_deadline()`` when the active deadline scope has
+    expired MID-attempt — long-running loop bodies (the chunked
+    executor's per-chunk loops) call it between iterations so a
+    deadlined query stops at the next chunk boundary instead of
+    finishing a doomed attempt. Deterministic: the wall clock cannot be
+    retried back."""
+
+
+# active per-call deadline, published by RetryPolicy.call so code deep
+# inside an attempt can honor it; thread-local because concurrent
+# in-process streams carry independent deadlines
+_deadline = threading.local()
+
+
+@contextmanager
+def deadline_scope(deadline_s: float | None,
+                   clock: Callable[[], float] = time.monotonic,
+                   start: float | None = None):
+    """Publish an absolute deadline for the block (no-op when
+    ``deadline_s`` is None); nests — the innermost scope wins."""
+    if deadline_s is None:
+        yield
+        return
+    prev = getattr(_deadline, "v", None)
+    _deadline.v = ((start if start is not None else clock())
+                   + deadline_s, clock)
+    try:
+        yield
+    finally:
+        _deadline.v = prev
+
+
+def check_deadline() -> None:
+    """Raise QueryDeadlineExceeded when the active scope's deadline has
+    passed; no-op outside any scope. Cheap enough for per-chunk
+    granularity (one thread-local read + one clock read)."""
+    v = getattr(_deadline, "v", None)
+    if v is not None and v[1]() > v[0]:
+        raise QueryDeadlineExceeded(
+            "query deadline exceeded mid-attempt "
+            "(engine.query_deadline_s)")
 
 # message fragments that mark a transient accelerator/runtime failure
 # (jaxlib surfaces device OOM as XlaRuntimeError("RESOURCE_EXHAUSTED:
@@ -70,6 +116,14 @@ def classify(exc: BaseException) -> str:
         return DETERMINISTIC
     if isinstance(exc, faults_mod.InjectedTransientFault):
         return TRANSIENT
+    if isinstance(exc, QueryDeadlineExceeded):
+        return DETERMINISTIC
+    from nds_tpu.io.integrity import CorruptArtifact
+    if isinstance(exc, CorruptArtifact):
+        # re-reading corrupt bytes yields the same corrupt bytes:
+        # explicitly deterministic even if a message ever carried a
+        # transient marker
+        return DETERMINISTIC
     msg = str(exc)
     if any(m in msg for m in _TRANSIENT_MARKERS):
         return TRANSIENT
@@ -179,42 +233,71 @@ class RetryPolicy:
         (optional, caller-owned) receives the accounting either way;
         a success that still overran the deadline is returned but
         flagged ``deadline_exceeded`` (and counted), since its wall
-        clock already damaged the run it was deadlined for."""
-        from nds_tpu.obs import metrics as obs_metrics
+        clock already damaged the run it was deadlined for.
+
+        The deadline is also enforced INSIDE an attempt: the call runs
+        under ``deadline_scope``, so loop bodies that poll
+        ``check_deadline()`` (the chunked executor, between chunks)
+        abort mid-attempt with QueryDeadlineExceeded; and a FINAL
+        attempt that fails after overrunning the deadline still records
+        ``deadline_exceeded`` alongside its ``gave_up_reason`` — the
+        overrun happened whether or not the attempt also raised."""
         stats = stats if stats is not None else RetryStats()
         start = self._clock()
-        while True:
-            stats.attempts += 1
-            try:
-                result = fn(*args)
-            except Exception as exc:  # noqa: BLE001 - classified below
-                stats.errors.append(f"{type(exc).__name__}: {exc}")
-                if classify_fn(exc) != TRANSIENT:
-                    stats.gave_up_reason = DETERMINISTIC
-                    raise
-                if stats.attempts >= self.max_attempts:
-                    stats.gave_up_reason = (
-                        f"attempts_exhausted({stats.attempts})")
-                    raise
-                d = self.delay_for(stats.retries)
-                if (self.deadline_s is not None
-                        and self._clock() - start + d > self.deadline_s):
-                    stats.gave_up_reason = "deadline"
-                    stats.deadline_exceeded = True
-                    obs_metrics.counter(
-                        "query_deadline_exceeded_total").inc()
-                    raise
-                stats.retries += 1
-                stats.backoff_s += d
-                obs_metrics.counter("query_retries_total").inc()
-                if on_retry is not None:
-                    on_retry(exc, stats.retries)
-                if d > 0:
-                    self._sleep(d)
-                continue
-            if (self.deadline_s is not None
-                    and self._clock() - start > self.deadline_s):
+
+        def _overrun() -> bool:
+            return (self.deadline_s is not None
+                    and self._clock() - start > self.deadline_s)
+
+        def _flag_deadline() -> None:
+            from nds_tpu.obs import metrics as obs_metrics
+            if not stats.deadline_exceeded:
                 stats.deadline_exceeded = True
                 obs_metrics.counter(
                     "query_deadline_exceeded_total").inc()
-            return result
+
+        with deadline_scope(self.deadline_s, self._clock, start=start):
+            while True:
+                stats.attempts += 1
+                try:
+                    result = fn(*args)
+                except QueryDeadlineExceeded as exc:
+                    # an in-attempt deadline abort IS the deadline
+                    # giving up, not a deterministic engine bug
+                    stats.errors.append(
+                        f"{type(exc).__name__}: {exc}")
+                    stats.gave_up_reason = "deadline"
+                    _flag_deadline()
+                    raise
+                except Exception as exc:  # noqa: BLE001 - classified below
+                    stats.errors.append(f"{type(exc).__name__}: {exc}")
+                    if classify_fn(exc) != TRANSIENT:
+                        stats.gave_up_reason = DETERMINISTIC
+                        if _overrun():
+                            _flag_deadline()
+                        raise
+                    if stats.attempts >= self.max_attempts:
+                        stats.gave_up_reason = (
+                            f"attempts_exhausted({stats.attempts})")
+                        if _overrun():
+                            _flag_deadline()
+                        raise
+                    d = self.delay_for(stats.retries)
+                    if (self.deadline_s is not None
+                            and self._clock() - start + d
+                            > self.deadline_s):
+                        stats.gave_up_reason = "deadline"
+                        _flag_deadline()
+                        raise
+                    from nds_tpu.obs import metrics as obs_metrics
+                    stats.retries += 1
+                    stats.backoff_s += d
+                    obs_metrics.counter("query_retries_total").inc()
+                    if on_retry is not None:
+                        on_retry(exc, stats.retries)
+                    if d > 0:
+                        self._sleep(d)
+                    continue
+                if _overrun():
+                    _flag_deadline()
+                return result

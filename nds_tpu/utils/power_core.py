@@ -24,7 +24,19 @@ retry with backoff, deterministic parse/plan errors fail fast; the
 per-query summary records ``retries`` / ``gave_up_reason`` /
 ``deadline_exceeded``. ``engine.fallback=cpu`` demotes the remaining
 stream to the CPU oracle after repeated device failures. Fault
-injection context (``NDS_TPU_FAULTS``) carries the query name.
+injection context (``NDS_TPU_FAULTS``) carries the query name — and
+the stream name (``NDS_TPU_STREAM``) when a supervisor launched this
+process as one throughput stream.
+
+Hang detection (resilience/watchdog.py): the loop publishes heartbeats
+(query, phase, attempt) around every dispatch and retry; with
+``engine.watchdog.stall_s`` (or ``NDS_TPU_WATCHDOG=stall_s[:action]``)
+a daemon watchdog dumps all-thread stacks + live metrics to
+``stall-<query>.json`` in the run dir when the heartbeats go silent,
+and ``action=kill`` hard-exits so a stream supervisor can restart the
+process. The warehouse load runs under the same retry policy and —
+with ``io.verify_digests`` — digest verification: a corrupt artifact
+fails the load fast, with a diagnosable BenchReport naming the file.
 """
 
 from __future__ import annotations
@@ -38,7 +50,7 @@ from nds_tpu.engine.session import Session
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
-from nds_tpu.resilience import faults
+from nds_tpu.resilience import faults, watchdog
 from nds_tpu.resilience.retry import RetryPolicy, RetryStats
 from nds_tpu.utils.config import EngineConfig
 from nds_tpu.utils.report import BenchReport
@@ -144,6 +156,9 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
     for name, schema in schemas.items():
         if tables is not None and name not in tables:
             continue
+        # per-table liveness: a multi-minute warehouse load must not
+        # read as a hang to the watchdog (resilience/watchdog.py)
+        watchdog.beat("engine", phase="load_warehouse", table=name)
         t0 = time.perf_counter()
         tdir = os.path.join(data_dir, name)
         if fmt in csv_io.FORMAT_EXT:
@@ -168,9 +183,10 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
             table = csv_io.read_table_fmt(paths, name, schema, fmt)
         elif fmt == "raw":
             if os.path.isdir(tdir):
+                from nds_tpu.io.integrity import MANIFEST_NAME
                 paths = sorted(
                     os.path.join(tdir, f) for f in os.listdir(tdir)
-                    if not f.startswith("."))
+                    if not f.startswith(".") and f != MANIFEST_NAME)
             else:
                 paths = [os.path.join(data_dir, f"{name}{suite.raw_ext}")]
             table = csv_io.read_tbl(paths, name, schema)
@@ -223,20 +239,41 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
 
     With ``NDS_TPU_METRICS_SNAP=path[:interval]`` set, a snapshot
     emitter (nds_tpu/obs/snapshot.py) publishes the metrics registry +
-    run progress periodically while the stream runs, so long runs are
-    observable in flight, not only post-mortem."""
+    run progress + heartbeat ages periodically while the stream runs,
+    so long runs are observable in flight, not only post-mortem."""
+    from contextlib import nullcontext
+
     from nds_tpu.obs.snapshot import MetricsSnapshotter
+    config = config or EngineConfig()
     progress = {"suite": suite.name, "stream": stream_path,
                 "queries_completed": 0, "current_query": None}
     snap = MetricsSnapshotter.from_env(progress)
     if snap:
         snap.start()
+    # hang watchdog: stall reports land next to the run's artifacts
+    run_dir = (json_summary_folder
+               or os.path.dirname(time_log_path) or ".")
+    wd = (watchdog.Watchdog.from_config(config, run_dir)
+          or watchdog.Watchdog.from_env(run_dir))
+    if wd:
+        wd.start()
+    # supervised throughput streams carry their stream name into the
+    # fault-injection context, so seeded chaos schedules can target
+    # one stream (and one incarnation) of a fleet
+    stream_name = os.environ.get(watchdog.STREAM_ENV)
+    ctx = (faults.context(stream=stream_name) if stream_name
+           else nullcontext())
     try:
-        return _run_query_stream(
-            suite, data_dir, stream_path, time_log_path, config,
-            input_format, json_summary_folder, output_prefix, warmup,
-            query_subset, profile_dir, extra_time_log, progress)
+        with ctx:
+            return _run_query_stream(
+                suite, data_dir, stream_path, time_log_path, config,
+                input_format, json_summary_folder, output_prefix,
+                warmup, query_subset, profile_dir, extra_time_log,
+                progress)
     finally:
+        if wd:
+            wd.stop()
+        watchdog.clear_unit(stream_name or f"power-{suite.name}")
         if snap:
             progress["current_query"] = None
             snap.stop()
@@ -247,6 +284,13 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                       output_prefix, warmup, query_subset, profile_dir,
                       extra_time_log, progress) -> int:
     config = config or EngineConfig()
+    if config.get_bool("io.verify_digests"):
+        # sticky per process, like the env-var gate it mirrors: every
+        # later read in this run verifies too (resume, maintenance)
+        from nds_tpu.io import integrity
+        integrity.set_verify(True)
+    unit = (os.environ.get(watchdog.STREAM_ENV)
+            or f"power-{suite.name}")
     session = make_session(suite, config)
     backend = config.get("engine.backend", "cpu")
     # multi-controller SPMD: every process computes every query, rank 0
@@ -259,9 +303,43 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     app_id = f"{suite.name}-tpu-{backend}-{int(time.time())}"
     tlog = TimeLog(app_id)
     total_start = time.perf_counter()
+    policy = RetryPolicy.from_config(config)
 
-    setup = load_warehouse(suite, session, data_dir, input_format,
-                           schemas=suite_schemas(suite, config))
+    # the warehouse load runs under the SAME retry policy as queries —
+    # transient io hiccups retry, a CorruptArtifact (digest mismatch,
+    # io/integrity.py) is deterministic and fails the run FAST with a
+    # BenchReport naming the file and both digests, retries=0 — but
+    # NOT under the per-QUERY deadline (a 25-table load is not a query)
+    load_policy = RetryPolicy(
+        max_attempts=policy.max_attempts,
+        base_delay_s=policy.base_delay_s,
+        max_delay_s=policy.max_delay_s, jitter=policy.jitter,
+        deadline_s=None, seed=policy.seed)
+    watchdog.beat(unit, phase="load_warehouse")
+    lstats = RetryStats()
+    load_hold: dict = {}
+
+    def _load_bracket():
+        def _load():
+            return load_warehouse(suite, session, data_dir,
+                                  input_format,
+                                  schemas=suite_schemas(suite, config))
+        try:
+            load_hold["setup"] = load_policy.call(_load, stats=lstats)
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            load_hold["error"] = exc
+            raise
+
+    load_report = BenchReport("load_warehouse", config.as_dict())
+    load_report.report_on(_load_bracket)
+    load_report.attach_retry(lstats)
+    if "error" in load_hold:
+        if json_summary_folder and primary:
+            os.makedirs(json_summary_folder, exist_ok=True)
+            load_report.write_summary(prefix=f"power-{app_id}",
+                                      out_dir=json_summary_folder)
+        raise load_hold["error"]
+    setup = load_hold["setup"]
     for tname, secs in setup.items():
         tlog.add(f"CreateTempView {tname}", int(secs * 1000))
 
@@ -283,11 +361,11 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         jax.profiler.start_trace(profile_dir)
         profiler_cm = True
     failures = 0
-    policy = RetryPolicy.from_config(config)
     fallback = config.get("engine.fallback")
     device_failure_streak = 0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
+        watchdog.beat(unit, query=qname, phase="dispatch")
         if warmup and not qname.startswith(suite.warmup_skip_prefixes):
             # span recording off during warmup: untimed passes would
             # otherwise append orphan root trees to the Chrome trace,
@@ -335,12 +413,22 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
             # the TimeLog row bill the retries and backoff to the query
             # that needed them, exactly like a Spark task retry bills
             # its stage
+            def _body(session, sql):
+                # per-query dispatch chaos site (stream.query): fires
+                # per ATTEMPT inside the policy, so raising kinds are
+                # classified/retried and a `hang` stalls exactly like
+                # a stuck engine call would — between heartbeats
+                faults.fault_point("stream.query")
+                return run_one_query(session, sql, _q, _o)
+
             with tracer.span("query", query=_q, suite=suite.name,
                              backend=backend) as sp:
                 _h["span"] = sp
                 with faults.context(query=_q):
-                    return policy.call(run_one_query, session, sql,
-                                       _q, _o, stats=_st)
+                    return policy.call(
+                        _body, session, sql, stats=_st,
+                        on_retry=lambda exc, n: watchdog.beat(
+                            unit, query=_q, phase="retry", attempt=n))
 
         # exports park during the bracket (even a ~ms inline write
         # would skew span totals vs the TimeLog row) and flush after
@@ -408,6 +496,7 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
             summary["metrics"] = mdelta
         tlog.add(qname, elapsed_ms)
         progress["queries_completed"] += 1
+        watchdog.beat(unit, query=qname, phase="done")
         print(f"====== Run {qname} ======")
         print(f"Time taken: {elapsed_ms} millis for {qname}")
         if json_summary_folder and primary:

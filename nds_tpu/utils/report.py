@@ -229,6 +229,7 @@ class BenchReport:
         path = (os.path.join(out_dir, filename) if out_dir
                 else filename)
         with open(path, "w") as f:
+            # ndslint: waive[NDS109] -- filename embeds query+startTime so every write is to a fresh unique path; no reader races a first write
             json.dump(self.summary, f, indent=2)
         return path
 

@@ -19,6 +19,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # planning time and again post-staging on the device path
 os.environ["NDS_TPU_VERIFY_PLANS"] = "1"
 
+# artifact digest verification likewise (nds_tpu/io/integrity.py):
+# every warehouse/cache read a test performs re-hashes against its
+# table manifest; files without a manifest load unverified, so
+# fixtures predating manifests keep working
+os.environ["NDS_TPU_VERIFY_DIGESTS"] = "1"
+
 
 def _jaxlib_knows(*flag_names: str) -> bool:
     """True when the installed jaxlib's binaries mention EVERY given

@@ -1,24 +1,32 @@
-"""Resilience layer tests: seeded fault injection, failure
-classification, RetryPolicy backoff/deadline semantics, the power-loop
-retry + fallback integration, thread-safe failure collection, the
-NDS108 naked-retry lint rule, the resumable bench journal, chunked-
-executor OOM degradation, and throughput stream failure reports."""
+"""Resilience layer tests: seeded fault injection (hang/corrupt kinds
+included), failure classification, RetryPolicy backoff/deadline
+semantics (mid-attempt deadline checks included), the power-loop retry
++ fallback integration, thread-safe failure collection, the NDS108/
+NDS109 lint rules, the resumable bench journal (torn-journal
+degradation included), chunked-executor OOM degradation, throughput
+stream failure reports, the heartbeat watchdog + stall reports, the
+stream supervisor's restart-once semantics, and artifact digest
+verification."""
 
 import json
 import os
+import sys
 import threading
+import time
 
 import pytest
 
 from nds_tpu.analysis import lint_rules
+from nds_tpu.io import integrity
 from nds_tpu.nds import gen_data, streams
 from nds_tpu.obs import metrics as obs_metrics
-from nds_tpu.resilience import faults
+from nds_tpu.resilience import faults, supervise, watchdog
 from nds_tpu.resilience.journal import (
     JournalMismatch, PhaseJournal, config_digest,
 )
 from nds_tpu.resilience.retry import (
-    DETERMINISTIC, TRANSIENT, RetryPolicy, RetryStats, classify, is_oom,
+    DETERMINISTIC, TRANSIENT, QueryDeadlineExceeded, RetryPolicy,
+    RetryStats, check_deadline, classify, deadline_scope, is_oom,
 )
 from nds_tpu.utils import power_core
 from nds_tpu.utils.config import EngineConfig
@@ -784,6 +792,10 @@ class TestBenchResume:
         state = json.load(open(jpath))
         for ph in ("throughput_2", "maintenance_2"):
             del state["phases"][ph]
+        # hand-edited journal: drop the stale CRC stamp (an unstamped
+        # journal is trusted legacy; a MISmatched one is torn — that
+        # path is covered by test_crc_tampered_journal_also_degrades)
+        state.pop("crc", None)
         with open(jpath, "w") as f:
             json.dump(state, f)
         calls.clear()
@@ -818,3 +830,541 @@ class TestBenchResume:
         calls.clear()
         run_full_bench(cfg)  # NOT resume: everything re-runs
         assert len(calls) == n
+
+    def test_torn_journal_resumes_fresh_with_warning(self, tmp_path,
+                                                     monkeypatch,
+                                                     capsys):
+        """Truncated bench_state.json: --resume warns, re-runs every
+        phase, and computes the SAME final metric a clean run would —
+        never a crash, never a splice of half-recorded phases."""
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        m1 = run_full_bench(cfg)
+        n_phases = len(calls)
+        jpath = os.path.join(cfg["paths"]["reports"],
+                             "bench_state.json")
+        blob = open(jpath).read()
+        with open(jpath, "w") as f:
+            f.write(blob[: len(blob) // 2])  # torn mid-write
+        calls.clear()
+        m2 = run_full_bench(cfg, resume=True)
+        out = capsys.readouterr().out
+        assert "torn/corrupt" in out
+        assert len(calls) == n_phases   # nothing replayed from the wreck
+        assert m2["metric"] == m1["metric"]
+
+    def test_crc_tampered_journal_also_degrades(self, tmp_path,
+                                                monkeypatch, capsys):
+        from nds_tpu.nds.bench import run_full_bench
+        calls = []
+        self._fake_phases(monkeypatch, calls)
+        cfg = self._cfg(tmp_path)
+        run_full_bench(cfg)
+        jpath = os.path.join(cfg["paths"]["reports"],
+                             "bench_state.json")
+        state = json.load(open(jpath))
+        assert "crc" in state
+        state["phases"]["power_test"]["timings"]["power_time_s"] = 9e9
+        with open(jpath, "w") as f:
+            json.dump(state, f)     # valid JSON, stale CRC
+        calls.clear()
+        run_full_bench(cfg, resume=True)
+        assert "torn/corrupt" in capsys.readouterr().out
+        assert calls                # phases re-ran, tamper not trusted
+
+
+# ------------------------------------------------ heartbeat watchdog
+
+@pytest.fixture(autouse=True)
+def _clean_heartbeats():
+    yield
+    watchdog.reset()
+
+
+class TestWatchdog:
+    def test_beat_registry_snapshot_and_clear(self):
+        watchdog.beat("u1", query="query5", phase="dispatch", attempt=2)
+        e = watchdog.snapshot_heartbeats()["u1"]
+        assert e["query"] == "query5" and e["phase"] == "dispatch"
+        assert e["attempt"] == 2 and e["count"] == 1
+        assert e["age_s"] >= 0
+        watchdog.beat("u1", query="query6")
+        assert watchdog.snapshot_heartbeats()["u1"]["count"] == 2
+        watchdog.clear_unit("u1")
+        assert watchdog.snapshot_heartbeats() == {}
+
+    def test_stall_report_schema_counter_and_rearm(self, tmp_path):
+        wd = watchdog.Watchdog(stall_s=0.01, run_dir=str(tmp_path))
+        before = obs_metrics.snapshot()
+        watchdog.beat("stream", query="query5", phase="dispatch")
+        time.sleep(0.03)
+        path = wd.check_once()
+        assert path and os.path.basename(path) == "stall-query5.json"
+        rep = json.load(open(path))
+        for key in ("unit", "query", "phase", "attempt", "age_s",
+                    "stall_s", "action", "ts", "pid", "heartbeats",
+                    "threads", "metrics"):
+            assert key in rep, key
+        assert rep["unit"] == "stream" and rep["query"] == "query5"
+        assert rep["age_s"] > rep["stall_s"] == 0.01
+        # this test thread's stack is in the dump
+        assert any("test_stall_report" in line
+                   for frames in rep["threads"].values()
+                   for line in frames)
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["watchdog_stalls_total"] == 1
+        # the SAME silence reports once...
+        assert wd.check_once() is None
+        # ...a new beat re-arms, and the next report gets a -2 suffix
+        watchdog.beat("stream", query="query5", phase="retry")
+        time.sleep(0.03)
+        p2 = wd.check_once()
+        assert p2 and p2.endswith("stall-query5-2.json")
+
+    def test_any_units_beat_keeps_the_alarm_armed(self, tmp_path):
+        """Progress ANYWHERE re-arms: a slow query whose chunk loop
+        still beats must never read as a stall."""
+        wd = watchdog.Watchdog(stall_s=0.05, run_dir=str(tmp_path))
+        watchdog.beat("stream", query="query5")
+        time.sleep(0.07)
+        watchdog.beat("engine", phase="chunk.scan")
+        assert wd.check_once() is None
+
+    def test_kill_action_dumps_then_exits(self, tmp_path):
+        codes = []
+        wd = watchdog.Watchdog(stall_s=0.01, action="kill",
+                               run_dir=str(tmp_path),
+                               _exit=codes.append)
+        watchdog.beat("s", query="query1")
+        time.sleep(0.03)
+        wd.check_once()
+        assert codes == [watchdog.EXIT_STALLED]
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           "stall-query1.json"))
+
+    def test_from_config_and_env(self, monkeypatch, tmp_path):
+        cfg = EngineConfig(overrides={"engine.watchdog.stall_s": "5",
+                                      "engine.watchdog.action": "kill"})
+        wd = watchdog.Watchdog.from_config(cfg, str(tmp_path))
+        assert wd.stall_s == 5.0 and wd.action == "kill"
+        assert watchdog.Watchdog.from_config(EngineConfig(), ".") is None
+        monkeypatch.setenv(watchdog.WATCHDOG_ENV, "2.5:report")
+        wd2 = watchdog.Watchdog.from_env(".")
+        assert wd2.stall_s == 2.5 and wd2.action == "report"
+        monkeypatch.delenv(watchdog.WATCHDOG_ENV)
+        assert watchdog.Watchdog.from_env(".") is None
+        with pytest.raises(ValueError):
+            watchdog.Watchdog(stall_s=1.0, action="bogus")
+
+    def test_snapshot_embeds_heartbeats(self, tmp_path):
+        from nds_tpu.obs.snapshot import MetricsSnapshotter
+        watchdog.beat("stream", query="query9", phase="dispatch")
+        path = str(tmp_path / "snap.json")
+        MetricsSnapshotter(path).write_once()
+        doc = json.load(open(path))
+        assert doc["heartbeats"]["stream"]["query"] == "query9"
+        assert doc["heartbeats"]["stream"]["age_s"] >= 0
+
+
+# -------------------------------------------- hang & corrupt kinds
+
+class TestHangCorruptKinds:
+    def test_parse_defaults(self):
+        specs = faults.parse_schedule(
+            "stream.query:hang=30@query_1,io.read:corrupt@store_*")
+        assert specs[0].kind == "hang" and specs[0].param == 30.0
+        assert specs[0].times == 1      # hang once, like raising kinds
+        assert specs[1].kind == "corrupt" and specs[1].times == 1
+
+    def test_hang_sleeps_param_seconds(self):
+        faults.install("plan:hang=0.1@*")
+        t0 = time.monotonic()
+        faults.fault_point("plan")
+        assert time.monotonic() - t0 >= 0.1
+        faults.fault_point("plan")      # budget spent: instant no-op
+        assert time.monotonic() - t0 < 5
+
+    def test_hang_is_interruptible(self):
+        faults.install("plan:hang=60@*")
+        t = threading.Thread(target=faults.fault_point, args=("plan",))
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive()             # genuinely hung
+        faults.interrupt_hangs()
+        t.join(timeout=2)
+        assert not t.is_alive()
+
+    def test_corrupt_flips_one_byte_once(self, tmp_path):
+        p = str(tmp_path / "chunk.dat")
+        with open(p, "wb") as f:
+            f.write(b"0123456789")
+        faults.install("io.read:corrupt@*")
+        faults.fault_point("io.read", table="t", paths=[p])
+        mutated = open(p, "rb").read()
+        assert mutated != b"0123456789"
+        assert len(mutated) == 10       # flip, not truncate
+        faults.fault_point("io.read", table="t", paths=[p])
+        assert open(p, "rb").read() == mutated  # times=1: fired once
+
+    def test_corrupt_without_paths_context_raises(self):
+        faults.install("io.read:corrupt@*")
+        with pytest.raises(ValueError, match="paths"):
+            faults.fault_point("io.read", table="t")
+
+
+# ------------------------------------------------ artifact integrity
+
+class TestIntegrity:
+    def test_manifest_roundtrip_then_mismatch(self, tmp_path):
+        d = str(tmp_path / "tbl")
+        os.makedirs(d)
+        p = os.path.join(d, "part-0.parquet")
+        with open(p, "wb") as f:
+            f.write(b"payload-bytes")
+        integrity.write_manifest(d)
+        integrity.set_verify(True)
+        try:
+            integrity.verify_paths([p], "tbl")  # clean: no raise
+            with open(p, "r+b") as f:
+                f.seek(4)
+                f.write(b"X")
+            integrity.clear_cache()
+            with pytest.raises(integrity.CorruptArtifact) as ei:
+                integrity.verify_paths([p], "tbl")
+            msg = str(ei.value)
+            assert p in msg and "sha256 expected" in msg
+            assert ei.value.expected != ei.value.actual
+        finally:
+            integrity.set_verify(None)
+
+    def test_corrupt_artifact_is_deterministic(self):
+        assert classify(integrity.CorruptArtifact("f", "a", "b")) \
+            == DETERMINISTIC
+
+    def test_unmanifested_files_load_unverified(self, tmp_path):
+        p = str(tmp_path / "legacy.dat")
+        with open(p, "wb") as f:
+            f.write(b"no manifest anywhere")
+        integrity.set_verify(True)
+        try:
+            integrity.verify_paths([p], "legacy")   # no raise
+        finally:
+            integrity.set_verify(None)
+
+    def test_disabled_gate_skips_hashing(self, tmp_path):
+        d = str(tmp_path / "tbl")
+        os.makedirs(d)
+        p = os.path.join(d, "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"abc")
+        integrity.write_manifest(d)
+        with open(p, "wb") as f:
+            f.write(b"xyz")
+        integrity.set_verify(False)
+        try:
+            integrity.verify_paths([p], "tbl")      # gate off: no raise
+        finally:
+            integrity.set_verify(None)
+
+    def test_read_tbl_verifies_digests(self, tmp_path):
+        from nds_tpu.engine.types import INT64, Schema
+        from nds_tpu.io import csv_io
+        d = str(tmp_path / "t")
+        os.makedirs(d)
+        p = os.path.join(d, "t_1_1.dat")
+        with open(p, "w") as f:
+            f.write("1|2|\n3|4|\n")
+        integrity.write_manifest(d)
+        schema = Schema.of(("a", INT64, False), ("b", INT64, False))
+        integrity.set_verify(True)
+        try:
+            t = csv_io.read_tbl([p], "t", schema)
+            assert t.nrows == 2
+            with open(p, "r+b") as f:
+                f.seek(2)
+                f.write(b"9")
+            integrity.clear_cache()
+            with pytest.raises(integrity.CorruptArtifact):
+                csv_io.read_tbl([p], "t", schema)
+        finally:
+            integrity.set_verify(None)
+
+    def test_crc_stamp_and_check(self):
+        doc = integrity.stamp_crc({"a": 1, "b": [2, 3]})
+        assert integrity.check_crc(doc)
+        tampered = {**doc, "a": 2}
+        assert not integrity.check_crc(tampered)
+        assert integrity.check_crc({"legacy": "no-crc"})
+
+    def test_write_json_atomic_leaves_no_tmp(self, tmp_path):
+        p = str(tmp_path / "x" / "doc.json")
+        integrity.write_json_atomic(p, {"k": 1})
+        assert json.load(open(p)) == {"k": 1}
+        assert os.listdir(os.path.dirname(p)) == ["doc.json"]
+
+    def test_torn_snapshot_manifest_degrades_to_baseline(self,
+                                                         tmp_path,
+                                                         capsys):
+        from nds_tpu.io.snapshots import MANIFEST, SnapshotLog
+        wh = str(tmp_path / "wh")
+        os.makedirs(os.path.join(wh, "t1"))
+        log = SnapshotLog(wh)
+        log.commit({"t1": ["t1/_v1/part-0.parquet"]}, note="m1")
+        assert SnapshotLog(wh).entries      # round-trips
+        mpath = os.path.join(wh, MANIFEST)
+        blob = open(mpath).read()
+        with open(mpath, "w") as f:
+            f.write(blob[: len(blob) // 2])
+        log2 = SnapshotLog(wh)
+        assert log2.entries == []           # baseline, not a crash
+        assert "torn/corrupt" in capsys.readouterr().out
+
+
+# --------------------------------------------- mid-attempt deadlines
+
+class TestMidAttemptDeadline:
+    def _clocked(self, **kw):
+        t = {"now": 0.0}
+        calls = []
+        pol = RetryPolicy(base_delay_s=0.1, jitter=0.0,
+                          clock=lambda: t["now"],
+                          sleep=calls.append, **kw)
+        return pol, t, calls
+
+    def test_check_deadline_scope(self):
+        t = {"now": 0.0}
+        check_deadline()                    # outside any scope: no-op
+        with deadline_scope(1.0, clock=lambda: t["now"]):
+            check_deadline()                # within budget
+            t["now"] = 2.0
+            with pytest.raises(QueryDeadlineExceeded):
+                check_deadline()
+        check_deadline()                    # scope popped
+
+    def test_policy_publishes_scope_and_flags_abort(self):
+        pol, t, _ = self._clocked(deadline_s=1.0, max_attempts=3)
+        st = RetryStats()
+
+        def body():
+            t["now"] = 5.0                  # attempt overruns mid-flight
+            check_deadline()
+
+        with pytest.raises(QueryDeadlineExceeded):
+            pol.call(body, stats=st)
+        assert st.attempts == 1             # never retried
+        assert st.gave_up_reason == "deadline"
+        assert st.deadline_exceeded is True
+
+    def test_deadline_recorded_when_final_attempt_raises(self):
+        pol, t, _ = self._clocked(deadline_s=10.0, max_attempts=2)
+        st = RetryStats()
+
+        def body():
+            t["now"] += 6.0                 # 2 attempts -> t=12 > 10
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake")
+
+        with pytest.raises(RuntimeError):
+            pol.call(body, stats=st)
+        assert st.gave_up_reason == "attempts_exhausted(2)"
+        assert st.deadline_exceeded is True  # overrun recorded too
+
+    def test_deterministic_failure_past_deadline_flags(self):
+        pol, t, _ = self._clocked(deadline_s=1.0, max_attempts=3)
+        st = RetryStats()
+
+        def body():
+            t["now"] = 9.0
+            raise ValueError("planner bug")
+
+        with pytest.raises(ValueError):
+            pol.call(body, stats=st)
+        assert st.gave_up_reason == DETERMINISTIC
+        assert st.deadline_exceeded is True
+
+    def test_within_deadline_keeps_flags_clear(self):
+        pol, t, _ = self._clocked(deadline_s=10.0, max_attempts=2)
+        st = RetryStats()
+        assert pol.call(lambda: 42, stats=st) == 42
+        assert st.deadline_exceeded is False
+
+
+def test_chunked_execution_honors_deadline_between_chunks(mini_wh):
+    """An already-expired deadline stops a streamed query at the next
+    chunk boundary — inside the attempt, not after it."""
+    sess, _table = _chunked_session(mini_wh, chunk_rows=1 << 12)
+    t = {"now": 0.0}
+    with deadline_scope(1.0, clock=lambda: t["now"]):
+        t["now"] = 5.0
+        with pytest.raises(QueryDeadlineExceeded):
+            sess.sql("select count(*) c from store_sales")
+
+
+# ------------------------------------------------- stream supervisor
+
+def _script_spec(name, out_dir, scripts, hb_path=None, queries=()):
+    """StreamSpec whose incarnations run the given -c scripts (the
+    last script repeats once the list is exhausted)."""
+    def make_cmd(incarnation, remaining):
+        body = scripts[min(incarnation, len(scripts) - 1)]
+        return [sys.executable, "-c", body]
+    return supervise.StreamSpec(
+        name=name, make_cmd=make_cmd,
+        hb_path=hb_path or os.path.join(out_dir, f"{name}_hb.json"),
+        queries=list(queries))
+
+
+class TestStreamSupervisor:
+    def test_restart_once_then_success(self, tmp_path):
+        out = str(tmp_path)
+        before = obs_metrics.snapshot()
+        spec = _script_spec("s1", out, ["raise SystemExit(3)", "pass"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert codes == [0]
+        assert s["exit_codes"] == [3, 0]
+        assert s["restarts"] == 1 and s["degraded"]
+        assert s["final_code"] == 0
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["stream_restarts_total"] == 1
+        # summary artifact on disk
+        ondisk = json.load(open(os.path.join(
+            out, supervise.SUMMARY_NAME)))
+        assert ondisk["streams"]["s1"]["restarts"] == 1
+
+    def test_restart_budget_is_one(self, tmp_path):
+        out = str(tmp_path)
+        spec = _script_spec("s1", out, ["raise SystemExit(3)"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert codes == [3]
+        assert s["exit_codes"] == [3, 3]    # exactly one restart
+        assert s["restarts"] == 1 and s["final_code"] == 3
+
+    def test_finished_stream_never_restarts(self, tmp_path):
+        """Exit 1 with every query completed is the reference's
+        completed-with-failures contract — restarting would re-run
+        finished work."""
+        out = str(tmp_path)
+        hb = os.path.join(out, "s1_hb.json")
+        script = (
+            "import json\n"
+            f"json.dump({{'progress': {{'queries_completed': 2, "
+            f"'queries_total': 2}}}}, open(r'{hb}', 'w'))\n"
+            "raise SystemExit(1)\n")
+        spec = _script_spec("s1", out, [script], hb_path=hb,
+                            queries=["query1", "query2"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert s["restarts"] == 0
+        assert s["exit_codes"] == [1] and codes == [1]
+        assert s["completed"] == 2
+
+    def test_stalled_stream_killed_and_restarted(self, tmp_path):
+        """A wedged child (stale heartbeat ages, then silence) is
+        SIGTERMed by the parent backstop and restarted once."""
+        out = str(tmp_path)
+        hb = os.path.join(out, "s1_hb.json")
+        hang = (
+            "import json, time\n"
+            f"json.dump({{'progress': {{}}, 'heartbeats': "
+            f"{{'u': {{'age_s': 999, 'count': 1}}}}}}, "
+            f"open(r'{hb}', 'w'))\n"
+            "time.sleep(60)\n")
+        spec = _script_spec("s1", out, [hang, "pass"], hb_path=hb)
+        sup = supervise.StreamSupervisor([spec], out, stall_s=0.2,
+                                         poll_s=0.05, grace_s=1.0,
+                                         startup_grace_s=10.0)
+        t0 = time.monotonic()
+        _elapse, codes, summary = sup.run()
+        assert time.monotonic() - t0 < 30   # never waited the 60 s out
+        s = summary["streams"]["s1"]
+        assert codes == [0]
+        assert s["restarts"] == 1 and s["stalls"]
+        assert s["signals"] and s["signals"][0] in (15, 9)
+        assert s["stalls"][0]["source"] == "supervisor"
+        # supervisor-side stall artifact
+        assert os.path.exists(os.path.join(out, "stall-s1.json"))
+
+    def test_resume_never_splits_a_part_group(self):
+        """NDS-H q15's parts share in-process state (CREATE VIEW /
+        SELECT / DROP VIEW): a restart boundary inside the group must
+        snap back to part1, or part2 fails on the missing view."""
+        qs = ["query14_part1", "query14_part2", "query15_part1",
+              "query15_part2", "query15_part3", "query16"]
+        assert supervise.resume_index(qs, 0) == 0
+        assert supervise.resume_index(qs, 2) == 2   # group boundary
+        assert supervise.resume_index(qs, 3) == 2   # mid-q15: snap back
+        assert supervise.resume_index(qs, 4) == 2
+        assert supervise.resume_index(qs, 5) == 5   # clean boundary
+        assert supervise.resume_index(qs, 6) == 6   # finished
+        # mid-q14 snaps to q14's own part1, not further
+        assert supervise.resume_index(qs, 1) == 0
+
+    def test_mini_journal_written(self, tmp_path):
+        out = str(tmp_path)
+        spec = _script_spec("s1", out, ["import time; time.sleep(0.3)"],
+                            queries=["q1"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05)
+        sup.run()
+        j = json.load(open(os.path.join(out, "s1_journal.json")))
+        assert j["incarnation"] == 0 and j["restarts"] == 0
+        assert j["queries_total"] == 1
+
+
+# --------------------------------------------------- NDS109 lint
+
+class TestNonAtomicJsonWriteRule:
+    def test_bare_dump_flags(self):
+        res = _lint(
+            "import json\n"
+            "def save(path, doc):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump(doc, f)\n",
+            enabled={"NDS109"})
+        assert _rules(res.violations) == {"NDS109"}
+
+    def test_tmp_plus_replace_is_clean(self):
+        res = _lint(
+            "import json, os\n"
+            "def save(path, doc):\n"
+            "    with open(path + '.tmp', 'w') as f:\n"
+            "        json.dump(doc, f)\n"
+            "    os.replace(path + '.tmp', path)\n",
+            enabled={"NDS109"})
+        assert res.violations == []
+
+    def test_fp_keyword_also_flags(self):
+        res = _lint(
+            "import json\n"
+            "def save(path, doc):\n"
+            "    with open(path, mode='w') as f:\n"
+            "        json.dump(doc, fp=f)\n",
+            enabled={"NDS109"})
+        assert _rules(res.violations) == {"NDS109"}
+
+    def test_read_handle_is_clean(self):
+        res = _lint(
+            "import json\n"
+            "def load(path):\n"
+            "    with open(path) as f:\n"
+            "        return json.load(f)\n",
+            enabled={"NDS109"})
+        assert res.violations == []
+
+    def test_waiver_applies(self):
+        res = _lint(
+            "import json\n"
+            "def save(path, doc):\n"
+            "    with open(path, 'w') as f:\n"
+            "        # ndslint: waive[NDS109] -- unique path per write\n"
+            "        json.dump(doc, f)\n",
+            enabled={"NDS109"})
+        assert res.violations == [] and len(res.waived) == 1
+
+    def test_in_default_rules(self):
+        assert "NDS109" in {r.id for r in lint_rules.default_rules()}

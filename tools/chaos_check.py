@@ -12,6 +12,21 @@ round-trip. The schedule is seeded, so every CI run replays the exact
 same failure sequence; a regression in classification, retry
 accounting, or journaling fails here before any differential tier
 spins up a device.
+
+Two watchdog/integrity scenarios ride on the same generated data:
+
+- **hang** — a 4-stream SUPERVISED subprocess throughput round with a
+  ``stream.query:hang`` injected into one stream: the child watchdog
+  must catch the stall within 2x ``stall_s`` (exit ``EXIT_STALLED``,
+  stall report dumped), the supervisor must restart the stream ONCE
+  from its last completed query, and the round must complete with the
+  stall + restart recorded in ``throughput_summary.json``.
+
+- **corrupt** — an ``io.read:corrupt`` byte-flip in one raw chunk with
+  digest verification on: the warehouse load must fail FAST with
+  ``CorruptArtifact`` naming the file and both digests, zero retries,
+  and a Failed ``load_warehouse`` BenchReport on disk. Runs LAST — the
+  flip really mutates the shared raw data.
 """
 
 from __future__ import annotations
@@ -141,10 +156,164 @@ def run_journal_check(workdir: str) -> int:
     return 0
 
 
+def run_watchdog_stream(workdir: str) -> int:
+    """Supervised 4-stream throughput round with one hung stream: the
+    watchdog catches it, the supervisor restarts it once, the round
+    completes degraded — never wedged."""
+    from nds_tpu.nds import streams
+    from nds_tpu.nds.throughput import run_streams
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.resilience import faults
+    from nds_tpu.resilience.watchdog import EXIT_STALLED
+
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "tstreams")
+    out = os.path.join(workdir, "tp")
+    streams.generate_query_streams(sdir, 4, templates=[96, 7])
+    paths = [os.path.join(sdir, f"query_{i}.sql") for i in range(4)]
+    # generous budget: 4 concurrent children on a loaded CI box can see
+    # multi-second gaps between legitimate beats; the injected hang is
+    # 120 s, so detection headroom costs nothing
+    stall_s = 10.0
+    before = obs_metrics.snapshot()
+    saved = os.environ.get(faults.FAULTS_ENV)
+    # the schedule reaches the CHILDREN via the environment; the scope
+    # matches stream query_1's NDS_TPU_STREAM context — and only its
+    # first incarnation (the restart renames itself query_1#r1)
+    os.environ[faults.FAULTS_ENV] = "stream.query:hang=120@query_1"
+    try:
+        _elapse, codes = run_streams(
+            raw, paths, out, backend="cpu", input_format="raw",
+            stall_s=stall_s)
+    finally:
+        if saved is None:
+            os.environ.pop(faults.FAULTS_ENV, None)
+        else:
+            os.environ[faults.FAULTS_ENV] = saved
+        faults.clear()
+
+    if any(codes):
+        return _fail(f"supervised round should complete: codes={codes}")
+    with open(os.path.join(out, "throughput_summary.json")) as f:
+        summary = json.load(f)
+    s1 = summary["streams"].get("query_1")
+    if not s1:
+        return _fail(f"query_1 missing from summary: {summary}")
+    if s1["exit_codes"][0] != EXIT_STALLED:
+        return _fail(f"child watchdog should have caught the hang "
+                     f"(exit {EXIT_STALLED}): {s1['exit_codes']}")
+    if s1["restarts"] != 1 or not s1["degraded"]:
+        return _fail(f"query_1 should restart ONCE and be marked "
+                     f"degraded: {s1}")
+    if not s1["stalls"]:
+        return _fail(f"stall record missing from summary: {s1}")
+    if s1["stalls"][0].get("age_s", 1e9) > 2 * stall_s:
+        return _fail(f"stall detected too late (> 2x stall_s): "
+                     f"{s1['stalls']}")
+    for name, s in summary["streams"].items():
+        if name != "query_1" and s["restarts"]:
+            return _fail(f"healthy stream {name} restarted: {s}")
+        if s["completed"] != 2:
+            return _fail(f"{name} should complete 2 queries: {s}")
+    # the hung child's watchdog dumped an all-thread stall report
+    # (streams permute query order, so find it by content: only the
+    # in-process watchdog can capture thread stacks)
+    reports = [f for f in os.listdir(out) if f.startswith("stall-")]
+    child_dump = None
+    for f in reports:
+        with open(os.path.join(out, f)) as fh:
+            doc = json.load(fh)
+        if "threads" in doc:
+            child_dump = doc
+            break
+    if child_dump is None:
+        return _fail(f"no child stall report with thread stacks "
+                     f"in {reports}")
+    for key in ("unit", "query", "phase", "age_s", "stall_s",
+                "threads", "metrics"):
+        if key not in child_dump:
+            return _fail(f"stall report missing {key!r}: "
+                         f"{sorted(child_dump)}")
+    if (child_dump["unit"] != "query_1"
+            or not child_dump["threads"]):
+        return _fail(f"stall report should blame stream query_1 with "
+                     f"non-empty stacks: unit={child_dump['unit']}")
+    delta = obs_metrics.delta(before, obs_metrics.snapshot())
+    counters = delta.get("counters", {})
+    if counters.get("stream_restarts_total") != 1:
+        return _fail(f"stream_restarts_total delta: {counters}")
+    print("OK: watchdog stream (hang caught by child watchdog, "
+          "killed, restarted once, round completed degraded)")
+    return 0
+
+
+def run_corrupt_load(workdir: str) -> int:
+    """Byte-flip one raw chunk under digest verification: the load
+    fails fast with CorruptArtifact, zero retries, reported."""
+    from nds_tpu.io import integrity
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.resilience import faults
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "streams")
+    jsons = os.path.join(workdir, "json_corrupt")
+    tlog = os.path.join(workdir, "time_corrupt.csv")
+    table = "catalog_page"
+    integrity.write_manifest(os.path.join(raw, table))
+    integrity.set_verify(True)
+    cfg = EngineConfig(overrides={"engine.backend": "cpu"})
+    before = obs_metrics.snapshot()
+    faults.install(f"io.read:corrupt@{table}", seed=7)
+    err = None
+    try:
+        power_core.run_query_stream(
+            SUITE, raw, os.path.join(sdir, "query_0.sql"), tlog,
+            config=cfg, input_format="raw",
+            json_summary_folder=jsons)
+    except integrity.CorruptArtifact as exc:
+        err = exc
+    finally:
+        faults.clear()
+        integrity.set_verify(None)
+    if err is None:
+        return _fail("corrupt chunk should fail the load with "
+                     "CorruptArtifact")
+    msg = str(err)
+    if table not in msg or "sha256 expected" not in msg:
+        return _fail(f"CorruptArtifact should name the file and "
+                     f"digests: {msg}")
+    delta = obs_metrics.delta(before, obs_metrics.snapshot())
+    counters = delta.get("counters", {})
+    if counters.get("query_retries_total"):
+        return _fail(f"corruption must NEVER be retried: {counters}")
+    if counters.get("corrupt_artifacts_total") != 1:
+        return _fail(f"corrupt_artifacts_total delta: {counters}")
+    loads = [f for f in os.listdir(jsons) if "load_warehouse" in f]
+    if not loads:
+        return _fail(f"no load_warehouse BenchReport in {jsons}")
+    with open(os.path.join(jsons, loads[0])) as f:
+        rep = json.load(f)
+    if rep["queryStatus"] != ["Failed"] or rep.get("retries") != 0:
+        return _fail(f"load report should be Failed with retries=0: "
+                     f"{rep['queryStatus']} retries={rep.get('retries')}")
+    if not any("corrupt artifact" in e for e in rep["exceptions"]):
+        return _fail(f"load report lost the corruption text: "
+                     f"{rep['exceptions']}")
+    print("OK: corrupt chunk (load failed fast with CorruptArtifact, "
+          "0 retries, reported)")
+    return 0
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory(prefix="nds_chaos_") as workdir:
         rc = run_chaos_stream(workdir)
         rc |= run_journal_check(workdir)
+        rc |= run_watchdog_stream(workdir)
+        # LAST: really mutates the shared raw data
+        rc |= run_corrupt_load(workdir)
     return rc
 
 

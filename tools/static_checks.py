@@ -17,7 +17,13 @@ the one to run locally before pushing:
   5. chaos              3-query NDS power stream on CPU under a fixed
                         fault schedule: one transient injection must
                         retry and complete, one deterministic must
-                        fail fast; plus the resume-journal round-trip
+                        fail fast; plus the resume-journal round-trip,
+                        a SUPERVISED 4-stream throughput round with an
+                        injected hang (watchdog catches it within 2x
+                        stall_s, stream restarts once, round completes
+                        degraded), and an injected io.read byte-flip
+                        (digest verification fails the load fast with
+                        CorruptArtifact, zero retries)
                         (tools/chaos_check.py)
   6. ndsreport          run-analysis self-check over the committed
                         fixture run-dirs (tests/fixtures/run_a|b):
