@@ -44,6 +44,18 @@ fails CI instead of waiting for a human audit:
                             rename), or waive with why a torn read is
                             impossible for that artifact.
 
+- NDS110 direct-executor    constructing a placement executor
+                            (``DeviceExecutor(`` / ``ChunkedExecutor(``
+                            / ``DistributedExecutor(`` /
+                            ``CpuExecutor(``) in engine/suite code
+                            outside ``engine/scheduler.py`` or the
+                            executor's own defining module: placement
+                            is a scheduling decision owned by the
+                            unified pipeline, and a stray direct
+                            construction silently regresses the
+                            unification (no shared retry/ladder/
+                            consensus wiring runs for it).
+
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
@@ -520,11 +532,58 @@ class NonAtomicJsonWriteRule(Rule):
         return out
 
 
+class DirectExecutorRule(Rule):
+    """NDS110: direct placement-executor construction outside the
+    scheduler. The unified pipeline (engine/scheduler.py) is the one
+    place executors are built — it wires the cost model, the
+    degradation ladder, retries, and SPMD consensus around them. A
+    direct ``DeviceExecutor(...)`` call elsewhere in nds_tpu/ runs none
+    of that and silently regresses the unification. Each executor's own
+    defining module is exempt (its ``make_*_factory`` helpers and
+    subclass internals construct legitimately); tests and tools are out
+    of scope by path."""
+
+    id = "NDS110"
+    name = "direct-executor"
+    paths = ("nds_tpu/",)
+
+    EXECUTORS = {
+        "CpuExecutor": "cpu_exec",
+        "DeviceExecutor": "device_exec",
+        "ChunkedExecutor": "chunked_exec",
+        "DistributedExecutor": "dist_exec",
+    }
+    ALLOWED = ("engine/scheduler.py",)
+
+    def check(self, tree, src, path):
+        norm = path.replace("\\", "/")
+        if any(a in norm for a in self.ALLOWED):
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else None)
+            home = self.EXECUTORS.get(name or "")
+            if home is None or norm.endswith(f"{home}.py"):
+                continue
+            out.append(LintViolation(
+                self.id, path, node.lineno,
+                f"direct {name} construction outside "
+                f"engine/scheduler.py — placement is a scheduling "
+                f"decision; route through the ExecutionPipeline (or "
+                f"waive with why this site must bypass it)"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
-            NonAtomicJsonWriteRule()]
+            NonAtomicJsonWriteRule(), DirectExecutorRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -523,3 +523,92 @@ def check_exchange_invariants(n_rows: int, n_dev: int,
             f"bucket {bucket} x {n_dev} devices = {bucket * n_dev} "
             f"slots < {n_rows} rows"))
     return out
+
+
+# ------------------------------------------------------- size estimates
+
+def _dtype_width(dt: DType) -> int:
+    """Estimated bytes per value as the engine materializes it on
+    device: ints by declared width, decimals as scaled int64, dates as
+    epoch-day int32, strings as int32 dictionary codes (the dictionary
+    itself stays on host and is small next to the column)."""
+    if isinstance(dt, IntType):
+        return dt.bits // 8
+    if isinstance(dt, FloatType):
+        return dt.bits // 8
+    if isinstance(dt, DecimalType):
+        return 8
+    if isinstance(dt, DateType):
+        return 4
+    if isinstance(dt, StringType):
+        return 4
+    return 8
+
+
+@dataclass
+class PlanEstimate:
+    """Static size estimate for one planned statement — the cost-model
+    input the scheduler (engine/scheduler.py) seeds placement from.
+    ``tables`` maps each scanned base table to its (rows, bytes)
+    estimate; bytes count only the columns the plan's scans actually
+    read, at device materialization widths. Estimates come from real
+    HostTables when an executor registry is supplied, else from the
+    planner catalog's relative size statistics — both paths need no
+    accelerator (tools/ndsverify.py assigns placements on bare CPU)."""
+    rows: int = 0
+    bytes: int = 0
+    widest_table_bytes: int = 0
+    tables: dict = None  # type: ignore[assignment]
+    joins: int = 0
+    aggregates: int = 0
+    sorts: int = 0
+    windows: int = 0
+
+
+def estimate_plan(planned: P.PlannedQuery, tables: "dict | None" = None,
+                  catalog=None) -> PlanEstimate:
+    """Scan-level size estimate over every root (scalar subplans
+    included). Row counts prefer the executor's registered HostTables
+    (exact); the catalog's ``sizes`` statistics (relative row weights)
+    are the planning-time fallback. Unknown tables estimate as 0 rows —
+    the scheduler treats an all-unknown plan as small, which is the
+    conservative direction for placement (the ladder recovers from an
+    underestimate; overestimating would pin small queries off-device)."""
+    est = PlanEstimate(tables={})
+    if not isinstance(planned, P.PlannedQuery):
+        return est
+    seen: set = set()
+    for root in [planned.root, *planned.scalar_subplans]:
+        if not isinstance(root, P.Node):
+            continue
+        for node in P.walk_plan(root):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, P.Join):
+                est.joins += 1
+            elif isinstance(node, P.Aggregate):
+                est.aggregates += 1
+            elif isinstance(node, P.Sort):
+                est.sorts += 1
+            elif isinstance(node, P.Window):
+                est.windows += 1
+            if not isinstance(node, P.Scan):
+                continue
+            nrows = 0
+            if tables is not None and node.table in tables:
+                nrows = tables[node.table].nrows
+            elif catalog is not None:
+                nrows = int(catalog.sizes.get(node.table, 0))
+            width = sum(_dtype_width(dt) for _n, dt in node.output)
+            nbytes = nrows * width
+            rows0, bytes0 = est.tables.get(node.table, (0, 0))
+            # one table scanned by several Scan nodes: rows count once,
+            # bytes accumulate per scan (each scan uploads its columns)
+            est.tables[node.table] = (max(rows0, nrows),
+                                      bytes0 + nbytes)
+    for nrows, nbytes in est.tables.values():
+        est.rows += nrows
+        est.bytes += nbytes
+        est.widest_table_bytes = max(est.widest_table_bytes, nbytes)
+    return est

@@ -46,7 +46,7 @@ from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience import watchdog
 from nds_tpu.resilience.retry import (
-    QueryDeadlineExceeded, RetryPolicy, check_deadline, is_oom,
+    QueryDeadlineExceeded, check_deadline, is_oom,
 )
 from nds_tpu.sql import ir
 from nds_tpu.sql import plan as P
@@ -243,9 +243,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
         # graceful degradation: an OOM-classified failure halves the
         # chunk size and rebuilds phase A before giving up — the
         # out-of-core engine's whole premise is that residency, not
-        # total size, is the limit (shared resilience policy; no sleep,
-        # each retry already pays a full re-scan)
-        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        # total size, is the limit (no-sleep policy from the pipeline
+        # module, the one place engine retry wiring is instantiated)
+        from nds_tpu.engine.scheduler import adaptive_policy
+        policy = adaptive_policy(3)
         last_attempt = policy.max_attempts - 1
         for attempt in policy.attempts():
             try:
@@ -485,6 +486,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
         # narrowing — chunk-0-local bounds would silently corrupt later
         # chunks (clustered layouts make this the common case, not the
         # edge case)
+        # ndslint: waive[NDS110] -- bounds-probe helper over one host table (col_bounds/col_is_sorted only); no plan ever executes on it
         bx = dx.DeviceExecutor({table: big})
         full_bounds = {(table, name): bx.col_bounds(table, name)
                        for name in big.columns}
@@ -540,8 +542,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     # overflow-retry on the shared policy
                     # (slack-doubling shape, no backoff sleep — same
                     # as dist_exec)
-                    overflow_policy = RetryPolicy(max_attempts=4,
-                                                  base_delay_s=0.0)
+                    from nds_tpu.engine.scheduler import adaptive_policy
+                    overflow_policy = adaptive_policy(4)
                     for attempt in overflow_policy.attempts():
                         row, outs, overflow = compiled(bufs)
                         row_h, outs_h, over_h = jax.device_get(

@@ -172,7 +172,16 @@ def write_parquet(table: HostTable, path: str, compression: str = "snappy",
 def read_parquet(paths: list[str] | str, name: str, schema: Schema) -> HostTable:
     if isinstance(paths, str):
         paths = [paths]
-    tables = [pq.read_table(p) for p in paths]
+    # ParquetFile, not pq.read_table: read_table wraps single files in a
+    # dataset and INFERS hive partitioning from `col=value` path
+    # segments (pyarrow >= 13). The transcode layout nests files under
+    # `<table>/<part_col>=<band>/part-N.parquet` WITH the partition
+    # column physically present in every file, so the inferred
+    # dictionary<int32> partition field collides with the physical
+    # int32 column and the schema merge fails (ArrowTypeError). Reading
+    # the file directly skips path inference entirely — partition
+    # columns come from the file bytes, which the writer guarantees.
+    tables = [pq.ParquetFile(p).read() for p in paths]
     return from_arrow(name, schema, pa.concat_tables(tables, promote_options="permissive"))
 
 

@@ -40,6 +40,13 @@ def main(argv=None) -> None:
                    default=None,
                    help="overrides engine.backend from template/property "
                         "files (default tpu)")
+    p.add_argument("--placement",
+                   choices=["device", "sharded", "chunked", "cpu"],
+                   default=None,
+                   help="pin the initial placement for every query "
+                        "(engine.placement.force); default: the "
+                        "scheduler's cost model picks per query "
+                        "(README 'Placement & degradation')")
     p.add_argument("--input_format",
                    choices=["parquet", "orc", "json", "avro", "raw"],
                    default="parquet")
@@ -66,6 +73,8 @@ def main(argv=None) -> None:
     config = power_core.config_from_args(args)
     if args.floats:
         config.conf["engine.floats"] = "true"
+    if args.placement:
+        config.conf["engine.placement.force"] = args.placement
     failures = power_core.run_query_stream(
         SUITE, args.data_dir, args.query_stream, args.time_log,
         config=config, input_format=args.input_format,

@@ -153,6 +153,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
     config = EngineConfig(overrides={"engine.backend": backend})
     policy = RetryPolicy.from_config(config)
     session = power_core.make_session(SUITE, config)
+    pipeline = session._executor_factory(session.tables)
     power_core.load_warehouse(
         SUITE, session, data_dir, input_format,
         schemas=power_core.suite_schemas(SUITE, config))
@@ -171,6 +172,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
             "exceptions": [],
             "qtimes": [],
             "retries": 0,
+            "reschedules": 0,
         })
     # flatten round-robin, then run with `engine.concurrent_tasks`
     # queries in flight: dispatch is async on the device engine
@@ -189,30 +191,28 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         s, qname, sql, t0, handle, err = inflight.pop(0)
         if err is None:
             try:
-                handle.result()
-            except Exception as exc:  # noqa: BLE001
-                err = exc
-        if (err is not None and classify(err) == TRANSIENT
-                and policy.max_attempts > 1):
-            # transient failure (device OOM, injected chaos): re-run
-            # synchronously under the shared policy — the stream keeps
-            # its pipelining for the healthy queries and pays the
-            # backoff only on the sick one. The failed async dispatch
-            # already SPENT attempt 1, so the rerun policy gets the
-            # remaining budget, keeping the per-query attempt cap
-            # identical to the power path's
-            st = RetryStats()
-            from nds_tpu.obs import metrics as obs_metrics
-            obs_metrics.counter("query_retries_total").inc()
-            s["retries"] += 1
-            rerun = policy.with_attempts(policy.max_attempts - 1)
-            try:
+                # retry + the degradation ladder run INSIDE the
+                # pipeline (engine/scheduler.py): a transient failure
+                # surfaces here at result() and reruns down the ladder
+                # on this blocked call, so the stream keeps its
+                # pipelining for the healthy queries and pays the
+                # recovery only on the sick one
                 with faults.context(query=qname, stream=s["name"]):
-                    rerun.call(session.sql, sql, stats=st)
-                err = None
+                    handle.result()
             except Exception as exc:  # noqa: BLE001
                 err = exc
-            s["retries"] += st.retries
+        # per-query recovery accounting comes from the pipeline's
+        # handle-local stats (re-pointed at result() even under
+        # interleaved dispatch); a dispatch-time failure (handle None:
+        # parse/plan or a deterministic classify) never dispatched, so
+        # it has nothing to read
+        if handle is not None:
+            st = getattr(pipeline, "last_stats", None)
+            sched = getattr(pipeline, "last_schedule", None) or {}
+            if st is not None:
+                s["retries"] += st.retries
+            if sched.get("reschedules"):
+                s["reschedules"] += sched["reschedules"]
         if err is not None:
             import traceback
             traceback.print_exception(type(err), err, err.__traceback__)
@@ -244,11 +244,39 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         t0 = time.time()
         handle, err = None, None
         try:
+            # the stream.query chaos site fires inside the pipeline's
+            # per-attempt dispatch (engine/scheduler.py), under this
+            # query/stream context
             with faults.context(query=qname, stream=s["name"]):
-                faults.fault_point("stream.query")
                 handle = session.sql_async(sql)
         except Exception as exc:  # noqa: BLE001
             err = exc
+            if classify(exc) == TRANSIENT and policy.max_attempts > 1:
+                # a dispatch-time transient never reached the pipeline
+                # (parse/plan window): re-run synchronously under the
+                # remaining budget, same contract as the power path's
+                # front-door retry
+                st = RetryStats()
+                from nds_tpu.obs import metrics as obs_metrics
+                obs_metrics.counter("query_retries_total").inc()
+                s["retries"] += 1
+                rerun = policy.with_attempts(policy.max_attempts - 1)
+                try:
+                    with faults.context(query=qname, stream=s["name"]):
+                        rerun.call(session.sql, sql, stats=st)
+                    err = None
+                except Exception as exc2:  # noqa: BLE001
+                    err = exc2
+                s["retries"] += st.retries
+                # the rerun went through the pipeline: its internal
+                # retries/reschedules belong to this query too (the
+                # handle-None guard in _finish_one will skip them)
+                st2 = getattr(pipeline, "last_stats", None)
+                sched2 = getattr(pipeline, "last_schedule", None) or {}
+                if st2 is not None:
+                    s["retries"] += st2.retries
+                if sched2.get("reschedules"):
+                    s["reschedules"] += sched2["reschedules"]
         inflight.append((s, qname, sql, t0, handle, err))
         while len(inflight) >= depth:
             _finish_one()
@@ -272,6 +300,8 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         rep.summary["exceptions"] = s["exceptions"]
         rep.summary["queryTimes"] = s["qtimes"]
         rep.summary["retries"] = s["retries"]
+        if s["reschedules"]:
+            rep.summary["reschedules"] = s["reschedules"]
         rep.write_summary(prefix="throughput", out_dir=out_dir)
     elapse = math.ceil((time.time() - start) * 10) / 10.0
     return elapse, [s["failures"] for s in streams]

@@ -121,6 +121,15 @@ def attribute_query(summary: dict) -> dict:
     mem = summary.get("memory")
     if isinstance(mem, dict) and "device_hwm_bytes" in mem:
         row["hwm_bytes"] = int(mem["device_hwm_bytes"])
+    # scheduling decisions (engine/scheduler.py): which placement
+    # served the query and how far the degradation ladder walked
+    if "placement" in summary:
+        row["placement"] = str(summary["placement"])
+        row["reschedules"] = int(summary.get("reschedules", 0))
+        if summary.get("ladder"):
+            row["ladder"] = list(summary["ladder"])
+        if summary.get("promoted_back"):
+            row["promoted_back"] = True
     return row
 
 
@@ -258,17 +267,25 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         order = {q: i for i, q in enumerate(analysis["slowest"])}
         rows = sorted(rows, key=lambda r: order[r["query"]])[:top]
     w = max([len(r["query"]) for r in rows] + [5])
+    has_placement = any("placement" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
-        f"{short.get(c, c):>9}" for c in cols) + "  status")
+        f"{short.get(c, c):>9}" for c in cols)
+        + ("  placement" if has_placement else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
         vals = [r["categories"][c] for c in CATEGORIES]
         vals += [r["residual_ms"], r["wall_ms"]]
+        place = ""
+        if has_placement:
+            p = r.get("placement", "?")
+            if r.get("reschedules"):
+                p += f"(+{r['reschedules']})"
+            place = f"  {p:>9}"
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + f"  {r['status']}")
+            + place + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -543,14 +560,25 @@ def render_html(analysis: dict, diff: dict | None = None,
         "<h2>Per-query time attribution</h2>", _legend(),
         "<table><tr><th class='q'>query</th><th>wall ms</th>"
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
-        "<th>retries</th><th>mem HWM</th><th>status</th></tr>",
+        "<th>retries</th><th>placement</th><th>mem HWM</th>"
+        "<th>status</th></tr>",
     ]
     for row in analysis["queries"]:
+        place = row.get("placement", "")
+        if row.get("ladder"):
+            # the walked ladder is the interesting story: show the
+            # whole path, not only where the query landed
+            place = "&rarr;".join(_esc(r) for r in row["ladder"])
+        elif place:
+            place = _esc(place)
+        if row.get("promoted_back"):
+            place += " &uarr;"
         out.append(
             f"<tr><td class='q'>{_esc(row['query'])}</td>"
             f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
             f"<td>{row['residual_ms']:.1f}</td>"
             f"<td>{row['compiles']}</td><td>{row['retries']}</td>"
+            f"<td>{place}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")
     out.append("</table>")

@@ -24,8 +24,11 @@ Metric names in use across the stack (documented in README
 - ``task_failures_total`` — TaskFailureCollector bridge
   (utils/report.py)
 - ``faults_injected_total`` / ``query_retries_total`` /
-  ``query_deadline_exceeded_total`` / ``engine_fallbacks_total`` —
-  resilience layer (nds_tpu/resilience/)
+  ``query_deadline_exceeded_total`` — resilience layer
+  (nds_tpu/resilience/)
+- ``query_reschedules_total`` / ``placement_consensus_total`` /
+  ``placement_demotions_total`` / ``placement_promotions_total`` —
+  unified execution pipeline (engine/scheduler.py)
 
 Per-query deltas (``delta(before, after)``) land in each BenchReport
 JSON under ``metrics``.

@@ -43,7 +43,6 @@ from nds_tpu.parallel.mesh import (
     DATA_AXIS, HOST_AXIS, make_mesh, pad_to_multiple,
 )
 from nds_tpu.resilience import faults
-from nds_tpu.resilience.retry import RetryPolicy
 from nds_tpu.sql import plan as P
 from nds_tpu.utils.report import TaskFailureCollector
 
@@ -123,6 +122,19 @@ class DistributedExecutor(dx.DeviceExecutor):
         if self._explicit_shard is not None:
             return table in self._explicit_shard
         return self.tables[table].nrows >= self.shard_threshold
+
+    def grow_slack(self) -> None:
+        """Scheduler ladder hook (engine/scheduler.py): an exchange
+        overflow that persisted through the in-execute slack-doubling
+        retries re-plans at a doubled BASE slack — every compiled
+        program is invalidated (their exchange capacities baked in the
+        old slack), and the next execute recompiles from the new
+        floor. Collective-safe: the scheduler only calls this after a
+        consensus round, so every rank re-plans together."""
+        self.slack *= 2
+        for key in list(self._compiled):
+            self._evict_query_state(key)
+        obs_metrics.counter("slack_replans_total").inc()
 
     def _dev(self, arr: np.ndarray, sharded: bool):
         """Host array -> device buffer. Single-process: plain upload
@@ -304,9 +316,10 @@ class DistributedExecutor(dx.DeviceExecutor):
         slack = state.get("slack", self.slack)
         # the ad-hoc `for attempt in range(3)` slack loop, generalized
         # onto the shared resilience policy (no backoff sleep: each
-        # retry already pays a full recompile)
-        for attempt in RetryPolicy(max_attempts=3,
-                                   base_delay_s=0.0).attempts():
+        # retry already pays a full recompile; policy built by the
+        # pipeline module — the single home of engine retry wiring)
+        from nds_tpu.engine.scheduler import adaptive_policy
+        for attempt in adaptive_policy(3).attempts():
             if "jitted" not in state or state.get("slack") != slack:
                 # free the previous slack's executable BEFORE compiling
                 # the bigger one: the 8-way compiled forms of wide

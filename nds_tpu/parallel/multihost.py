@@ -81,6 +81,26 @@ def global_mesh(shards: int | None = None):
     return make_mesh(shards if shards and shards > 1 else None)
 
 
+def gather_votes(vote: int) -> "list[int] | None":
+    """Allgather one small int from every process (DCN) — the
+    transport under the scheduler's placement-consensus step
+    (engine/scheduler.py): every rank calls this at the same decision
+    point, reads back all votes, and applies the same deterministic
+    rule, so placement switches are all-or-none across the SPMD world.
+    Returns None when the gather fails (a dead coordinator / lagging
+    rank) — the caller keeps its placement rather than diverging."""
+    import jax
+    if jax.process_count() == 1:
+        return [int(vote)]
+    try:
+        from jax.experimental import multihost_utils
+        votes = multihost_utils.process_allgather(
+            np.asarray([vote], dtype=np.int32))
+        return [int(v) for v in np.asarray(votes).reshape(-1)]
+    except Exception:  # noqa: BLE001 - consensus must degrade, not hang
+        return None
+
+
 def make_global_array(mesh, spec, full_value: np.ndarray):
     """Build a global jax.Array laid out per (mesh, spec) from host data.
 

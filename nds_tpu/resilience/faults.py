@@ -304,6 +304,14 @@ def context(**kv):
         _ctx.d = prev
 
 
+def current_context() -> dict:
+    """Read-only copy of the active thread-local context (the query /
+    stream names the loops publish via ``context()``). The scheduler
+    keys its memory-HWM history and reschedule records on the query
+    name without threading it through every executor signature."""
+    return dict(getattr(_ctx, "d", {}))
+
+
 @contextmanager
 def suppress():
     """Disable firing inside the block (warmup passes must not consume

@@ -14,17 +14,17 @@ module is the single place that distinction lives:
   Everything else — parse/plan/verify errors included — is
   deterministic and never retried.
 - ``RetryPolicy`` — attempt cap, exponential backoff with seeded
-  deterministic jitter, and a per-query wall-clock deadline. Wrapped
-  around the query body in ``utils/power_core.py`` and the stream
-  workers in ``nds/throughput.py``; the executors' slack-doubling
-  loops (``parallel/dist_exec.py``, ``engine/chunked_exec.py``) share
-  the same policy via ``attempts()``.
+  deterministic jitter, and a per-query wall-clock deadline. Owned by
+  the unified execution pipeline (``engine/scheduler.py``), which runs
+  every query's retry + degradation-ladder walk; the executors'
+  slack-doubling loops (``parallel/dist_exec.py``,
+  ``engine/chunked_exec.py``) borrow no-sleep policies from
+  ``scheduler.adaptive_policy`` and share ``attempts()``.
 
 Config keys (README "Resilience"): ``engine.retry.max_attempts``,
 ``engine.retry.base_delay_s``, ``engine.retry.max_delay_s``,
-``engine.retry.jitter``, ``engine.query_deadline_s``,
-``engine.fallback``. Metrics: ``query_retries_total``,
-``query_deadline_exceeded_total``.
+``engine.retry.jitter``, ``engine.query_deadline_s``. Metrics:
+``query_retries_total``, ``query_deadline_exceeded_total``.
 """
 
 from __future__ import annotations

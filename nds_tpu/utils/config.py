@@ -33,9 +33,25 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             chaos runs replay exactly)
   engine.query_deadline_s   per-query wall-clock deadline (0/unset =
                             none); overruns are flagged and counted
-  engine.fallback           "cpu" -> after repeated transient device
-                            failures the remaining stream runs on the
-                            CPU executor instead of aborting
+  engine.placement.force    pin the initial placement (device/sharded/
+                            chunked/cpu); the power drivers'
+                            --placement flag sets this
+  engine.placement.ladder   on (default) / off: reschedule classified
+                            transient failures down the degradation
+                            ladder (engine/scheduler.py)
+  engine.placement.floor    deepest ladder rung (default cpu)
+  engine.placement.demote_after / engine.placement.promote_after
+                            sticky stream-demotion shape: consecutive
+                            ladder-walked queries before the starting
+                            rung demotes / clean queries before it
+                            promotes back
+  engine.placement.device_budget_bytes
+                            cost-model working-set budget for the
+                            device placement (default 8 GiB)
+  engine.fallback           legacy alias: "cpu" forces
+                            engine.placement.floor=cpu (the one-shot
+                            stream demotion it used to trigger is now
+                            the ladder + sticky demotion)
 """
 
 from __future__ import annotations

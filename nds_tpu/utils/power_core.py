@@ -17,16 +17,20 @@ included — is attached to the JSON summary (``spans``) together with
 the per-query metrics delta (``metrics``); ``NDS_TPU_TRACE=path``
 additionally appends every tree to a Chrome trace-event JSONL.
 
-Resilience: the query body runs under ``resilience.retry.RetryPolicy``
-(``engine.retry.*`` / ``engine.query_deadline_s`` config keys) —
-transient failures (device OOM, exchange overflow, injected chaos)
-retry with backoff, deterministic parse/plan errors fail fast; the
-per-query summary records ``retries`` / ``gave_up_reason`` /
-``deadline_exceeded``. ``engine.fallback=cpu`` demotes the remaining
-stream to the CPU oracle after repeated device failures. Fault
-injection context (``NDS_TPU_FAULTS``) carries the query name — and
-the stream name (``NDS_TPU_STREAM``) when a supervisor launched this
-process as one throughput stream.
+Resilience: every backend now runs through the unified execution
+pipeline (``nds_tpu/engine/scheduler.py``) — per query, a cost model
+picks the initial placement (single-device / sharded / out-of-core /
+CPU), classified transient failures walk a degradation ladder as a
+reschedule of that one query, and the pipeline owns the retry policy
+(``engine.retry.*`` / ``engine.query_deadline_s``). The per-query
+summary records ``retries`` / ``gave_up_reason`` /
+``deadline_exceeded`` plus the scheduling decisions: ``placement``,
+``reschedules``, ``promoted_back`` (README "Placement &
+degradation"). ``engine.fallback=cpu`` survives as an alias forcing
+the ladder floor to the CPU oracle. Fault injection context
+(``NDS_TPU_FAULTS``) carries the query name — and the stream name
+(``NDS_TPU_STREAM``) when a supervisor launched this process as one
+throughput stream.
 
 Hang detection (resilience/watchdog.py): the loop publishes heartbeats
 (query, phase, attempt) around every dispatch and retry; with
@@ -51,14 +55,81 @@ from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience import faults, watchdog
-from nds_tpu.resilience.retry import RetryPolicy, RetryStats
+from nds_tpu.resilience.retry import (
+    DETERMINISTIC, TRANSIENT, RetryPolicy, RetryStats, classify,
+)
 from nds_tpu.utils.config import EngineConfig
 from nds_tpu.utils.report import BenchReport
 from nds_tpu.utils.timelog import TimeLog
 
-# consecutive transiently-failed queries before the engine.fallback=cpu
-# demotion engages (one flaky query should not abandon the accelerator)
-FALLBACK_AFTER = 2
+
+def _front_door_retry(policy, pipeline, unit, qname, body):
+    """Retry TRANSIENT failures that never reached the pipeline
+    (parse/plan phase — the executor-phase retry + ladder live inside
+    engine/scheduler.py): a plan-site chaos injection or a flaky
+    catalog read retries with the same backoff policy, a deterministic
+    planner bug fails fast. Accounting merges into the pipeline's
+    per-query stats so the summary reports ONE recovery budget."""
+    from nds_tpu.obs import metrics as obs_metrics
+    attempts = 0
+    front_retries = 0
+    front_backoff = 0.0
+    start = time.monotonic()
+
+    def _merge(st):
+        if st is not None:
+            st.retries += front_retries
+            st.backoff_s += front_backoff
+
+    def _flag_deadline(st):
+        if st is not None and not st.deadline_exceeded:
+            st.deadline_exceeded = True
+            obs_metrics.counter("query_deadline_exceeded_total").inc()
+
+    # ndslint: waive[NDS108] -- capped (attempts >= policy.max_attempts raises) with policy.delay_for backoff; while-True only because the cap check needs the classified exception first
+    while True:
+        try:
+            out = body()
+        except Exception as exc:  # noqa: BLE001 - classified below
+            st = getattr(pipeline, "last_stats", None)
+            pre_dispatch = (st is not None and st.attempts == 0
+                            and not st.gave_up_reason)
+            if not pre_dispatch:
+                # the pipeline saw this query: its classification and
+                # ladder already ran — nothing to add but the bill
+                _merge(st)
+                raise
+            attempts += 1
+            st.errors.append(f"{type(exc).__name__}: {exc}")
+            if classify(exc) != TRANSIENT:
+                st.gave_up_reason = DETERMINISTIC
+                _merge(st)
+                raise
+            if attempts >= policy.max_attempts:
+                st.gave_up_reason = f"attempts_exhausted({attempts})"
+                _merge(st)
+                raise
+            d = policy.delay_for(front_retries)
+            if (policy.deadline_s is not None
+                    and time.monotonic() - start + d
+                    > policy.deadline_s):
+                # same pre-sleep deadline check policy.call enforces:
+                # the plan window must not back off past the query's
+                # wall-clock budget
+                st.gave_up_reason = "deadline"
+                _flag_deadline(st)
+                _merge(st)
+                raise
+            front_retries += 1
+            front_backoff += d
+            obs_metrics.counter("query_retries_total").inc()
+            watchdog.beat(unit, query=qname, phase="retry",
+                          attempt=front_retries)
+            if d > 0:
+                time.sleep(d)
+            continue
+        _merge(getattr(pipeline, "last_stats", None))
+        return out
 
 
 @dataclass
@@ -95,7 +166,11 @@ def suite_schemas(suite: Suite, config: EngineConfig) -> dict:
 def make_session(suite: Suite, config: EngineConfig) -> Session:
     """Session from an EngineConfig — the template/property-file layer
     actually driving engine choice (closes the reference's
-    spark-submit-template contract)."""
+    spark-submit-template contract). EVERY backend routes through the
+    unified execution pipeline (engine/scheduler.py): the backend picks
+    the placement *universe* (tpu -> device/chunked/cpu, distributed ->
+    sharded/chunked/cpu, cpu -> cpu), and the pipeline's cost model +
+    degradation ladder schedule each query within it."""
     backend = config.get("engine.backend", "cpu")
     kwargs = schema_kwargs_for(suite, config)
     if backend in ("tpu", "distributed"):
@@ -103,40 +178,10 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
         # bench.py uses); harmless for repeated in-process queries
         from nds_tpu.utils.xla_cache import enable as enable_xla_cache
         enable_xla_cache()
-    if backend == "tpu":
-        # engine.precision only applies in floats mode: decimal mode's
-        # scaled-int arithmetic must stay exact (the reference's
-        # variableFloatAgg knob is likewise float-mode-only)
-        precision = "f64"
-        if config.get_bool("engine.floats"):
-            precision = config.get("engine.precision", "f64")
-        stream_bytes = config.get_int("engine.stream_bytes", 0)
-        if stream_bytes > 0:
-            # out-of-core: oversized tables chunk-stream through HBM
-            from nds_tpu.engine.chunked_exec import make_chunked_factory
-            from nds_tpu.engine.chunked_exec import DEFAULT_CHUNK_ROWS
-            factory = make_chunked_factory(
-                stream_bytes,
-                config.get_int("engine.chunk_rows", DEFAULT_CHUNK_ROWS),
-                precision)
-        else:
-            from nds_tpu.engine.device_exec import make_device_factory
-            factory = make_device_factory(precision)
-    elif backend == "distributed":
-        from nds_tpu.parallel import multihost
-        from nds_tpu.parallel.dist_exec import make_distributed_factory
-        # env-driven multi-process launch (NDS_TPU_COORDINATOR et al.):
-        # every host runs this same driver; the mesh spans the global
-        # device world after jax.distributed.initialize
-        multihost.maybe_initialize()
-        shards = config.get_int("engine.mesh.shards", 0)
-        mesh = multihost.global_mesh(shards if shards > 1 else None)
-        factory = make_distributed_factory(mesh=mesh)
-    elif backend == "cpu":
-        factory = None
-    else:
+    elif backend != "cpu":
         raise ValueError(f"unknown engine.backend {backend!r}")
-    return suite.session_for(factory, **kwargs)
+    from nds_tpu.engine.scheduler import make_pipeline
+    return suite.session_for(make_pipeline(config, backend), **kwargs)
 
 
 def load_warehouse(suite: Suite, session: Session, data_dir: str,
@@ -195,21 +240,6 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
         session.register_table(table)
         timings[name] = time.perf_counter() - t0
     return timings
-
-
-def _fallback_safe(backend: str) -> bool:
-    """engine.fallback=cpu must never engage on a multi-process SPMD
-    run: the demotion is rank-local, and a demoted rank stops
-    participating in the compiled programs' cross-host collectives —
-    every OTHER rank would block forever inside the next all_to_all.
-    Single-process backends (single chip, virtual mesh) demote freely."""
-    if backend != "distributed":
-        return True
-    try:
-        import jax
-        return jax.process_count() == 1
-    except Exception:  # jax unavailable: nothing to demote from anyway
-        return True
 
 
 def run_one_query(session: Session, sql: str, qname: str = "",
@@ -303,18 +333,17 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     app_id = f"{suite.name}-tpu-{backend}-{int(time.time())}"
     tlog = TimeLog(app_id)
     total_start = time.perf_counter()
-    policy = RetryPolicy.from_config(config)
 
-    # the warehouse load runs under the SAME retry policy as queries —
-    # transient io hiccups retry, a CorruptArtifact (digest mismatch,
-    # io/integrity.py) is deterministic and fails the run FAST with a
-    # BenchReport naming the file and both digests, retries=0 — but
-    # NOT under the per-QUERY deadline (a 25-table load is not a query)
-    load_policy = RetryPolicy(
-        max_attempts=policy.max_attempts,
-        base_delay_s=policy.base_delay_s,
-        max_delay_s=policy.max_delay_s, jitter=policy.jitter,
-        deadline_s=None, seed=policy.seed)
+    # the warehouse load runs under the SAME retry policy shape as
+    # queries — transient io hiccups retry, a CorruptArtifact (digest
+    # mismatch, io/integrity.py) is deterministic and fails the run
+    # FAST with a BenchReport naming the file and both digests,
+    # retries=0 — but NOT under the per-QUERY deadline (a 25-table
+    # load is not a query). Built by the pipeline module, the single
+    # home of the engine retry wiring.
+    from nds_tpu.engine.scheduler import load_policy as _mk_load_policy
+    front_policy = RetryPolicy.from_config(config)
+    load_policy = _mk_load_policy(front_policy)
     watchdog.beat(unit, phase="load_warehouse")
     lstats = RetryStats()
     load_hold: dict = {}
@@ -361,8 +390,6 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         jax.profiler.start_trace(profile_dir)
         profiler_cm = True
     failures = 0
-    fallback = config.get("engine.fallback")
-    device_failure_streak = 0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
         watchdog.beat(unit, query=qname, phase="dispatch")
@@ -392,12 +419,15 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         report = BenchReport(qname, config.as_dict())
         out_pref = output_prefix if primary else None
         # a query that fails BEFORE reaching the executor (parse/plan
-        # errors) must not inherit the previous query's span/timings
-        # into its summary — the in-executor resets only cover queries
-        # that dispatch
+        # errors) must not inherit the previous query's
+        # span/timings/stats into its summary — the pipeline's
+        # reset covers exactly that window
         pre_ex = session._executor_factory(session.tables)
-        pre_ex.last_query_span = None
-        pre_ex.last_timings = {}
+        if hasattr(pre_ex, "reset_query"):
+            pre_ex.reset_query()
+        else:
+            pre_ex.last_query_span = None
+            pre_ex.last_timings = {}
         # per-query root span: brackets EXACTLY what queryTimes/TimeLog
         # brackets (fn inside report_on), so span totals and the CSV
         # agree; the engine's parse/plan/compile/execute spans nest
@@ -405,30 +435,22 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         tracer = get_tracer()
         qhold: dict = {}
         metrics_before = obs_metrics.snapshot()
-        rstats = RetryStats()
 
         def traced_query(session, sql, _q=qname, _o=out_pref,
-                         _h=qhold, _st=rstats):
-            # the retry loop nests INSIDE the query span: queryTimes /
-            # the TimeLog row bill the retries and backoff to the query
-            # that needed them, exactly like a Spark task retry bills
-            # its stage
-            def _body(session, sql):
-                # per-query dispatch chaos site (stream.query): fires
-                # per ATTEMPT inside the policy, so raising kinds are
-                # classified/retried and a `hang` stalls exactly like
-                # a stuck engine call would — between heartbeats
-                faults.fault_point("stream.query")
-                return run_one_query(session, sql, _q, _o)
-
+                         _h=qhold, _ex=pre_ex):
+            # retry + the degradation ladder both live INSIDE the
+            # pipeline now and nest inside the query span (queryTimes /
+            # the TimeLog row bill retries, backoff, and reschedules to
+            # the query that needed them, exactly like a Spark task
+            # retry bills its stage); _front_door_retry covers only the
+            # pre-dispatch (parse/plan) window the pipeline cannot see
             with tracer.span("query", query=_q, suite=suite.name,
                              backend=backend) as sp:
                 _h["span"] = sp
                 with faults.context(query=_q):
-                    return policy.call(
-                        _body, session, sql, stats=_st,
-                        on_retry=lambda exc, n: watchdog.beat(
-                            unit, query=_q, phase="retry", attempt=n))
+                    return _front_door_retry(
+                        front_policy, _ex, unit, _q,
+                        lambda: run_one_query(session, sql, _q, _o))
 
         # exports park during the bracket (even a ~ms inline write
         # would skew span totals vs the TimeLog row) and flush after
@@ -459,7 +481,12 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         qspan = qhold.get("span")
         if qspan:
             summary["spans"] = qspan.to_dict()
-        report.attach_retry(rstats)
+        # the pipeline owns retry + scheduling accounting; a bare
+        # executor factory (tests driving run_query_stream with a
+        # custom session) degrades to empty stats
+        report.attach_retry(getattr(pre_ex, "last_stats", None)
+                            or RetryStats())
+        report.attach_schedule(getattr(pre_ex, "last_schedule", None))
         report.attach_memory(memwatch.high_water())
         elapsed_ms = summary["queryTimes"][-1]
         obs_metrics.counter("queries_total").inc()
@@ -468,28 +495,6 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         if not report.is_success():
             failures += 1
             obs_metrics.counter("query_failures_total").inc()
-            # engine.fallback=cpu: repeated TRANSIENT-exhausted device
-            # failures (never deterministic planner bugs) demote the
-            # remaining stream to the CPU oracle — degraded numbers
-            # beat an abandoned run
-            if (rstats.gave_up_reason
-                    and rstats.gave_up_reason != "deterministic"):
-                device_failure_streak += 1
-                if (fallback == "cpu" and backend != "cpu"
-                        and device_failure_streak >= FALLBACK_AFTER
-                        and _fallback_safe(backend)):
-                    from nds_tpu.engine.cpu_exec import CpuExecutor
-                    session._executor_factory = (
-                        lambda tables: CpuExecutor(tables))
-                    obs_metrics.counter("engine_fallbacks_total").inc()
-                    fallback = None  # one-shot demotion
-                    print(f"ENGINE FALLBACK: {device_failure_streak} "
-                          f"consecutive device failures — remaining "
-                          f"queries run on the CPU executor")
-            else:
-                device_failure_streak = 0
-        else:
-            device_failure_streak = 0
         mdelta = obs_metrics.delta(metrics_before,
                                    obs_metrics.snapshot())
         if mdelta:

@@ -209,6 +209,23 @@ class BenchReport:
         if stats.deadline_exceeded:
             self.summary["deadline_exceeded"] = True
 
+    def attach_schedule(self, sched: dict | None) -> None:
+        """Record the pipeline's scheduling decision
+        (engine/scheduler.py): ``placement`` (the placement that served
+        the query) and ``reschedules`` always when the pipeline ran;
+        ``ladder`` (the rungs walked) only when the query was
+        rescheduled; ``promoted_back`` only on the query where a
+        stream promotion took effect (README "Placement &
+        degradation" schema)."""
+        if not sched or "placement" not in sched:
+            return
+        self.summary["placement"] = sched["placement"]
+        self.summary["reschedules"] = int(sched.get("reschedules", 0))
+        if sched.get("reschedules"):
+            self.summary["ladder"] = list(sched.get("ladder", []))
+        if sched.get("promoted_back"):
+            self.summary["promoted_back"] = True
+
     def attach_memory(self, hwm: dict | None) -> None:
         """Record the per-query device-memory high-water mark
         (obs/memwatch.py) as the ``memory`` block:

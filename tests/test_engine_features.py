@@ -366,22 +366,25 @@ def test_precision_rejects_unknown():
 
 
 def test_make_session_precision_only_in_floats_mode(tmp_path):
-    """Decimal mode must pin f64 regardless of engine.precision."""
+    """Decimal mode must pin f64 regardless of engine.precision — the
+    pipeline threads the precision into every device-side placement."""
     from nds_tpu.utils import power_core
     from nds_tpu.utils.config import EngineConfig
     from nds_tpu.nds.power import SUITE
     cfg = EngineConfig(overrides={"engine.backend": "tpu",
                                   "engine.precision": "f32"})
     sess = power_core.make_session(SUITE, cfg)
-    ex = sess._executor_factory({})
-    assert ex.float_dtype is None  # f64
+    pipe = sess._executor_factory({})
+    assert pipe._executor("device").float_dtype is None  # f64
     cfg2 = EngineConfig(overrides={"engine.backend": "tpu",
                                    "engine.floats": "true",
                                    "engine.precision": "f32"})
     sess2 = power_core.make_session(SUITE, cfg2)
-    ex2 = sess2._executor_factory({})
+    pipe2 = sess2._executor_factory({})
     import jax.numpy as jnp
-    assert ex2.float_dtype == jnp.float32
+    assert pipe2._executor("device").float_dtype == jnp.float32
+    # both device-side rungs share the precision
+    assert pipe2._executor("chunked").float_dtype == jnp.float32
 
 
 class TestChunkedExecution:
@@ -544,17 +547,30 @@ class TestChunkedExecution:
 
 
 def test_make_session_stream_bytes_selects_chunked():
-    """engine.stream_bytes > 0 routes the tpu backend through the
-    out-of-core executor."""
+    """engine.stream_bytes > 0: the cost model places any plan whose
+    widest scanned table exceeds the threshold on the out-of-core
+    executor — a per-query scheduling decision now, not a stream-wide
+    factory choice (engine/scheduler.py)."""
     from nds_tpu.engine.chunked_exec import ChunkedExecutor
     from nds_tpu.utils import power_core
     from nds_tpu.utils.config import EngineConfig
     from nds_tpu.nds.power import SUITE
+    from nds_tpu.datagen import tpcds
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds.schema import get_schemas
     cfg = EngineConfig(overrides={"engine.backend": "tpu",
                                   "engine.stream_bytes": "1024",
                                   "engine.chunk_rows": "128"})
     sess = power_core.make_session(SUITE, cfg)
-    ex = sess._executor_factory({})
+    pipe = sess._executor_factory(sess.tables)
+    assert pipe.stream_bytes == 1024 and pipe.chunk_rows == 128
+    schemas = get_schemas()
+    sess.register_table(from_arrays("date_dim", schemas["date_dim"],
+                                    tpcds.gen_table("date_dim", 0.01)))
+    sess.sql("select count(*) c from date_dim")
+    assert pipe.last_schedule["placement"] == "chunked"
+    assert "table-exceeds-stream-bytes" in pipe.last_schedule["reason"]
+    ex = pipe._executor("chunked")
     assert isinstance(ex, ChunkedExecutor)
     assert ex.stream_bytes == 1024 and ex.chunk_rows == 128
 

@@ -497,6 +497,37 @@ class TestPowerLoopResilience:
         assert sums["query7"]["retry_backoff_s"] > 0
         assert sums["query96"]["retries"] == 0
 
+    def test_transient_plan_fault_retried_to_completion(self, mini_wh,
+                                                        tmp_path):
+        """A TRANSIENT failure in the parse/plan window (before the
+        pipeline's executor dispatch) still retries under the config
+        policy — the power loop's front-door retry covers the window
+        the scheduler cannot see."""
+        faults.install("plan:fault*1@query96")
+        failures, sums = _run_stream(mini_wh, tmp_path)
+        assert failures == 0
+        assert sums["query96"]["queryStatus"] == ["Completed"]
+        assert sums["query96"]["retries"] == 1
+        assert sums["query96"]["retry_backoff_s"] > 0
+
+    def test_plan_window_retry_honors_deadline(self, mini_wh,
+                                               tmp_path):
+        """The front-door retry enforces engine.query_deadline_s like
+        the executor-phase policy: a backoff that would overrun the
+        budget gives up with gave_up_reason=deadline instead of
+        sleeping past it."""
+        faults.install("plan:fault*99@query96")
+        failures, sums = _run_stream(
+            mini_wh, tmp_path,
+            overrides={"engine.query_deadline_s": "0.05",
+                       "engine.retry.base_delay_s": "30"},
+            subset=["query96"])
+        assert failures == 1
+        s = sums["query96"]
+        assert s["queryStatus"] == ["Failed"]
+        assert s["gave_up_reason"] == "deadline"
+        assert s["deadline_exceeded"] is True
+
     def test_plan_fault_fails_fast(self, mini_wh, tmp_path):
         faults.install("plan:deterministic@query96")
         failures, sums = _run_stream(mini_wh, tmp_path)
@@ -533,12 +564,15 @@ class TestPowerLoopResilience:
         d = obs_metrics.delta(before, obs_metrics.snapshot())
         assert d["counters"]["query_deadline_exceeded_total"] >= 1
 
-    def test_fallback_to_cpu_after_repeated_device_failure(
+    def test_sticky_demotion_after_repeated_ladder_exhaustion(
             self, mini_wh, tmp_path):
-        # tpu backend on the virtual-CPU mesh: both early queries
-        # exhaust their attempts on injected OOM, the streak trips
-        # engine.fallback=cpu, and the LAST query completes on the
-        # CPU oracle
+        # tpu backend on the virtual-CPU mesh: the first two queries
+        # exhaust the WHOLE ladder on injected OOM (the query-scoped
+        # fault fires at every placement, floor included), the
+        # reschedule streak sticky-demotes the stream's STARTING rung
+        # to the floor, and the LAST query runs directly on the CPU
+        # oracle — the old one-shot engine.fallback=cpu contract,
+        # now expressed as a (reversible) scheduling decision
         faults.install("device.execute:oom*99@query96,"
                        "device.execute:oom*99@query7")
         before = obs_metrics.snapshot()
@@ -547,13 +581,20 @@ class TestPowerLoopResilience:
             overrides={"engine.backend": "tpu",
                        "engine.fallback": "cpu"})
         assert failures == 2
-        assert sums["query96"]["gave_up_reason"] == \
-            "attempts_exhausted(3)"
-        assert sums["query7"]["gave_up_reason"] == \
-            "attempts_exhausted(3)"
+        assert sums["query96"]["gave_up_reason"].startswith(
+            "attempts_exhausted")
+        assert sums["query7"]["gave_up_reason"].startswith(
+            "attempts_exhausted")
+        # the failed queries record their ladder walk
+        assert sums["query96"]["ladder"] == ["device", "chunked", "cpu"]
+        assert sums["query96"]["reschedules"] == 2
         assert sums["query93"]["queryStatus"] == ["Completed"]
+        # demoted start: query93 began at the floor, no ladder walk
+        assert sums["query93"]["placement"] == "cpu"
+        assert sums["query93"]["reschedules"] == 0
         d = obs_metrics.delta(before, obs_metrics.snapshot())
-        assert d["counters"]["engine_fallbacks_total"] == 1
+        assert d["counters"]["placement_demotions_total"] == 1
+        assert d["counters"]["query_reschedules_total"] >= 4
 
     def test_allow_failure_exit_code_contract(self, mini_wh, tmp_path,
                                               monkeypatch):

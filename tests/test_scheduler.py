@@ -1,0 +1,492 @@
+"""Unified execution pipeline tests (nds_tpu/engine/scheduler.py):
+cost-model placement, degradation-ladder ordering, sticky demotion +
+promotion-after-N-clean, and the consensus vote protocol — all on bare
+CPU with fake placement executors, no jax device work."""
+
+import pytest
+
+from nds_tpu.analysis import plan_verify
+from nds_tpu.engine import scheduler
+from nds_tpu.engine.scheduler import (
+    CHUNKED, CPU, DEVICE, SHARDED, Consensus, CostModel,
+    ExecutionPipeline, NullChannel,
+)
+from nds_tpu.engine.session import Session
+from nds_tpu.resilience import faults
+from nds_tpu.resilience.faults import InjectedOOM
+from nds_tpu.utils.config import EngineConfig
+
+
+def _plan(sql="select count(*) c from store_sales"):
+    sess = Session.for_nds()
+    return sess.plan(sql), sess.catalog
+
+
+# ------------------------------------------------------------ cost model
+
+class TestCostModel:
+    def test_small_plan_stays_on_device(self):
+        planned, catalog = _plan("select count(*) c from reason")
+        cm = CostModel(device_budget=1 << 30)
+        placement, why = cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                                   catalog=catalog)
+        assert placement == DEVICE
+        assert why.startswith("fits:")
+
+    def test_large_plan_goes_out_of_core(self):
+        # SF1 catalog stats: store_sales ~2.9M rows; a 1 MB budget is
+        # exceeded by orders of magnitude
+        planned, catalog = _plan()
+        cm = CostModel(device_budget=1 << 20)
+        placement, why = cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                                   catalog=catalog)
+        assert placement == CHUNKED
+        assert why.startswith("working-set:")
+
+    def test_stream_bytes_threshold_routes_chunked(self):
+        planned, catalog = _plan()
+        cm = CostModel(device_budget=1 << 40, stream_bytes=1 << 20)
+        placement, why = cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                                   catalog=catalog)
+        assert placement == CHUNKED
+        assert why.startswith("table-exceeds-stream-bytes")
+
+    def test_hwm_history_demotes_repeat_offender(self):
+        planned, catalog = _plan("select count(*) c from reason")
+        cm = CostModel(device_budget=1 << 30)
+        assert cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                         catalog=catalog, qname="q9")[0] == DEVICE
+        cm.observe("q9", (1 << 30) + 1)  # blew the budget last run
+        placement, why = cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                                   catalog=catalog, qname="q9")
+        assert placement == CHUNKED
+        assert why.startswith("hwm-history:")
+        # other queries are unaffected
+        assert cm.choose(planned, scheduler.UNIVERSES["tpu"],
+                         catalog=catalog, qname="q8")[0] == DEVICE
+
+    def test_cpu_universe_has_no_choice(self):
+        planned, catalog = _plan()
+        cm = CostModel(device_budget=1)
+        assert cm.choose(planned, scheduler.UNIVERSES["cpu"],
+                         catalog=catalog)[0] == CPU
+
+    def test_estimates_follow_catalog_stats(self):
+        from nds_tpu.sql import plan as P
+        planned, catalog = _plan(
+            "select ss_item_sk from store_sales")
+        est = plan_verify.estimate_plan(planned, catalog=catalog)
+        assert set(est.tables) == {"store_sales"}
+        rows, nbytes = est.tables["store_sales"]
+        assert rows == catalog.sizes["store_sales"]
+        # bytes = rows x the scan's output width at device dtypes
+        scan = next(n for n in P.walk_plan(planned.root)
+                    if isinstance(n, P.Scan))
+        width = sum(plan_verify._dtype_width(dt)
+                    for _n, dt in scan.output)
+        assert nbytes == rows * width
+        assert est.widest_table_bytes == nbytes
+
+
+# --------------------------------------------------------- fake executors
+
+class FakeExec:
+    """Scripted placement executor: raises per the schedule, then
+    succeeds. Records every execute() call."""
+
+    def __init__(self, fails=(), result="ok"):
+        self.fails = list(fails)
+        self.result = result
+        self.calls = 0
+        self.chunk_rows = 1 << 20      # chunked-placement surface
+        self.stream_bytes = 1 << 40    # nothing streams by default
+        self.last_timings = {"execute_ms": 1.0}
+        self.last_query_span = None
+
+    def execute(self, planned, key=None):
+        self.calls += 1
+        if self.fails:
+            raise self.fails.pop(0)
+        return self.result
+
+
+def _pipe(backend="tpu", overrides=None, execs=None):
+    cfg = EngineConfig(overrides={
+        "engine.backend": backend,
+        "engine.retry.base_delay_s": "0",
+        **(overrides or {})})
+    pipe = ExecutionPipeline(backend=backend, config=cfg)
+    pipe({})
+    for name, ex in (execs or {}).items():
+        pipe._executors[name] = ex
+    return pipe
+
+
+def _oom():
+    return InjectedOOM("device.execute", "RESOURCE_EXHAUSTED: test oom")
+
+
+# ------------------------------------------------------- ladder ordering
+
+class TestLadder:
+    def test_rungs_for_each_start(self):
+        pipe = _pipe("tpu")
+        assert pipe.rungs_for(DEVICE) == [DEVICE, CHUNKED, CPU]
+        assert pipe.rungs_for(CHUNKED) == [CHUNKED, CPU]
+        assert pipe.rungs_for(CPU) == [CPU]
+        dist = _pipe("distributed")
+        assert dist.rungs_for(SHARDED) == [SHARDED, CHUNKED, CPU]
+
+    def test_floor_truncates_ladder(self):
+        pipe = _pipe("tpu", {"engine.placement.floor": "chunked"})
+        assert pipe.rungs_for(DEVICE) == [DEVICE, CHUNKED]
+
+    def test_fallback_alias_forces_cpu_floor(self):
+        pipe = _pipe("tpu", {"engine.placement.floor": "chunked",
+                             "engine.fallback": "cpu"})
+        assert pipe.rungs_for(DEVICE) == [DEVICE, CHUNKED, CPU]
+
+    def test_ladder_off_is_single_rung(self):
+        pipe = _pipe("tpu", {"engine.placement.ladder": "off"})
+        assert pipe.rungs_for(DEVICE) == [DEVICE]
+
+    def test_oom_walks_full_ladder_in_order(self):
+        dev, chk, cpu = (FakeExec([_oom()]), FakeExec([_oom()]),
+                         FakeExec())
+        pipe = _pipe(execs={DEVICE: dev, CHUNKED: chk, CPU: cpu})
+        planned, _cat = _plan("select count(*) c from reason")
+        assert pipe.execute(planned) == "ok"
+        assert (dev.calls, chk.calls, cpu.calls) == (1, 1, 1)
+        assert pipe.last_schedule["ladder"] == [DEVICE, CHUNKED, CPU]
+        assert pipe.last_schedule["reschedules"] == 2
+        assert pipe.last_schedule["placement"] == CPU
+        assert pipe.last_stats.retries == 0  # reschedules, not retries
+
+    def test_reschedule_halves_chunk_rows_for_that_query_only(self):
+        class Recording(FakeExec):
+            seen = None
+
+            def execute(self, planned, key=None):
+                Recording.seen = self.chunk_rows
+                return super().execute(planned, key)
+
+        chk = Recording()
+        chk.chunk_rows = 1 << 20
+        pipe = _pipe(execs={DEVICE: FakeExec([_oom()]), CHUNKED: chk})
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)
+        # the rescheduled query ran at HALF the configured chunk size…
+        assert Recording.seen == 1 << 19
+        # …and the halving rolled back afterwards: repeated walks must
+        # not grind later chunked queries down to the floor
+        assert chk.chunk_rows == 1 << 20
+
+    def test_chunked_relief_lowers_stream_threshold_for_the_query(self):
+        """Entering chunked as a RELIEF placement (ladder / cost-model
+        working-set) must actually stream: the largest scanned table's
+        bytes cap the stream threshold for that query, then the
+        threshold restores."""
+        from nds_tpu.datagen import tpcds
+        from nds_tpu.io.host_table import from_arrays
+        from nds_tpu.nds.schema import get_schemas
+
+        table = from_arrays("reason", get_schemas()["reason"],
+                            tpcds.gen_table("reason", 0.01))
+
+        class Recording(FakeExec):
+            seen = None
+
+            def execute(self, planned, key=None):
+                Recording.seen = self.stream_bytes
+                return super().execute(planned, key)
+
+        chk = Recording()
+        pipe = _pipe()
+        pipe({"reason": table})
+        pipe._executors.update({DEVICE: FakeExec([_oom()]),
+                                CHUNKED: chk})
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)
+        from nds_tpu.obs.memwatch import table_bytes
+        assert Recording.seen == max(table_bytes(table) - 1, 1)
+        assert chk.stream_bytes == 1 << 40  # restored after the walk
+
+    def test_generic_transient_retries_same_rung(self):
+        boom = faults.InjectedTransientFault("device.execute", "flaky")
+        dev = FakeExec([boom])
+        pipe = _pipe(execs={DEVICE: dev})
+        planned, _ = _plan("select count(*) c from reason")
+        assert pipe.execute(planned) == "ok"
+        assert dev.calls == 2                      # retried in place
+        assert pipe.last_stats.retries == 1
+        assert pipe.last_schedule["reschedules"] == 0
+
+    def test_deterministic_never_walks(self):
+        err = faults.InjectedDeterministicFault("device.execute", "bug")
+        dev, cpu = FakeExec([err]), FakeExec()
+        pipe = _pipe(execs={DEVICE: dev, CPU: cpu})
+        planned, _ = _plan("select count(*) c from reason")
+        with pytest.raises(faults.InjectedDeterministicFault):
+            pipe.execute(planned)
+        assert cpu.calls == 0
+        assert pipe.last_stats.gave_up_reason == "deterministic"
+
+    def test_oom_at_floor_exhausts_attempts(self):
+        cpu = FakeExec([_oom(), _oom(), _oom(), _oom()])
+        pipe = _pipe("cpu", execs={CPU: cpu})
+        planned, _ = _plan("select count(*) c from reason")
+        with pytest.raises(InjectedOOM):
+            pipe.execute(planned)
+        assert cpu.calls == 3  # engine.retry.max_attempts default
+        assert pipe.last_stats.gave_up_reason == "attempts_exhausted(3)"
+
+    def test_sharded_overflow_replans_with_grown_slack(self):
+        class FakeSharded(FakeExec):
+            slack_grown = 0
+
+            def grow_slack(self):
+                self.slack_grown += 1
+
+        from nds_tpu.engine.device_exec import DeviceExecError
+        over = DeviceExecError("exchange overflow persisted")
+        sh = FakeSharded([over])
+        pipe = _pipe("distributed", execs={SHARDED: sh})
+        planned, _ = _plan("select count(*) c from reason")
+        assert pipe.execute(planned) == "ok"
+        # one overflow -> re-plan at doubled slack on the SAME rung
+        assert sh.slack_grown == 1 and sh.calls == 2
+        assert pipe.last_schedule["ladder"] == [SHARDED,
+                                                scheduler.SHARDED_REPLAN]
+        assert pipe.last_schedule["placement"] == SHARDED
+
+    def test_sharded_overflow_persisting_demotes_to_chunked(self):
+        from nds_tpu.engine.device_exec import DeviceExecError
+
+        class FakeSharded(FakeExec):
+            def grow_slack(self):
+                pass
+
+        over = [DeviceExecError("exchange overflow persisted")
+                for _ in range(2)]
+        sh, chk = FakeSharded(over), FakeExec()
+        pipe = _pipe("distributed", execs={SHARDED: sh, CHUNKED: chk})
+        planned, _ = _plan("select count(*) c from reason")
+        assert pipe.execute(planned) == "ok"
+        assert chk.calls == 1
+        assert pipe.last_schedule["placement"] == CHUNKED
+
+
+# ------------------------------------------- demotion / promotion cycle
+
+class TestPromotion:
+    def _walked_pipe(self):
+        pipe = _pipe(overrides={"engine.placement.demote_after": "2",
+                                "engine.placement.promote_after": "2"})
+        return pipe
+
+    def _walk_once(self, pipe, planned):
+        pipe._executors[DEVICE] = FakeExec([_oom()])
+        pipe._executors.setdefault(CHUNKED, FakeExec())
+        pipe.execute(planned)
+
+    def test_demotes_after_streak_and_promotes_after_clean(self):
+        pipe = self._walked_pipe()
+        planned, _ = _plan("select count(*) c from reason")
+        # two consecutive ladder-walked queries -> sticky demotion
+        self._walk_once(pipe, planned)
+        assert pipe._demoted_to is None
+        self._walk_once(pipe, planned)
+        assert pipe._demoted_to == CHUNKED
+        # demoted start: no ladder walk, placement is the demoted rung
+        pipe._executors[DEVICE] = FakeExec()  # healthy again
+        pipe.execute(planned)
+        assert pipe.last_schedule["initial"] == CHUNKED
+        assert pipe.last_schedule["reason"] == "sticky-demotion"
+        assert pipe._executors[DEVICE].calls == 0
+        # second clean query at the demoted rung -> promotion
+        pipe.execute(planned)
+        assert pipe._demoted_to is None
+        # the next query records the promotion and runs at the top
+        pipe.execute(planned)
+        assert pipe.last_schedule.get("promoted_back") is True
+        assert pipe.last_schedule["initial"] == DEVICE
+        assert pipe._executors[DEVICE].calls == 1
+
+    def test_promotion_metrics(self):
+        from nds_tpu.obs import metrics as obs_metrics
+        before = obs_metrics.snapshot()
+        self.test_demotes_after_streak_and_promotes_after_clean()
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["placement_demotions_total"] == 1
+        assert d["counters"]["placement_promotions_total"] == 1
+        assert d["counters"]["query_reschedules_total"] == 2
+
+
+# ------------------------------------------------------------- consensus
+
+class SimChannel:
+    """Simulated multi-rank vote transport: scripted peer votes, or a
+    lagging rank that never reports (gather -> None)."""
+
+    def __init__(self, peers, world=None, lagging=False):
+        self.peers = peers
+        self.world = world if world is not None else len(peers) + 1
+        self.lagging = lagging
+        self.gathers = 0
+
+    def gather(self, vote):
+        self.gathers += 1
+        if self.lagging:
+            return None
+        return [vote] + list(self.peers)
+
+
+class TestConsensus:
+    def test_unanimous_switch(self):
+        c = Consensus(SimChannel([2, 2]))
+        assert c.decide(2) == 2
+
+    def test_deepest_demotion_wins(self):
+        # one rank wants rung 2, others are happy at 0: everyone goes
+        # to 2 — all switch together or none do
+        c = Consensus(SimChannel([0, 0]))
+        assert c.decide(2) == 2
+        c2 = Consensus(SimChannel([2, 1]))
+        assert c2.decide(0) == 2
+
+    def test_lagging_rank_blocks_switch(self):
+        ch = SimChannel([], world=3, lagging=True)
+        c = Consensus(ch)
+        assert c.decide(1) is None
+        assert ch.gathers == 1
+
+    def test_partial_gather_blocks_switch(self):
+        # a gather that comes back short of the world size means a
+        # rank is missing: no switch
+        c = Consensus(SimChannel([1], world=3))
+        assert c.decide(1) is None
+
+    def test_null_channel_is_degenerate_unanimity(self):
+        c = Consensus(NullChannel())
+        assert c.decide(1) == 1
+
+    def test_multi_rank_world_has_no_mid_query_ladder(self):
+        # rank-local mid-query walking cannot pair its collectives:
+        # on a multi-rank world the query exhausts its single rung and
+        # placement moves only through the per-query boundary vote
+        dev, cpu = FakeExec([_oom()] * 3), FakeExec()
+        pipe = _pipe(execs={DEVICE: dev, CPU: cpu})
+        pipe.consensus = Consensus(SimChannel([], world=3,
+                                              lagging=True))
+        planned, _ = _plan("select count(*) c from reason")
+        with pytest.raises(InjectedOOM):
+            pipe.execute(planned)
+        assert pipe.last_schedule["reschedules"] == 0
+        assert pipe.last_stats.gave_up_reason == "attempts_exhausted(3)"
+        assert cpu.calls == 0
+        # the lagging rank blocked the boundary switch: nobody moves
+        assert pipe._demoted_to is None
+
+    def test_multi_rank_boundary_vote_demotes_all_together(self):
+        pipe = _pipe(overrides={"engine.placement.demote_after": "1"},
+                     execs={DEVICE: FakeExec([_oom()] * 3),
+                            CHUNKED: FakeExec()})
+        pipe.consensus = Consensus(SimChannel([1]))  # peer wants rung 1
+        planned, _ = _plan("select count(*) c from reason")
+        with pytest.raises(InjectedOOM):
+            pipe.execute(planned)
+        # the failed query demoted the START through the shared vote
+        assert pipe._demoted_to == CHUNKED
+        pipe.execute(planned)
+        assert pipe.last_schedule["placement"] == CHUNKED
+        assert pipe._executors[CHUNKED].calls == 1
+
+    def test_multi_rank_peer_vote_can_demote_a_healthy_rank(self):
+        # the deepest demotion wins even when THIS rank is clean —
+        # all switch together or none do
+        pipe = _pipe(execs={DEVICE: FakeExec(), CHUNKED: FakeExec()})
+        pipe.consensus = Consensus(SimChannel([1]))
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)  # succeeds locally, peer votes rung 1
+        assert pipe._demoted_to == CHUNKED
+
+    def test_multi_rank_boundary_promotion_requires_unanimity(self):
+        pipe = _pipe(overrides={"engine.placement.demote_after": "1",
+                                "engine.placement.promote_after": "1"},
+                     execs={DEVICE: FakeExec([_oom()] * 3),
+                            CHUNKED: FakeExec()})
+        pipe.consensus = Consensus(SimChannel([1]))
+        planned, _ = _plan("select count(*) c from reason")
+        with pytest.raises(InjectedOOM):
+            pipe.execute(planned)
+        assert pipe._demoted_to == CHUNKED
+        # clean query at the demoted rung: self votes promote, the
+        # peer still votes for the demotion -> stay demoted
+        pipe.execute(planned)
+        assert pipe._demoted_to == CHUNKED
+        # peers agree -> promoted, recorded on the next query
+        pipe.consensus = Consensus(SimChannel([0]))
+        pipe.execute(planned)
+        assert pipe._demoted_to is None
+        pipe.execute(planned)
+        assert pipe.last_schedule.get("promoted_back") is True
+        assert pipe.last_schedule["placement"] == DEVICE
+
+    def test_consensus_metric_counts_votes(self):
+        from nds_tpu.obs import metrics as obs_metrics
+        before = obs_metrics.snapshot()
+        Consensus(NullChannel()).decide(0)
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["placement_consensus_total"] == 1
+
+
+# ----------------------------------------------------- pipeline surface
+
+class TestPipelineSurface:
+    def test_reset_query_clears_stale_state(self):
+        pipe = _pipe(execs={DEVICE: FakeExec()})
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)
+        assert pipe.last_schedule
+        pipe.reset_query()
+        assert pipe.last_stats.retries == 0
+        assert pipe.last_schedule == {}
+        assert pipe.last_timings == {}
+
+    def test_adopts_executor_timings(self):
+        dev = FakeExec()
+        dev.last_timings = {"execute_ms": 42.0}
+        pipe = _pipe(execs={DEVICE: dev})
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)
+        assert pipe.last_timings["execute_ms"] == 42.0
+
+    def test_rebinding_tables_drops_executors(self):
+        pipe = _pipe(execs={DEVICE: FakeExec()})
+        pipe({"t": object()})
+        assert pipe._executors == {}
+
+    def test_invalidate_keeps_hwm_history(self):
+        pipe = _pipe(execs={DEVICE: FakeExec()})
+        pipe.cost_model.observe("q1", 123)
+        pipe.invalidate()
+        assert pipe._executors == {}
+        assert pipe.cost_model.hwm_history == {"q1": 123}
+
+    def test_forced_placement_wins(self):
+        cpu = FakeExec()
+        pipe = _pipe(overrides={"engine.placement.force": "cpu"},
+                     execs={CPU: cpu, DEVICE: FakeExec()})
+        planned, _ = _plan("select count(*) c from reason")
+        pipe.execute(planned)
+        assert cpu.calls == 1
+        assert pipe.last_schedule["reason"] == "forced"
+
+    def test_query_name_threads_from_faults_context(self):
+        pipe = _pipe(execs={DEVICE: FakeExec([_oom()],),
+                            CHUNKED: FakeExec()})
+        planned, _ = _plan("select count(*) c from reason")
+        with faults.context(query="query42"):
+            pipe.execute(planned)
+        assert pipe.last_schedule["reschedules"] == 1
+        assert pipe.last_schedule["ladder"] == [DEVICE, CHUNKED]

@@ -306,6 +306,34 @@ def test_rule_mutable_default_and_bare_except():
         == {"NDS106", "NDS107"}
 
 
+def test_rule_direct_executor_construction():
+    src = ("def f(tables):\n"
+           "    from nds_tpu.engine.device_exec import DeviceExecutor\n"
+           "    return DeviceExecutor(tables)\n")
+    assert _rules(_lint(src, enabled={"NDS110"}).violations) == {"NDS110"}
+    # attribute form flags too
+    attr = ("from nds_tpu.engine import cpu_exec as cx\n\n"
+            "def f(tables):\n"
+            "    return cx.CpuExecutor(tables)\n")
+    assert _rules(_lint(attr, enabled={"NDS110"}).violations) == {"NDS110"}
+    # the scheduler itself is the allowed construction point
+    assert _lint(src, path="nds_tpu/engine/scheduler.py",
+                 enabled={"NDS110"}).violations == []
+    # an executor's own module constructs freely (factories, subclass
+    # helpers)
+    assert _lint(src, path="nds_tpu/engine/device_exec.py",
+                 enabled={"NDS110"}).violations == []
+    # ...but only for ITS executor
+    assert _rules(_lint(attr, path="nds_tpu/engine/device_exec.py",
+                        enabled={"NDS110"}).violations) == {"NDS110"}
+    # waivable like every rule
+    waived = ("def f(tables):\n"
+              "    # ndslint: waive[NDS110] -- bounds probe only\n"
+              "    return DeviceExecutor(tables)\n")
+    res = _lint(waived, enabled={"NDS110"})
+    assert res.violations == [] and len(res.waived) == 1
+
+
 def test_waiver_requires_justification_and_use():
     src = ("def f(a=[]):  # ndslint: waive[NDS106]\n"
            "    return a\n")

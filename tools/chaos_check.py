@@ -13,6 +13,24 @@ same failure sequence; a regression in classification, retry
 accounting, or journaling fails here before any differential tier
 spins up a device.
 
+Two placement/ladder scenarios (engine/scheduler.py) ride on the same
+generated data:
+
+- **ladder** — a 3-query power stream on the tpu backend with device
+  OOM injected at BOTH device-side placements (scoped to the executor
+  class names, so the CPU floor stays healthy): every query must walk
+  the full degradation ladder (device -> chunked -> cpu), complete
+  with ``reschedules: 2`` recorded in its summary, and produce result
+  rows IDENTICAL to a clean cpu-backend run of the same stream.
+
+- **consensus** — the same stream on the distributed backend (8-device
+  virtual mesh) with OOM injected at the sharded placement: the first
+  queries reschedule through a consensus vote (degenerate one-rank
+  world — the same code path real multi-process runs take), the
+  reschedule streak demotes the stream's starting placement, the run
+  completes degraded with no deadlock, and
+  ``placement_consensus_total`` / ``placement_demotions_total`` move.
+
 Two watchdog/integrity scenarios ride on the same generated data:
 
 - **hang** — a 4-stream SUPERVISED subprocess throughput round with a
@@ -153,6 +171,162 @@ def run_journal_check(workdir: str) -> int:
     else:
         return _fail("journal accepted a mismatched config digest")
     print("OK: phase journal round-trip + config-digest guard")
+    return 0
+
+
+def _stream_summaries(jsons: str) -> dict:
+    out = {}
+    for f in os.listdir(jsons):
+        with open(os.path.join(jsons, f)) as fh:
+            s = json.load(fh)
+        out[s["query"]] = s
+    return out
+
+
+def run_ladder_stream(workdir: str) -> int:
+    """Injected device OOM at every device-side placement: each query
+    walks the FULL ladder (device -> chunked -> cpu), completes, and
+    its rows match a clean CPU run bit-for-bit."""
+    from nds_tpu.io.result_io import read_result
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.resilience import faults
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "streams")
+    stream = os.path.join(sdir, "query_0.sql")
+
+    # clean reference rows: the same stream, cpu backend, no faults
+    clean_out = os.path.join(workdir, "ladder_clean")
+    power_core.run_query_stream(
+        SUITE, raw, stream, os.path.join(workdir, "ladder_clean.csv"),
+        config=EngineConfig(overrides={"engine.backend": "cpu"}),
+        input_format="raw", output_prefix=clean_out)
+
+    jsons = os.path.join(workdir, "json_ladder")
+    chaos_out = os.path.join(workdir, "ladder_chaos")
+    cfg = EngineConfig(overrides={
+        "engine.backend": "tpu",
+        "engine.retry.base_delay_s": "0.01",
+        # keep the sticky demotion OUT of this scenario: every query
+        # must start at the top and walk the whole ladder itself
+        "engine.placement.demote_after": "99",
+    })
+    before = obs_metrics.snapshot()
+    # scope by executor CLASS: the device and chunked placements die
+    # with OOM on every attempt, the CPU floor never fires. The
+    # chunked rung streams for real now (the scheduler lowers the
+    # stream threshold on relief entries), so its dispatches run in
+    # the phase A/B sub-executors — fail those too or the ladder
+    # (correctly!) stops at chunked
+    faults.install("device.execute:oom*99@DeviceExecutor,"
+                   "device.execute:oom*99@ChunkedExecutor,"
+                   "device.execute:oom*99@_PhaseBExecutor,"
+                   "device.execute:oom*99@_PartialAggExecutor", seed=7)
+    try:
+        power_core.run_query_stream(
+            SUITE, raw, stream,
+            os.path.join(workdir, "ladder_time.csv"), config=cfg,
+            input_format="raw", json_summary_folder=jsons,
+            output_prefix=chaos_out)
+    finally:
+        faults.clear()
+    # run_query_stream counts CompletedWithTaskFailures as non-success
+    # (the chunked rung's internal chunk-halving notifies the
+    # collector), so the gate keys on per-query statuses: every query
+    # must COMPLETE — with or without recovered task failures
+    sums = _stream_summaries(jsons)
+    for n in TEMPLATES:
+        s = sums.get(f"query{n}")
+        if not s:
+            return _fail(f"query{n} summary missing: {sorted(sums)}")
+        if s["queryStatus"][-1] not in ("Completed",
+                                        "CompletedWithTaskFailures"):
+            return _fail(f"query{n} did not complete: "
+                         f"{s['queryStatus']}")
+        if s.get("placement") != "cpu" or s.get("reschedules") != 2:
+            return _fail(
+                f"query{n} should land on cpu after 2 reschedules: "
+                f"placement={s.get('placement')} "
+                f"reschedules={s.get('reschedules')}")
+        if s.get("ladder") != ["device", "chunked", "cpu"]:
+            return _fail(f"query{n} ladder wrong: {s.get('ladder')}")
+    # correctness across the whole walk: identical rows to the clean
+    # CPU run, query by query
+    for n in TEMPLATES:
+        a = read_result(os.path.join(clean_out, f"query{n}"))
+        b = read_result(os.path.join(chaos_out, f"query{n}"))
+        if not a.equals(b):
+            return _fail(f"query{n} rows diverged from the clean CPU "
+                         f"run after the ladder walk")
+    delta = obs_metrics.delta(before, obs_metrics.snapshot())
+    counters = delta.get("counters", {})
+    if counters.get("query_reschedules_total", 0) < 2 * len(TEMPLATES):
+        return _fail(f"query_reschedules_total delta: {counters}")
+    print("OK: ladder stream (device OOM walked device->chunked->cpu "
+          "per query, all completed, rows identical to clean CPU run)")
+    return 0
+
+
+def run_consensus_demotion(workdir: str) -> int:
+    """Virtual-mesh consensus demotion: sharded-placement OOM
+    reschedules through the consensus vote, the stream's starting
+    placement demotes (all ranks together — degenerate 1-rank world
+    here, same code path as a real pod), and the run completes
+    degraded without deadlock."""
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.resilience import faults
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "query_0.sql")
+    jsons = os.path.join(workdir, "json_consensus")
+    cfg = EngineConfig(overrides={
+        "engine.backend": "distributed",
+        "engine.retry.base_delay_s": "0.01",
+        "engine.placement.demote_after": "2",
+    })
+    before = obs_metrics.snapshot()
+    faults.install("device.execute:oom*99@DistributedExecutor", seed=7)
+    try:
+        failures = power_core.run_query_stream(
+            SUITE, raw, stream,
+            os.path.join(workdir, "consensus_time.csv"), config=cfg,
+            input_format="raw", json_summary_folder=jsons)
+    finally:
+        faults.clear()
+    if failures != 0:
+        return _fail(f"consensus stream should complete degraded, "
+                     f"{failures} failed")
+    sums = _stream_summaries(jsons)
+    walked = [s for s in sums.values() if s.get("reschedules")]
+    if not walked:
+        return _fail(f"no query rescheduled off the sharded "
+                     f"placement: { {q: s.get('placement') for q, s in sums.items()} }")
+    for s in walked:
+        if s.get("placement") == "sharded":
+            return _fail(f"{s['query']} still reports the sharded "
+                         f"placement after rescheduling: {s}")
+    # after demote_after rescheduled queries the START demotes: the
+    # last query must begin off-sharded with no ladder walk of its own
+    last = sums.get(f"query{TEMPLATES[-1]}")
+    if not last or last.get("reschedules") != 0 \
+            or last.get("placement") == "sharded":
+        return _fail(f"stream start should be demoted by the streak: "
+                     f"{last}")
+    delta = obs_metrics.delta(before, obs_metrics.snapshot())
+    counters = delta.get("counters", {})
+    if not counters.get("placement_consensus_total"):
+        return _fail(f"placement_consensus_total delta: {counters}")
+    if counters.get("placement_demotions_total") != 1:
+        return _fail(f"placement_demotions_total delta: {counters}")
+    print("OK: consensus demotion (sharded OOM rescheduled via "
+          "consensus, stream start demoted, run completed degraded, "
+          "no deadlock)")
     return 0
 
 
@@ -311,6 +485,8 @@ def main() -> int:
     with tempfile.TemporaryDirectory(prefix="nds_chaos_") as workdir:
         rc = run_chaos_stream(workdir)
         rc |= run_journal_check(workdir)
+        rc |= run_ladder_stream(workdir)
+        rc |= run_consensus_demotion(workdir)
         rc |= run_watchdog_stream(workdir)
         # LAST: really mutates the shared raw data
         rc |= run_corrupt_load(workdir)

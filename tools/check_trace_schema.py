@@ -17,7 +17,9 @@ BenchReport summary schema (``--summary``, README "Observability"):
   — spans (name/dur_ms/attrs/children tree), metrics (counters/gauges/
   histograms with count+sum and optional p50/p95/p99), memory
   (device_hwm_bytes + source), retries / retry_backoff_s /
-  gave_up_reason / deadline_exceeded.
+  gave_up_reason / deadline_exceeded, and the scheduling fields
+  placement / reschedules / ladder / promoted_back
+  (engine/scheduler.py; README "Placement & degradation").
 
 Exit 0 when every record validates; prints each offense otherwise.
 Run by tests/test_observability.py and tools/static_checks.py as a
@@ -171,6 +173,23 @@ def validate_summary(obj: object) -> list[str]:
     if "deadline_exceeded" in obj and not isinstance(
             obj["deadline_exceeded"], bool):
         errs.append("deadline_exceeded is not a bool")
+    # scheduling fields (engine/scheduler.py; README "Placement &
+    # degradation"): placement + reschedules travel together,
+    # ladder only appears on rescheduled queries
+    if "placement" in obj and (
+            not isinstance(obj["placement"], str)
+            or not obj["placement"]):
+        errs.append(f"bad placement {obj.get('placement')!r}")
+    if "reschedules" in obj and (
+            not isinstance(obj["reschedules"], int)
+            or obj["reschedules"] < 0):
+        errs.append(f"bad reschedules {obj['reschedules']!r}")
+    if "ladder" in obj and (
+            not isinstance(obj["ladder"], list)
+            or not all(isinstance(x, str) for x in obj["ladder"])):
+        errs.append(f"bad ladder {obj['ladder']!r}")
+    if "promoted_back" in obj and obj["promoted_back"] is not True:
+        errs.append(f"bad promoted_back {obj['promoted_back']!r}")
     return errs
 
 

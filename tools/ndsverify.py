@@ -8,8 +8,15 @@ differential tiers prove the *results*, this proves the *plans* — and
 it runs in tier-1 (tests/test_static_analysis.py) so a planner
 regression fails before any engine executes it.
 
-Exit 0 when every statement plans and verifies clean; prints each
-violation otherwise. View DDL (NDS-H q15's create/drop cycle) is
+Every verified statement also gets a PLACEMENT assigned by the
+scheduler's cost model (engine/scheduler.py) seeded from the plan
+verifier's size estimates over the catalog's SF1 statistics — proving
+the control-plane decision the unified pipeline makes per query is
+computable for the whole workload with no accelerator and no data. A
+statement the cost model cannot place is a failure.
+
+Exit 0 when every statement plans, verifies clean, and places; prints
+each violation otherwise. View DDL (NDS-H q15's create/drop cycle) is
 applied to the session, not verified as a plan.
 
 Usage: python tools/ndsverify.py [--suite nds|nds_h|all] [-v]
@@ -30,14 +37,17 @@ from nds_tpu.sql import plan as P  # noqa: E402
 
 
 def _verify_statement(session: Session, label: str, stmt: str,
-                      failures: list) -> int:
+                      failures: list, placements: dict,
+                      verbose: bool = False) -> int:
     """Plan one statement, apply DDL side effects, verify SELECT/INSERT
-    plans. Returns the number of PlannedQuery units verified.
+    plans, and assign a placement via the scheduler cost model.
+    Returns the number of PlannedQuery units verified.
 
     Under NDS_TPU_VERIFY_PLANS=1 (tests force it) Session.plan raises
     on the first violation before our collecting verify() pass runs —
     catch it so one bad statement still reports its violations and the
     sweep continues to the remaining statements."""
+    from nds_tpu.engine import scheduler
     try:
         planned = session.plan(stmt)
     except plan_verify.PlanVerifyError as exc:
@@ -61,10 +71,21 @@ def _verify_statement(session: Session, label: str, stmt: str,
     vs = plan_verify.verify(planned, catalog=session.catalog)
     for v in vs:
         failures.append(f"{label}: {v}")
+    try:
+        placement, why = scheduler.CostModel().choose(
+            planned, scheduler.UNIVERSES["tpu"],
+            catalog=session.catalog, qname=label)
+        placements[placement] = placements.get(placement, 0) + 1
+        if verbose:
+            print(f"  {label}: placement={placement} ({why})")
+    except Exception as exc:  # noqa: BLE001 - a placement MUST compute
+        failures.append(f"{label}: placement assignment failed: "
+                        f"{type(exc).__name__}: {exc}")
     return 1
 
 
-def verify_nds(failures: list, verbose: bool = False) -> int:
+def verify_nds(failures: list, placements: dict,
+               verbose: bool = False) -> int:
     from nds_tpu.nds import streams
     session = Session.for_nds()
     n = 0
@@ -73,22 +94,21 @@ def verify_nds(failures: list, verbose: bool = False) -> int:
         parts = [s for s in sql.split(";") if s.strip()]
         for i, stmt in enumerate(parts, 1):
             label = f"nds q{qn}" + (f" part{i}" if len(parts) > 1 else "")
-            n += _verify_statement(session, label, stmt, failures)
-            if verbose:
-                print(f"  {label}: ok")
+            n += _verify_statement(session, label, stmt, failures,
+                                   placements, verbose)
     return n
 
 
-def verify_nds_h(failures: list, verbose: bool = False) -> int:
+def verify_nds_h(failures: list, placements: dict,
+                 verbose: bool = False) -> int:
     from nds_tpu.nds_h import streams
     session = Session.for_nds_h()
     n = 0
     for qn in streams.stream_order(0):
         for i, stmt in enumerate(streams.statements(qn), 1):
             label = f"nds_h q{qn} part{i}"
-            n += _verify_statement(session, label, stmt, failures)
-            if verbose:
-                print(f"  {label}: ok")
+            n += _verify_statement(session, label, stmt, failures,
+                                   placements, verbose)
     return n
 
 
@@ -99,16 +119,26 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
     failures: list[str] = []
+    placements: dict[str, int] = {}
     counts = []
     if args.suite in ("nds", "all"):
-        counts.append(("nds", verify_nds(failures, args.verbose)))
+        counts.append(("nds", verify_nds(failures, placements,
+                                         args.verbose)))
     if args.suite in ("nds_h", "all"):
-        counts.append(("nds_h", verify_nds_h(failures, args.verbose)))
+        counts.append(("nds_h", verify_nds_h(failures, placements,
+                                             args.verbose)))
     for line in failures:
         print(line)
+    total = sum(n for _name, n in counts)
+    placed = sum(placements.values())
+    if placed != total and not failures:
+        print(f"FAIL: only {placed}/{total} statements got a placement")
+        return 1
     summary = " + ".join(f"{n} {name}" for name, n in counts)
+    pl = ", ".join(f"{k}={v}" for k, v in sorted(placements.items()))
     print(f"{'FAIL' if failures else 'OK'}: {len(failures)} "
-          f"violation(s) across {summary} statement(s)")
+          f"violation(s) across {summary} statement(s); "
+          f"placements assigned: {placed} ({pl})")
     return 1 if failures else 0
 
 

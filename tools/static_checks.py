@@ -13,18 +13,25 @@ the one to run locally before pushing:
                         nds_tpu/analysis/lint_rules.py)
   4. ndsverify          plan + verify all 103 NDS and 22 NDS-H
                         statements on CPU (invariants:
-                        nds_tpu/analysis/plan_verify.py)
+                        nds_tpu/analysis/plan_verify.py), each with a
+                        placement assigned by the scheduler cost model
+                        (engine/scheduler.py) — no accelerator
   5. chaos              3-query NDS power stream on CPU under a fixed
                         fault schedule: one transient injection must
                         retry and complete, one deterministic must
                         fail fast; plus the resume-journal round-trip,
-                        a SUPERVISED 4-stream throughput round with an
-                        injected hang (watchdog catches it within 2x
-                        stall_s, stream restarts once, round completes
-                        degraded), and an injected io.read byte-flip
-                        (digest verification fails the load fast with
-                        CorruptArtifact, zero retries)
-                        (tools/chaos_check.py)
+                        a FULL-LADDER walk under injected device OOM
+                        (every query completes at the floor with rows
+                        identical to a clean CPU run), a virtual-mesh
+                        CONSENSUS demotion (sharded OOM reschedules
+                        through the vote, the stream start demotes,
+                        no deadlock), a SUPERVISED 4-stream throughput
+                        round with an injected hang (watchdog catches
+                        it within 2x stall_s, stream restarts once,
+                        round completes degraded), and an injected
+                        io.read byte-flip (digest verification fails
+                        the load fast with CorruptArtifact, zero
+                        retries) (tools/chaos_check.py)
   6. ndsreport          run-analysis self-check over the committed
                         fixture run-dirs (tests/fixtures/run_a|b):
                         attribution sums to wall-clock, the regression
