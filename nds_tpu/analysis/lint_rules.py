@@ -56,6 +56,17 @@ fails CI instead of waiting for a human audit:
                             unification (no shared retry/ladder/
                             consensus wiring runs for it).
 
+- NDS111 uncached-compile   ``jax.jit(...)`` or a ``.lower(args)``
+                            AOT-lowering call inside ``engine/`` /
+                            ``parallel/``: every lower+compile must
+                            route through ``nds_tpu/cache/aot.py`` so
+                            the persistent plan cache sees it — a
+                            stray inline compile is invisible to the
+                            cache and pays the full XLA bill in every
+                            process. Sites that only BUILD the traced
+                            callable (the ``jax.jit(fn)`` handed to
+                            ``cache.aot``) carry waivers saying so.
+
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
@@ -579,11 +590,68 @@ class DirectExecutorRule(Rule):
         return out
 
 
+class UncachedCompileRule(Rule):
+    """NDS111: an XLA compile entry point — ``jax.jit(...)`` or an AOT
+    ``.lower(args)`` chain — inside ``engine/``/``parallel/`` outside
+    the cache module. The persistent plan cache (nds_tpu/cache/) can
+    only serve a program it saw compiled: ``cache.aot`` is the single
+    lower/compile site, so every executor program gets the
+    consult-hit-or-persist treatment. ``.lower()`` with no arguments
+    is string-lowercasing, never flagged; ``jax.jit(fn)`` used purely
+    to build the traced callable handed to ``cache.aot`` is
+    legitimate and carries a waiver saying so."""
+
+    id = "NDS111"
+    name = "uncached-compile"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+
+    def check(self, tree, src, path):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "jit"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jax"):
+                out.append(LintViolation(
+                    self.id, path, node.lineno,
+                    "jax.jit in engine/parallel code — compiles must "
+                    "route through nds_tpu/cache/aot.py so the "
+                    "persistent plan cache sees them (waive sites "
+                    "that only build the traced callable)"))
+            elif (isinstance(f, ast.Attribute) and f.attr == "lower"
+                    and (node.args or node.keywords)
+                    and not self._string_module(f.value)):
+                # .lower(bufs) is jax AOT lowering; bare .lower() is a
+                # string method
+                out.append(LintViolation(
+                    self.id, path, node.lineno,
+                    ".lower(args) AOT chain in engine/parallel code — "
+                    "use cache.aot.lower_and_compile / cached_compile "
+                    "so the plan cache can serve and persist the "
+                    "executable"))
+        return out
+
+    @staticmethod
+    def _string_module(value: ast.AST) -> bool:
+        """``np.char.lower(a)`` / ``str.lower(s)`` are string ops, not
+        AOT lowering — a function call THROUGH a string-handling
+        module, distinguishable syntactically from a method on a
+        jitted object."""
+        if isinstance(value, ast.Name):
+            return value.id == "str"
+        if isinstance(value, ast.Attribute):
+            return value.attr == "char"
+        return False
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
-            NonAtomicJsonWriteRule(), DirectExecutorRule()]
+            NonAtomicJsonWriteRule(), DirectExecutorRule(),
+            UncachedCompileRule()]
 
 
 # -------------------------------------------------------------- driver

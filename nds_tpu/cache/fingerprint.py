@@ -1,0 +1,212 @@
+"""Plan fingerprints: the cache key for persisted AOT executables.
+
+A compiled XLA program is reusable across processes only when EVERYTHING
+that shaped it matches: the logical plan, the input tables (the trace
+bakes host-derived constants — string-dictionary predicate tables,
+col_bounds key-packing clips, sorted-build verdicts, reduced-scan
+survivor capacities — so table CONTENT matters, not just schema), the
+compute precision, the capacity slack, the jax/jaxlib versions, the
+backend platform, the mesh topology, and the engine's own trace code.
+``fingerprint()`` folds all of it into one sha256 hex string; any drift
+in any component lands on a different key, so the cache can never serve
+a stale program — version skew is a MISS by construction, never an
+error case.
+
+Components:
+
+- ``canonical(obj)`` — deterministic text form of a plan tree
+  (dataclass walk over plan.Node / ir.IR / AggSpec / WindowSpec /
+  DType; numpy scalars normalized through ``.item()`` so numpy-2 repr
+  drift cannot rename keys).
+- ``table_stamp(table)`` — name, row count, schema, and a full-content
+  sha256 (values + null masks + dictionaries). The digest is computed
+  once per HostTable object and memoized ON the object (tables are
+  immutable once registered; DML builds new objects), so a 99-query
+  power run hashes each table once, not once per query.
+- ``code_epoch()`` — sha256 over the engine modules whose source
+  shapes the traced program. A PR that changes the trace logic
+  silently invalidates every cached executable instead of serving
+  programs the new code would no longer build.
+
+Nothing here imports jax: fingerprinting (and the ndscache CLI's
+ls/verify/prune verbs) must run on any host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+import numpy as np
+
+from nds_tpu.engine.types import DType
+
+# bump to invalidate every existing cache entry on a format change
+FP_VERSION = 1
+
+# engine modules whose source text shapes the compiled programs (the
+# trace interpreters and everything they bake constants from)
+_EPOCH_MODULES = (
+    "nds_tpu/engine/device_exec.py",
+    "nds_tpu/engine/chunked_exec.py",
+    "nds_tpu/engine/staging.py",
+    "nds_tpu/parallel/dist_exec.py",
+    "nds_tpu/parallel/exchange.py",
+    "nds_tpu/parallel/mesh.py",
+    "nds_tpu/sql/plan.py",
+    "nds_tpu/sql/ir.py",
+)
+
+_epoch_cache: str | None = None
+
+
+def code_epoch() -> str:
+    """sha256 (hex) over the engine sources that shape traced programs;
+    computed once per process."""
+    global _epoch_cache
+    if _epoch_cache is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        h = hashlib.sha256()
+        for rel in _EPOCH_MODULES:
+            path = os.path.join(root, rel)
+            h.update(rel.encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(b"<missing>")
+        _epoch_cache = h.hexdigest()
+    return _epoch_cache
+
+
+# ------------------------------------------------------- canonical plan
+
+def canonical(obj) -> str:
+    """Deterministic text form of a plan/IR tree. Object identity and
+    field ORDER are preserved (a shared CTE body serializes at each
+    reference — the trace caches by identity, but identical text means
+    identical traced program, which is all the key needs)."""
+    if obj is None:
+        return "~"
+    if isinstance(obj, DType):
+        return repr(obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        parts = [type(obj).__name__]
+        for f in dataclasses.fields(obj):
+            parts.append(f"{f.name}={canonical(getattr(obj, f.name))}")
+        return "(" + " ".join(parts) + ")"
+    if isinstance(obj, (list, tuple)):
+        return "[" + ",".join(canonical(x) for x in obj) + "]"
+    if isinstance(obj, np.generic):
+        # numpy>=2 reprs carry an "np.int64(...)" wrapper; .item()
+        # normalizes both numpy generations onto the python repr
+        return repr(obj.item())
+    if isinstance(obj, (str, bytes, bool, int, float)):
+        return repr(obj)
+    return f"<{type(obj).__name__}:{obj!r}>"
+
+
+def plan_digest(planned) -> str:
+    """Short stable digest of one plan tree (stage temp naming and
+    cache-entry labels)."""
+    return hashlib.sha256(canonical(planned).encode()).hexdigest()[:12]
+
+
+# --------------------------------------------------------- table stamps
+
+_DIGEST_ATTR = "_nds_content_sha256"
+
+
+def table_digest(table) -> str:
+    """Full-content sha256 of a HostTable, memoized on the object (one
+    hash per table per process; DML replaces table objects, so a stale
+    memo cannot survive a mutation)."""
+    memo = getattr(table, _DIGEST_ATTR, None)
+    if memo is not None:
+        return memo
+    h = hashlib.sha256()
+    for name in sorted(table.columns):
+        col = table.columns[name]
+        h.update(name.encode())
+        h.update(repr(col.dtype).encode())
+        vals = np.ascontiguousarray(col.values)
+        h.update(str(vals.dtype).encode())
+        h.update(str(vals.shape).encode())
+        h.update(vals)
+        if col.null_mask is not None:
+            h.update(b"#null")
+            h.update(np.ascontiguousarray(col.null_mask))
+        if col.dictionary is not None:
+            h.update(b"#dict")
+            # object arrays have no stable buffer: hash the decoded
+            # text form (dictionaries are sorted-unique, so this is
+            # deterministic for identical content)
+            h.update("\x00".join(
+                str(v) for v in col.dictionary).encode())
+    digest = h.hexdigest()
+    try:
+        setattr(table, _DIGEST_ATTR, digest)
+    except Exception:  # noqa: BLE001 - slotted table: recompute next time
+        pass
+    return digest
+
+
+def table_stamp(table) -> str:
+    """One table's contribution to a fingerprint: identity + shape +
+    content."""
+    return (f"{table.name}|rows={table.nrows}"
+            f"|sha256={table_digest(table)}")
+
+
+def scan_tables(planned) -> list:
+    """Sorted unique table names scanned anywhere in a plan (root +
+    scalar subplans + any extra roots an executor substitutes in)."""
+    from nds_tpu.sql import plan as P
+    roots = []
+    if isinstance(planned, P.PlannedQuery):
+        roots = [planned.root, *planned.scalar_subplans]
+    elif planned is not None:
+        roots = [planned]
+    names = set()
+    for root in roots:
+        for node in P.walk_plan(root):
+            if isinstance(node, P.Scan):
+                names.add(node.table)
+    return sorted(names)
+
+
+# ----------------------------------------------------------- fingerprint
+
+def fingerprint(planned, tables: dict, *, kind: str,
+                parts: dict | None = None,
+                extra_roots: list | None = None) -> str:
+    """sha256 hex key for one compilable unit.
+
+    ``kind`` names the program family (executor class / "compact" /
+    "chunkscan"); ``parts`` carries every scalar that shapes the
+    program (slack, precision, platform, jax versions, mesh shape...);
+    ``extra_roots`` adds plan trees outside the PlannedQuery proper
+    (the partial-agg merge plan). Tables are stamped by CONTENT, so a
+    same-shape warehouse with different rows misses instead of serving
+    stale baked constants."""
+    h = hashlib.sha256()
+    h.update(f"fp_v{FP_VERSION}".encode())
+    h.update(code_epoch().encode())
+    h.update(kind.encode())
+    h.update(canonical(planned).encode())
+    for root in (extra_roots or []):
+        h.update(canonical(root).encode())
+    names = scan_tables(planned)
+    for root in (extra_roots or []):
+        names = sorted(set(names) | set(scan_tables(root)))
+    for name in names:
+        t = tables.get(name)
+        if t is None:
+            h.update(f"{name}|<unregistered>".encode())
+        else:
+            h.update(table_stamp(t).encode())
+    for k in sorted(parts or {}):
+        h.update(f"{k}={parts[k]!r}".encode())
+    return h.hexdigest()

@@ -1,0 +1,312 @@
+"""Persistent AOT plan-cache store: one directory, one entry per
+fingerprint, every payload sha256-stamped.
+
+Layout (``<root>/<fp[:2]>/<fp>/``):
+
+- ``payload-<sha16>.bin`` — pickle of the executor-specific payload
+  dict (the serialized XLA executable plus its pytree defs and
+  host-side trace byproducts such as output string dictionaries),
+  named by a prefix of its own sha256 so the file is immutable once
+  referenced;
+- ``manifest.json`` — metadata + the payload's file name and full
+  sha256 (the same digest-manifest idea io/integrity.py uses for
+  warehouse artifacts, specialized to the single-entry shape so
+  ``ndscache verify`` and the load path share one verdict).
+
+Failure policy (the ISSUE's hard rule): a cache problem is NEVER a
+query failure. Any read-side anomaly — torn payload, digest mismatch,
+version skew, an unpicklable blob from a different jax — warns once on
+stderr, bumps ``compile_cache_errors_total``, quarantines the entry
+(best effort, skipped in readonly mode), and returns a miss so the
+caller falls through to a fresh compile. Writes are atomic and
+manifest-last: the content-named payload lands first (pid-suffixed
+tmp + ``os.replace``), then the manifest that references it — so a
+reader holding ANY complete manifest always finds the complete
+payload it names, even while another process re-persists the same
+fingerprint. A superseded payload file (same fingerprint, different
+bytes) lingers until ``prune`` removes the entry; deleting it inline
+could yank the file out from under a reader that already loaded the
+older manifest.
+
+Metrics: ``compile_cache_hits_total`` / ``compile_cache_misses_total``
+/ ``compile_cache_errors_total`` and the byte counters
+``compile_cache_bytes_read_total`` / ``compile_cache_bytes_written_total``
+(per-query deltas surface as the BenchReport ``cache`` block).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+
+from nds_tpu.io.integrity import write_json_atomic
+from nds_tpu.obs import metrics as obs_metrics
+
+PAYLOAD_PREFIX = "payload-"
+MANIFEST_NAME = "manifest.json"
+
+
+def _payload_name(sha: str) -> str:
+    return f"{PAYLOAD_PREFIX}{sha[:16]}.bin"
+
+# manifest format version; payload compatibility itself is governed by
+# the fingerprint (FP_VERSION + code epoch + jax versions)
+STORE_VERSION = 1
+
+
+def _warn(msg: str) -> None:
+    obs_metrics.counter("compile_cache_errors_total").inc()
+    print(f"PLAN-CACHE WARNING: {msg}")
+
+
+class PlanCache:
+    """Disk-backed compile-once store shared by every placement
+    executor and every process pointed at the same directory."""
+
+    def __init__(self, root: str, readonly: bool = False):
+        self.root = os.path.abspath(root)
+        self.readonly = readonly
+        if not readonly:
+            os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------ paths
+
+    def entry_dir(self, fp: str) -> str:
+        return os.path.join(self.root, fp[:2], fp)
+
+    def _manifest_path(self, fp: str) -> str:
+        return os.path.join(self.entry_dir(fp), MANIFEST_NAME)
+
+    def _payload_path(self, fp: str, manifest: dict) -> str | None:
+        """Path of the payload file the manifest references, or None
+        when the reference is absent/unsafe (treated as corrupt)."""
+        name = manifest.get("payload")
+        if (not isinstance(name, str) or os.path.basename(name) != name
+                or not name.startswith(PAYLOAD_PREFIX)):
+            return None
+        return os.path.join(self.entry_dir(fp), name)
+
+    def payload_path(self, fp: str) -> str | None:
+        """Resolve the live payload file for ``fp`` via its manifest
+        (admin/test helper; the read path resolves inline)."""
+        try:
+            with open(self._manifest_path(fp)) as f:
+                return self._payload_path(fp, json.load(f))
+        except (OSError, ValueError):
+            return None
+
+    # ------------------------------------------------------------- read
+
+    def get(self, fp: str, expect_kind: str | None = None):
+        """Payload dict for ``fp``, or None (miss). Every anomaly
+        degrades to a miss with a warning + metric; the entry is
+        quarantined so the next process does not re-pay the failed
+        read."""
+        manifest_path = self._manifest_path(fp)
+        if not os.path.exists(manifest_path):
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as exc:
+            _warn(f"unreadable manifest for {fp[:12]}… "
+                  f"({type(exc).__name__}: {exc}); recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        if manifest.get("store_version") != STORE_VERSION:
+            _warn(f"store version skew for {fp[:12]}… "
+                  f"({manifest.get('store_version')!r} != "
+                  f"{STORE_VERSION}); recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        payload_path = self._payload_path(fp, manifest)
+        if payload_path is None:
+            _warn(f"manifest for {fp[:12]}… names no payload; "
+                  f"recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        try:
+            with open(payload_path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            _warn(f"unreadable payload for {fp[:12]}… ({exc}); "
+                  f"recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        actual = hashlib.sha256(blob).hexdigest()
+        if actual != manifest.get("sha256"):
+            _warn(f"corrupt entry {fp[:12]}…: sha256 expected "
+                  f"{manifest.get('sha256')}, got {actual}; "
+                  f"recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        if expect_kind and manifest.get("kind") != expect_kind:
+            _warn(f"kind mismatch for {fp[:12]}…: entry is "
+                  f"{manifest.get('kind')!r}, wanted {expect_kind!r}; "
+                  f"recompiling fresh")
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        try:
+            payload = pickle.loads(blob)
+        except Exception as exc:  # noqa: BLE001 - any unpickle failure
+            _warn(f"unpicklable payload for {fp[:12]}… "
+                  f"({type(exc).__name__}: {exc}); recompiling fresh")
+            self._quarantine(fp)
+            obs_metrics.counter("compile_cache_misses_total").inc()
+            return None
+        # NOT a hit yet: aot.load_cached counts the hit only after the
+        # blob deserializes against the live backend and matches the
+        # query's buffer signature — a degraded load must read as a
+        # miss, or the BenchReport cache block would call a query "hit"
+        # that actually compiled fresh
+        obs_metrics.counter("compile_cache_bytes_read_total").inc(
+            float(len(blob)))
+        return payload
+
+    # ------------------------------------------------------------ write
+
+    def put(self, fp: str, payload: dict, meta: dict | None = None
+            ) -> bool:
+        """Persist an entry atomically. Returns False (without raising)
+        in readonly mode or on any write failure — caching is an
+        optimization, never a query hazard."""
+        if self.readonly:
+            return False
+        try:
+            blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            sha = hashlib.sha256(blob).hexdigest()
+            # payload first (content-named, so the file is immutable
+            # once it exists), manifest last: any complete manifest a
+            # reader picks up references a complete payload
+            payload_path = os.path.join(self.entry_dir(fp),
+                                        _payload_name(sha))
+            os.makedirs(os.path.dirname(payload_path), exist_ok=True)
+            tmp = f"{payload_path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, payload_path)
+            manifest = {
+                "store_version": STORE_VERSION,
+                "fingerprint": fp,
+                "payload": os.path.basename(payload_path),
+                "sha256": sha,
+                "size_bytes": len(blob),
+                "created_unix": int(time.time()),
+                **(meta or {}),
+            }
+            write_json_atomic(self._manifest_path(fp), manifest)
+        except Exception as exc:  # noqa: BLE001 - cache write best-effort
+            _warn(f"failed to persist {fp[:12]}… "
+                  f"({type(exc).__name__}: {exc})")
+            return False
+        obs_metrics.counter("compile_cache_bytes_written_total").inc(
+            float(len(blob)))
+        return True
+
+    # ------------------------------------------------- admin (ndscache)
+
+    def _quarantine(self, fp: str) -> None:
+        """Move a bad entry out of the lookup path so every later
+        process misses cleanly instead of re-diagnosing it. Best
+        effort; readonly caches leave the entry in place."""
+        if self.readonly:
+            return
+        d = self.entry_dir(fp)
+        try:
+            os.rename(d, f"{d}.corrupt-{os.getpid()}")
+        except OSError:
+            pass
+
+    def entries(self) -> list:
+        """Every readable manifest, sorted by fingerprint."""
+        out = []
+        if not os.path.isdir(self.root):
+            return out
+        for shard in sorted(os.listdir(self.root)):
+            sdir = os.path.join(self.root, shard)
+            if not os.path.isdir(sdir):
+                continue
+            for fp in sorted(os.listdir(sdir)):
+                if ".corrupt-" in fp:
+                    # quarantined by a failed read: out of the lookup
+                    # path, not part of the live inventory (prune
+                    # --corrupt deletes the husks)
+                    continue
+                mpath = os.path.join(sdir, fp, MANIFEST_NAME)
+                if not os.path.exists(mpath):
+                    continue
+                try:
+                    with open(mpath) as f:
+                        out.append(json.load(f))
+                except (OSError, ValueError):
+                    out.append({"fingerprint": fp, "unreadable": True})
+        return out
+
+    def verify(self) -> list:
+        """Re-hash every payload against its manifest; returns the
+        offending fingerprints (missing payload, digest mismatch,
+        unreadable manifest)."""
+        bad = []
+        for m in self.entries():
+            fp = m.get("fingerprint", "?")
+            if m.get("unreadable"):
+                bad.append(fp)
+                continue
+            payload_path = self._payload_path(fp, m)
+            if payload_path is None:
+                bad.append(fp)
+                continue
+            try:
+                with open(payload_path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                bad.append(fp)
+                continue
+            if hashlib.sha256(blob).hexdigest() != m.get("sha256"):
+                bad.append(fp)
+        return bad
+
+    def prune(self, keep_days: float | None = None,
+              jax_version: str | None = None,
+              corrupt: bool = False) -> list:
+        """Delete entries older than ``keep_days``, built by a jax
+        other than ``jax_version``, or failing verification
+        (``corrupt=True``). Returns the removed fingerprints."""
+        import shutil
+        removed = []
+        bad = set(self.verify()) if corrupt else set()
+        now = time.time()
+        if corrupt and os.path.isdir(self.root):
+            # quarantined husks left by failed reads
+            for shard in sorted(os.listdir(self.root)):
+                sdir = os.path.join(self.root, shard)
+                if not os.path.isdir(sdir):
+                    continue
+                for fp in sorted(os.listdir(sdir)):
+                    if ".corrupt-" in fp:
+                        shutil.rmtree(os.path.join(sdir, fp),
+                                      ignore_errors=True)
+                        removed.append(fp)
+        for m in self.entries():
+            fp = m.get("fingerprint", "?")
+            drop = m.get("unreadable", False) or fp in bad
+            if (keep_days is not None and not drop
+                    and now - m.get("created_unix", 0)
+                    > keep_days * 86400):
+                drop = True
+            if (jax_version is not None and not drop
+                    and m.get("jax") != jax_version):
+                drop = True
+            if drop:
+                shutil.rmtree(self.entry_dir(fp), ignore_errors=True)
+                removed.append(fp)
+        return removed

@@ -178,7 +178,16 @@ class _PartialAggExecutor(_PhaseBExecutor):
             side["dicts"] = dicts
             return row, outs, tr.total_overflow()
 
+        # ndslint: waive[NDS111] -- builds the traced callable only; AOT lower+compile routes through cache.aot (_compile_or_load)
         return jax.jit(fn), side
+
+    def _fingerprint_roots(self) -> list:
+        """The merge substitution shapes the program but lives OUTSIDE
+        the PlannedQuery (the trace swaps it in at id-matched nodes):
+        fold the merge plans into the fingerprint or a plain phase-B
+        program of the same plan would key-collide. The partials
+        table's content stamp rides along via the merge plan's scan."""
+        return list(self._extra_roots)
 
 
 class _ForwardResult:
@@ -564,8 +573,10 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         TaskFailureCollector.notify(
                             f"partial-agg chunk [{s}:{e}] overflow; "
                             f"recompiling with slack={slack}")
+                        from nds_tpu.cache import aot as cache_aot
                         jitted, side = ex._compile(planned_a, slack)
-                        compiled = jitted.lower(bufs).compile()
+                        compiled = cache_aot.lower_and_compile(jitted,
+                                                               bufs)
                 finally:
                     memwatch.sub_live(win)
                 parts.append(ex._materialize(planned_a, row_h, outs_h,
@@ -677,7 +688,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return keep
 
         try:
-            jitted = jax.jit(fn)
+            compiled = None
             keep_np = np.empty(n, dtype=bool)
             for start in range(0, n, C):
                 # same between-chunk control point as the partial-agg
@@ -708,9 +719,16 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 win = sum(b.nbytes for b in bufs.values())
                 memwatch.add_live(win)
                 try:
+                    if compiled is None:
+                        # every chunk shares one static shape (the tail
+                        # pads): AOT-compile once on the first chunk's
+                        # buffers, consulting the persistent plan cache
+                        # so a warm process scans with zero compiles
+                        compiled = self._keep_mask_compiled(
+                            table, scans, need_cols, C, fn, bufs)
                     keep_np[start:stop] = np.asarray(
-                        jitted(bufs,
-                               jnp.int32(stop - start)))[:stop - start]
+                        compiled(bufs,
+                                 jnp.int32(stop - start)))[:stop - start]
                 finally:
                     memwatch.sub_live(win)
             if skipped:
@@ -731,6 +749,27 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 f"chunked scan fell back to full rows for {table}: "
                 f"{type(exc).__name__}: {exc}")
             return np.ones(n, dtype=bool)
+
+    def _keep_mask_compiled(self, table: str, scans: list,
+                            need_cols: list, C: int, fn, bufs: dict):
+        """AOT form of the phase-A chunk-scan program, consulted
+        against the persistent plan cache (kind ``chunkscan``): the
+        fingerprint folds in the scans' filter trees (extra roots),
+        the streamed table's content stamp, the chunk shape, and the
+        compute dtype. A warm hit skips the trace entirely — which
+        also skips the per-predicate ``skipped`` bookkeeping, matching
+        the baked behavior of the program it restores."""
+        from nds_tpu.cache import aot as cache_aot
+        pc, fp = cache_aot.try_fingerprint(
+            "chunkscan",
+            {"table": table, "chunk": C, "cols": tuple(need_cols),
+             "float_dtype": str(self.float_dtype)},
+            tables=self.tables, extra_roots=list(scans))
+        compiled, _extra, _hit = cache_aot.cached_compile(
+            # ndslint: waive[NDS111] -- builds the chunk-scan trace callable; lower+compile happens inside cache.aot
+            pc, fp, "chunkscan", lambda: jax.jit(fn),
+            (bufs, jnp.int32(0)))
+        return compiled
 
 
 def make_chunked_factory(stream_bytes: int = DEFAULT_STREAM_BYTES,

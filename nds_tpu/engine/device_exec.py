@@ -376,7 +376,6 @@ class DeviceExecutor:
         # the key is its id(), and a recycled address must never serve
         # another query's staged split (advisor finding, round 5)
         self._stage_plans: dict[object, tuple] = {}
-        self._stage_seq = 0                  # collision-free temp names
         self._stage_fps: dict[str, str] = {}  # temp -> content md5
         # pending sub-program bills keyed by query key (async
         # interleaving: another query's _finish must not consume
@@ -457,13 +456,25 @@ class DeviceExecutor:
             plans = None
         if plans is None:
             subs, main = [], planned
+            base_digest = None
             while staging.plan_weight(main) > self.STAGE_WEIGHT:
                 cut = staging.choose_cut(main)
                 if cut is None:
                     break
-                # executor-local counter: collision-free temp names
-                self._stage_seq += 1
-                temp = f"__stage_{self._stage_seq}"
+                if base_digest is None:
+                    # DETERMINISTIC temp names (plan-digest + index, not
+                    # a process counter): the staged main plan's scan
+                    # buffer keys embed them, and the persistent AOT
+                    # plan cache (nds_tpu/cache/) can only hit across
+                    # processes when identical plans stage identical
+                    # names. Distinct plans get distinct digests, so
+                    # names stay collision-free; re-splits after
+                    # eviction re-mint the SAME names and
+                    # _register_staged's content fingerprint keeps the
+                    # buffers honest
+                    from nds_tpu.cache.fingerprint import plan_digest
+                    base_digest = plan_digest(planned)
+                temp = staging.stage_temp_name(base_digest, len(subs))
                 sub, main = staging.build_stage(main, cut, temp)
                 subs.append((sub, temp))
             plans = (planned, subs, main)
@@ -516,10 +527,11 @@ class DeviceExecutor:
         evicted — including the recursive sub-program entries keyed off
         it — so _stage_plans/_stage_timings/_compiled never hold a
         stale split for a plan whose pinning ref is gone (and never
-        grow unboundedly across a long run). A re-split after eviction
-        mints FRESH temp names (_stage_seq), so the evicted split's
-        temp tables and their host/device caches must free here or
-        eviction+rerun cycles leak every old intermediate."""
+        grow unboundedly across a long run). The evicted split's temp
+        tables and their host/device caches free here too: a DIFFERENT
+        plan rebound to this key would stage different digest-named
+        temps, and eviction+rerun cycles must not leak the old
+        intermediates."""
         for d in (self._stage_plans, self._stage_timings, self._compiled):
             for k in [key] + [k for k in d
                               if self._stage_key_derives_from(k, key)]:
@@ -615,24 +627,7 @@ class DeviceExecutor:
             entry = self._compiled.setdefault(
                 key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
             if "compiled" not in entry:
-                # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
-                t0 = _time.perf_counter()
-                with tracer.span("device.compile", slack=entry["slack"]):
-                    jitted, side = self._compile(planned, entry["slack"])
-                    bufs = self._collect_buffers(planned)
-                    # AOT-compile now so compile cost is attributed
-                    # separately from steady-state execution
-                    entry["compiled"] = jitted.lower(bufs).compile()
-                entry["side"] = side
-                timings["compile_ms"] += (
-                    # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; the execute bracket closes via device_get in _finish_traced
-                    _time.perf_counter() - t0) * 1000
-                # overflow retries recompile the SAME query: count them
-                # apart from first compiles (distributed executor
-                # semantics, README counter contract)
-                obs_metrics.counter(
-                    "recompiles_total" if entry.pop("recompile", False)
-                    else "compiles_total").inc()
+                self._compile_or_load(planned, entry, timings, tracer)
             bufs = self._collect_buffers(planned)
             # bytes the query reads from HBM-resident scan buffers: the
             # roofline denominator (achieved GB/s lands in scan_gbps at
@@ -657,6 +652,85 @@ class DeviceExecutor:
             row, outs, overflow = entry["compiled"](bufs)
         return _AsyncResult(self, planned, key, entry, timings, t1,
                             (row, outs, overflow), qspan)
+
+    # ------------------------------------------------- plan cache (AOT)
+
+    def _fingerprint_parts(self) -> dict:
+        """Executor-family facts every plan fingerprint folds in —
+        anything (beyond the plan and the tables) that changes the
+        traced program. Subclasses extend."""
+        return {
+            "float_dtype": str(self.float_dtype),
+            "scan_reduce": bool(
+                self.SCAN_REDUCE and os.environ.get(
+                    "NDS_TPU_SCAN_REDUCE", "1") != "0"),
+            "stage_weight": self.STAGE_WEIGHT,
+        }
+
+    def _fingerprint_roots(self) -> list:
+        """Plan trees OUTSIDE the PlannedQuery that still shape the
+        program (the partial-agg executor's merge plan)."""
+        return []
+
+    def _plan_fingerprint(self, planned, slack: float):
+        """(cache, fingerprint) for this staged plan at this slack, or
+        (None, None) when caching is off. A fingerprint failure is a
+        warned cache miss, never a query failure."""
+        from nds_tpu.cache import aot as cache_aot
+        return cache_aot.try_fingerprint(
+            type(self).__name__,
+            {"slack": slack, **self._fingerprint_parts()},
+            planned=planned, tables=self.tables,
+            extra_roots=self._fingerprint_roots())
+
+    def _compile_or_load(self, planned, entry: dict, timings: dict,
+                         tracer) -> None:
+        """Fill ``entry['compiled']``/``entry['side']`` for a plan: a
+        verified plan-cache hit deserializes the persisted executable
+        (0 compiles, ``compile_ms`` stays 0, ``cache_load_ms``
+        recorded); otherwise compile as always and persist for the
+        next process."""
+        import time as _time
+        from nds_tpu.cache import aot as cache_aot
+        pc, fp = self._plan_fingerprint(planned, entry["slack"])
+        if fp:
+            with tracer.span("cache.load", fp=fp[:12]):
+                bufs = self._collect_buffers(planned)
+                hit = cache_aot.load_cached(pc, fp,
+                                            type(self).__name__,
+                                            timings, args=(bufs,))
+            if hit is not None:
+                entry["compiled"], extra = hit
+                entry["side"] = {"dicts": extra.get("dicts")}
+                # an overflow retry served from another process's
+                # persisted recompile consumed no compile here
+                entry.pop("recompile", None)
+                return
+        # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
+        t0 = _time.perf_counter()
+        with tracer.span("device.compile", slack=entry["slack"]):
+            jitted, side = self._compile(planned, entry["slack"])
+            bufs = self._collect_buffers(planned)
+            # AOT-compile now so compile cost is attributed
+            # separately from steady-state execution (fresh when the
+            # blob will persist: see lower_and_compile)
+            entry["compiled"] = cache_aot.lower_and_compile(
+                jitted, bufs, fresh=cache_aot.fresh_for(pc, fp))
+        entry["side"] = side
+        timings["compile_ms"] += (
+            # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; the execute bracket closes via device_get in _finish_traced
+            _time.perf_counter() - t0) * 1000
+        # overflow retries recompile the SAME query: count them
+        # apart from first compiles (distributed executor
+        # semantics, README counter contract)
+        obs_metrics.counter(
+            "recompiles_total" if entry.pop("recompile", False)
+            else "compiles_total").inc()
+        if fp:
+            cache_aot.persist(pc, fp, type(self).__name__,
+                              entry["compiled"],
+                              {"dicts": side.get("dicts")},
+                              meta={"slack": entry["slack"]})
 
     # capacity at or above which results compact ON DEVICE before the
     # host transfer: a masked full-capacity result of a 576k-slot query
@@ -693,10 +767,22 @@ class DeviceExecutor:
                        [(jax.ShapeDtypeStruct(a.shape, a.dtype),
                          jax.ShapeDtypeStruct(v.shape, v.dtype))
                         for a, v in outs_d])
-            cf = jax.jit(fn).lower(*avatars).compile()
+            from nds_tpu.cache import aot as cache_aot
+            pc, fp = cache_aot.try_fingerprint(
+                "compact", {"n": n, "sig": sig})
+            cf, _extra, hit = cache_aot.cached_compile(
+                # ndslint: waive[NDS111] -- builds the compaction trace callable; lower+compile happens inside cache.aot
+                pc, fp, "compact", lambda: jax.jit(fn), avatars,
+                timings=timings)
             # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; no device work is in flight here
             dt = (_time.perf_counter() - t0) * 1000
-            timings["compile_ms"] = timings.get("compile_ms", 0.0) + dt
+            if not hit:
+                timings["compile_ms"] = (timings.get("compile_ms", 0.0)
+                                         + dt)
+            # hit or miss, the bracket is fingerprint + compile-or-load
+            # time, not device execution: _finish_traced shifts the
+            # execute window past it (a hit's deserialize cost is
+            # already billed to cache_load_ms by load_cached)
             timings["__compact_compile_ms"] = dt
             self._compiled[key] = cf
         return cf
@@ -826,6 +912,7 @@ class DeviceExecutor:
             side["dicts"] = dicts
             return row, outs, tr.total_overflow()
 
+        # ndslint: waive[NDS111] -- builds the traced callable only; AOT lower+compile routes through cache.aot (_compile_or_load)
         return jax.jit(fn), side
 
     # -------------------------------------------------------------- buffers

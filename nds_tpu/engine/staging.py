@@ -40,6 +40,18 @@ from nds_tpu.sql import plan as P
 MIN_CUT_WEIGHT = 6
 
 
+def stage_temp_name(plan_digest: str, index: int) -> str:
+    """Deterministic temp-table name for the index-th cut of a plan.
+
+    The digest (cache/fingerprint.plan_digest of the ORIGINAL plan)
+    replaces the old per-executor counter: staged buffer keys embed the
+    temp name, so the persistent AOT plan cache can only serve a
+    staged main program across processes when identical plans stage
+    identically-named temps. Distinct plans yield distinct digests, so
+    names stay collision-free within an executor."""
+    return f"__stage_{plan_digest}_{index}"
+
+
 def _uniq_nodes(*roots) -> set:
     seen = set()
     for r in roots:

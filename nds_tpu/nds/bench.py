@@ -137,6 +137,12 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
     # snapshot file per phase
     snap_cfg = cfg.get("metrics_snap") or {}
 
+    # YAML ``cache: {dir, readonly}`` (README "Plan cache"): one
+    # persistent AOT plan cache shared by every phase subprocess, so
+    # the throughput rounds replay the power round's compiles as hits
+    from nds_tpu import cache as plan_cache
+    plan_cache.export_env(cfg.get("cache"))
+
     def _snap_env(phase_name: str) -> dict | None:
         snap_dir = snap_cfg.get("dir")
         if not snap_dir:

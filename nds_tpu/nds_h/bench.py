@@ -71,6 +71,11 @@ def run_full_bench(cfg: dict) -> dict:
     load_report = os.path.join(report_dir, "load_report.txt")
     metrics = {}
 
+    # YAML ``cache: {dir, readonly}`` (README "Plan cache"): one
+    # persistent AOT plan cache shared by every phase subprocess
+    from nds_tpu import cache as plan_cache
+    plan_cache.export_env(cfg.get("cache"))
+
     if not cfg.get("skip", {}).get("data_gen", False):
         _run([sys.executable, "-m", "nds_tpu.nds_h.gen_data",
               str(scale), str(parallel), raw_dir, "--overwrite_output"],

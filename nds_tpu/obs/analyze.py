@@ -130,6 +130,13 @@ def attribute_query(summary: dict) -> dict:
             row["ladder"] = list(summary["ladder"])
         if summary.get("promoted_back"):
             row["promoted_back"] = True
+    # plan-cache activity (nds_tpu/cache/; README "Plan cache"):
+    # hits/misses per query — absent when no cache was active, so
+    # pre-cache run dirs analyze byte-identically
+    cache = summary.get("cache")
+    if isinstance(cache, dict) and "hits" in cache:
+        row["cache_hits"] = int(cache.get("hits", 0))
+        row["cache_misses"] = int(cache.get("misses", 0))
     return row
 
 
@@ -268,10 +275,12 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         rows = sorted(rows, key=lambda r: order[r["query"]])[:top]
     w = max([len(r["query"]) for r in rows] + [5])
     has_placement = any("placement" in r for r in rows)
+    has_cache = any("cache_hits" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
         f"{short.get(c, c):>9}" for c in cols)
-        + ("  placement" if has_placement else "") + "  status")
+        + ("  placement" if has_placement else "")
+        + ("  cache" if has_cache else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
         vals = [r["categories"][c] for c in CATEGORIES]
@@ -282,10 +291,25 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             if r.get("reschedules"):
                 p += f"(+{r['reschedules']})"
             place = f"  {p:>9}"
+        cache_col = ""
+        if has_cache:
+            if "cache_hits" in r:
+                # hit when every consult hit; miss when any compile
+                # fell through; "err" when the block exists with zero
+                # consults (fingerprint failure — attach_cache only
+                # emits an all-zero block when errors moved); "-" for
+                # queries the cache never saw
+                hits, misses = r["cache_hits"], r["cache_misses"]
+                verdict = ("err" if not hits and not misses else
+                           "hit" if misses == 0 else
+                           "miss" if hits == 0 else "part")
+            else:
+                verdict = "-"
+            cache_col = f"  {verdict:>5}"
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + f"  {r['status']}")
+            + place + cache_col + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -351,6 +375,25 @@ def diff_times(base: dict, cur: dict, pct: float = 10.0,
     }
 
 
+def cache_hit_rate(analysis: dict) -> "dict | None":
+    """Run-level plan-cache summary from the per-query rows:
+    ``{"hits", "misses", "rate"}`` (rate = hits / consults), or None
+    when no query carried a cache block (cache off — pre-cache run
+    dirs keep diffing byte-identically)."""
+    hits = misses = 0
+    seen = False
+    for r in analysis.get("queries", []):
+        if "cache_hits" in r:
+            seen = True
+            hits += r["cache_hits"]
+            misses += r["cache_misses"]
+    if not seen:
+        return None
+    total = hits + misses
+    return {"hits": hits, "misses": misses,
+            "rate": round(hits / total, 4) if total else None}
+
+
 def diff_runs(base: dict, cur: dict, pct: float = 10.0,
               abs_ms: float = 50.0) -> dict:
     """Query-by-query diff of two ``analyze_run`` results, gated on
@@ -388,6 +431,14 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
         "passed": not d["regressions"] and not d["removed"]
                   and not newly_failed,
     })
+    # plan-cache hit-rate per run, the compile-count-change flag's
+    # natural companion: a run whose compile counts dropped to 0
+    # should show a warm cache explaining WHY (README "Plan cache").
+    # Only when a side actually carried a cache block — pre-cache run
+    # dirs keep diffing byte-identically
+    chr_base, chr_cur = cache_hit_rate(base), cache_hit_rate(cur)
+    if chr_base is not None or chr_cur is not None:
+        d["cache_hit_rate"] = {"base": chr_base, "cur": chr_cur}
     return d
 
 
@@ -415,6 +466,18 @@ def format_diff(d: dict) -> str:
             f"{e['base_compiles']} compile(s)/"
             f"{e['base_compile_ms']:.0f} ms -> {e['cur_compiles']}/"
             f"{e['cur_compile_ms']:.0f} ms")
+    chr_ = d.get("cache_hit_rate") or {}
+    if any(chr_.get(k) for k in ("base", "cur")):
+        def _rate(r):
+            if not r:
+                return "off"
+            if r["rate"] is None:
+                return "0 consults"
+            return (f"{r['rate'] * 100.0:.0f}% "
+                    f"({r['hits']}/{r['hits'] + r['misses']})")
+        lines.append(f"  cache       hit-rate "
+                     f"{_rate(chr_.get('base'))} -> "
+                     f"{_rate(chr_.get('cur'))}")
     lines.append(f"  {len(d['noise'])} querie(s) within noise threshold")
     lines.append("DIFF " + ("OK" if d["passed"] else "FAILED"))
     return "\n".join(lines)
@@ -560,8 +623,8 @@ def render_html(analysis: dict, diff: dict | None = None,
         "<h2>Per-query time attribution</h2>", _legend(),
         "<table><tr><th class='q'>query</th><th>wall ms</th>"
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
-        "<th>retries</th><th>placement</th><th>mem HWM</th>"
-        "<th>status</th></tr>",
+        "<th>cache</th><th>retries</th><th>placement</th>"
+        "<th>mem HWM</th><th>status</th></tr>",
     ]
     for row in analysis["queries"]:
         place = row.get("placement", "")
@@ -573,11 +636,17 @@ def render_html(analysis: dict, diff: dict | None = None,
             place = _esc(place)
         if row.get("promoted_back"):
             place += " &uarr;"
+        if "cache_hits" in row:
+            cache = (f"{row['cache_hits']} hit / "
+                     f"{row['cache_misses']} miss")
+        else:
+            cache = ""
         out.append(
             f"<tr><td class='q'>{_esc(row['query'])}</td>"
             f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
             f"<td>{row['residual_ms']:.1f}</td>"
-            f"<td>{row['compiles']}</td><td>{row['retries']}</td>"
+            f"<td>{row['compiles']}</td><td>{cache}</td>"
+            f"<td>{row['retries']}</td>"
             f"<td>{place}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")

@@ -191,9 +191,86 @@ class DistributedExecutor(dx.DeviceExecutor):
                 in_specs=({k: P_(self.axes) for k in sharded_keys},
                           {k: P_() for k in repl_keys}),
                 out_specs=P_())
+            # ndslint: waive[NDS111] -- builds the traced callable only; AOT lower+compile routes through cache.aot in _execute_traced
             return jax.jit(wrapped), sharded_keys, repl_keys
 
         return build, side
+
+    # ------------------------------------------------- plan cache (AOT)
+
+    def _fingerprint_parts(self) -> dict:
+        parts = super()._fingerprint_parts()
+        parts.update({
+            "mesh_shape": tuple(self.mesh.devices.shape),
+            "mesh_axes": tuple(self.mesh.axis_names),
+            "n_dev": self.n_dev,
+            "shard_threshold": self.shard_threshold,
+            "explicit_shard": (tuple(sorted(self._explicit_shard))
+                               if self._explicit_shard is not None
+                               else None),
+        })
+        return parts
+
+    def _cache_for_sharded(self, planned, slack: float):
+        """Plan-cache handle for the sharded program — single-process
+        worlds only: a multi-controller executable spans every rank's
+        devices, and per-rank deserialization against a local client
+        is not a supported jax path. Multi-process runs fall back to
+        jax's own persistent XLA cache (utils/xla_cache.py)."""
+        if self.multiprocess:
+            return None, None
+        return self._plan_fingerprint(planned, slack)
+
+    def _load_cached_sharded(self, planned, slack, state, side,
+                             timings, tracer) -> bool:
+        """Fill state[jitted/sk/rk] + side[dicts] from a verified
+        plan-cache hit; False on miss (compile as always). The
+        (cache, fingerprint) handle is stashed on ``state`` for
+        ``_persist_sharded`` — the fingerprint hashes the whole plan
+        tree, so a miss must not pay it twice."""
+        from nds_tpu.cache import aot as cache_aot
+        from nds_tpu.obs import metrics as obs_metrics
+        pc, fp = self._cache_for_sharded(planned, slack)
+        state["cache_handle"] = (pc, fp)
+        if not fp:
+            return False
+        # the hit/miss verdict is counted HERE, after the sharded
+        # key-split compat check load_cached cannot run itself
+        with tracer.span("cache.load", fp=fp[:12]):
+            bufs = self._collect_buffers(planned)
+            hit = cache_aot.load_cached(pc, fp, type(self).__name__,
+                                        timings, count=False)
+        if hit is None:
+            return False
+        compiled, extra = hit
+        sk, rk = extra.get("sk"), extra.get("rk")
+        ok = sk is not None and rk is not None
+        if ok and not cache_aot.call_compatible(
+                compiled,
+                {k: bufs[k] for k in sk if k in bufs},
+                {k: bufs[k] for k in rk if k in bufs}):
+            from nds_tpu.cache.store import _warn
+            _warn(f"sharded entry {fp[:12]}… is "
+                  f"signature-incompatible; recompiling fresh")
+            ok = False
+        obs_metrics.counter(
+            "compile_cache_hits_total" if ok
+            else "compile_cache_misses_total").inc()
+        if not ok:
+            return False
+        state["jitted"], state["sk"], state["rk"] = compiled, sk, rk
+        side["dicts"] = extra.get("dicts")
+        return True
+
+    def _persist_sharded(self, planned, slack, state, side) -> None:
+        from nds_tpu.cache import aot as cache_aot
+        pc, fp = state.pop("cache_handle", (None, None))
+        if fp:
+            cache_aot.persist(pc, fp, type(self).__name__,
+                              state["jitted"],
+                              {"sk": state["sk"], "rk": state["rk"],
+                               "dicts": side.get("dicts")},
+                              meta={"slack": slack})
 
     # survivor cap for turning a SHARDED filtered scan into a
     # replicated reduced build side (the broadcast-join move Spark AQE
@@ -329,24 +406,37 @@ class DistributedExecutor(dx.DeviceExecutor):
                 state.pop("jitted", None)
                 import gc
                 gc.collect()
-                # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
-                t0 = _time.perf_counter()
-                with tracer.span("device.compile", slack=slack):
-                    jitted, state["sk"], state["rk"] = build(slack)
-                    bufs = self._collect_buffers(planned)
-                    # AOT-compile (single-chip contract): compile cost
-                    # must be attributed separately from the execute
-                    # bracket, not hidden in the first timed call
-                    state["jitted"] = jitted.lower(
-                        {k: bufs[k] for k in state["sk"]},
-                        {k: bufs[k] for k in state["rk"]}).compile()
-                state["slack"] = slack
-                timings["compile_ms"] += (
-                    # ndslint: waive[NDS102] -- .compile() is synchronous; bracket ends when it returns
-                    _time.perf_counter() - t0) * 1000
-                obs_metrics.counter(
-                    "compiles_total" if attempt == 0
-                    else "recompiles_total").inc()
+                if self._load_cached_sharded(planned, slack, state,
+                                             side, timings, tracer):
+                    # persisted AOT hit: zero compiles this process
+                    # (compile_ms stays 0; cache_load_ms carries the
+                    # deserialize cost)
+                    state["slack"] = slack
+                else:
+                    from nds_tpu.cache import aot as cache_aot
+                    # ndslint: waive[NDS102] -- raw bracket feeds compile_ms; the span records it too
+                    t0 = _time.perf_counter()
+                    with tracer.span("device.compile", slack=slack):
+                        jitted, state["sk"], state["rk"] = build(slack)
+                        bufs = self._collect_buffers(planned)
+                        # AOT-compile (single-chip contract): compile
+                        # cost must be attributed separately from the
+                        # execute bracket, not hidden in the first
+                        # timed call
+                        state["jitted"] = cache_aot.lower_and_compile(
+                            jitted,
+                            {k: bufs[k] for k in state["sk"]},
+                            {k: bufs[k] for k in state["rk"]},
+                            fresh=cache_aot.fresh_for(*state.get(
+                                "cache_handle", (None, None))))
+                    state["slack"] = slack
+                    timings["compile_ms"] += (
+                        # ndslint: waive[NDS102] -- .compile() is synchronous; bracket ends when it returns
+                        _time.perf_counter() - t0) * 1000
+                    obs_metrics.counter(
+                        "compiles_total" if attempt == 0
+                        else "recompiles_total").inc()
+                    self._persist_sharded(planned, slack, state, side)
             bufs = self._collect_buffers(planned)
             shard_bufs = {k: bufs[k] for k in state["sk"]}
             repl_bufs = {k: bufs[k] for k in state["rk"]}

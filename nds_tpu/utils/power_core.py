@@ -173,11 +173,42 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
     degradation ladder schedule each query within it."""
     backend = config.get("engine.backend", "cpu")
     kwargs = schema_kwargs_for(suite, config)
+    # cache.dir/cache.readonly activate the persistent AOT plan cache
+    # for every executor this session schedules (README "Plan cache");
+    # configs without the keys leave the NDS_TPU_PLAN_CACHE env
+    # resolution in charge
+    from nds_tpu import cache as plan_cache
+    active_cache = plan_cache.configure_from(config)
     if backend in ("tpu", "distributed"):
-        # compiles amortize across driver invocations (same cache
-        # bench.py uses); harmless for repeated in-process queries
-        from nds_tpu.utils.xla_cache import enable as enable_xla_cache
-        enable_xla_cache()
+        from nds_tpu.utils import xla_cache
+        multiproc = False
+        if backend == "distributed":
+            # idempotent (session construction calls it again); needed
+            # NOW because the cache decision below depends on world
+            # size, which only exists after the runtime initializes
+            from nds_tpu.parallel import multihost
+            multiproc = multihost.maybe_initialize()
+        if active_cache is None or multiproc or active_cache.readonly:
+            # compiles amortize across driver invocations (same cache
+            # bench.py uses); harmless for repeated in-process queries.
+            # Multi-rank worlds keep this EVEN with a plan cache: the
+            # plan cache refuses multi-controller sharded programs
+            # (per-rank deserialization against a local client is not
+            # a supported jax path), so jax's own cache is the only
+            # compile amortization those programs get. READONLY plan
+            # caches keep it too: their misses never persist (the
+            # reloadability hazard below only bites blobs we write),
+            # so without jax's cache every miss would pay a full
+            # compile on every process start
+            xla_cache.enable()
+        else:
+            # NOT layered under the plan cache: an executable jax's
+            # compile cache serves back re-serializes into a blob that
+            # cannot reload ("Symbols not found" on XLA:CPU), so a
+            # plan-cache session must see only REAL compiles — and a
+            # prior session's enable() is process-sticky, so disable
+            # explicitly
+            xla_cache.disable()
     elif backend != "cpu":
         raise ValueError(f"unknown engine.backend {backend!r}")
     from nds_tpu.engine.scheduler import make_pipeline
@@ -499,6 +530,9 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                                    obs_metrics.snapshot())
         if mdelta:
             summary["metrics"] = mdelta
+        # plan-cache activity for THIS query (hits/misses/bytes +
+        # deserialize ms), derived from the same metrics delta
+        report.attach_cache(mdelta, timings)
         tlog.add(qname, elapsed_ms)
         progress["queries_completed"] += 1
         watchdog.beat(unit, query=qname, phase="done")
@@ -562,6 +596,13 @@ def add_config_args(parser) -> None:
                         help="append per-query Chrome trace-event JSONL "
                              "here (same as NDS_TPU_TRACE=path; see "
                              "README Observability)")
+    parser.add_argument("--cache_dir",
+                        help="persistent AOT plan-cache directory "
+                             "(cache.dir; same as NDS_TPU_PLAN_CACHE — "
+                             "README 'Plan cache')")
+    parser.add_argument("--cache_readonly", action="store_true",
+                        help="consult the plan cache but never write it "
+                             "(cache.readonly)")
 
 
 def config_from_args(args, default_backend: str = "tpu") -> EngineConfig:
@@ -574,6 +615,10 @@ def config_from_args(args, default_backend: str = "tpu") -> EngineConfig:
     overrides = {}
     if cli_backend is not None:
         overrides["engine.backend"] = cli_backend
+    if getattr(args, "cache_dir", None):
+        overrides["cache.dir"] = args.cache_dir
+    if getattr(args, "cache_readonly", False):
+        overrides["cache.readonly"] = "1"
     cfg = EngineConfig(getattr(args, "template", None),
                        getattr(args, "property_file", None), overrides)
     if "engine.backend" not in cfg.explicit:

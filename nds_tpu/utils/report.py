@@ -226,6 +226,36 @@ class BenchReport:
         if sched.get("promoted_back"):
             self.summary["promoted_back"] = True
 
+    def attach_cache(self, mdelta: dict | None,
+                     timings: dict | None = None) -> None:
+        """Record the query's persistent plan-cache activity (README
+        "Plan cache") as the ``cache`` block, derived from the
+        per-query metrics delta: ``{"hits": int, "misses": int}``
+        always when the cache was consulted, plus ``errors`` /
+        ``bytes_read`` / ``bytes_written`` / ``load_ms`` (deserialize
+        wall-clock from engineTimings' ``cache_load_ms``) when
+        non-zero. Absent entirely when no plan cache is active — the
+        pre-cache summary shape is unchanged."""
+        counters = (mdelta or {}).get("counters", {})
+        hits = counters.get("compile_cache_hits_total", 0)
+        misses = counters.get("compile_cache_misses_total", 0)
+        errors = counters.get("compile_cache_errors_total", 0)
+        if not (hits or misses or errors):
+            return
+        block = {"hits": int(hits), "misses": int(misses)}
+        if errors:
+            block["errors"] = int(errors)
+        for key, name in (("bytes_read",
+                           "compile_cache_bytes_read_total"),
+                          ("bytes_written",
+                           "compile_cache_bytes_written_total")):
+            if counters.get(name):
+                block[key] = int(counters[name])
+        load_ms = (timings or {}).get("cache_load_ms")
+        if load_ms:
+            block["load_ms"] = round(load_ms, 3)
+        self.summary["cache"] = block
+
     def attach_memory(self, hwm: dict | None) -> None:
         """Record the per-query device-memory high-water mark
         (obs/memwatch.py) as the ``memory`` block:

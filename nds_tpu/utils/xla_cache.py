@@ -18,14 +18,59 @@ _DEFAULT_DIR = os.path.join(
 
 
 def enable(cache_dir: str | None = None) -> str:
-    """Turn on jax's persistent compilation cache. Idempotent."""
+    """Turn on jax's persistent compilation cache. Idempotent.
+
+    The cache dir is suffixed by a digest of the XLA_FLAGS in effect:
+    jax's cache key EXCLUDES codegen debug options, so an entry
+    compiled under different flags would otherwise be served silently
+    — observed as "Symbols not found" when the plan cache
+    (nds_tpu/cache/) re-serializes an executable a stale entry built
+    with parallel-split codegen (cache.ensure_reloadable_codegen pins
+    the split count precisely so executables can reload)."""
+    import hashlib
+
     import jax
 
     cache_dir = cache_dir or os.environ.get(
         "NDS_TPU_XLA_CACHE", _DEFAULT_DIR)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if flags:
+        cache_dir = os.path.join(
+            cache_dir,
+            "flags-" + hashlib.sha256(flags.encode()).hexdigest()[:10])
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # a prior disable() must not stick
+    jax.config.update("jax_enable_compilation_cache", True)
     # cache every program: benchmark queries are all worth persisting
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _drop_memoized_verdict()
     return cache_dir
+
+
+def disable() -> None:
+    """Turn jax's persistent compilation cache OFF (process-wide
+    setting). The plan cache (nds_tpu/cache/) requires this: an
+    executable jax's cache serves back re-serializes into a blob that
+    cannot reload, so plan-cache sessions must see only REAL
+    compiles."""
+    import jax
+    jax.config.update("jax_enable_compilation_cache", False)
+    _drop_memoized_verdict()
+
+
+def _drop_memoized_verdict() -> None:
+    """``compilation_cache.is_cache_used`` memoizes its on/off verdict
+    at the FIRST compile and then ignores every later
+    ``jax_enable_compilation_cache`` update, so an enable()/disable()
+    after any compile would silently not take. ``reset_cache()`` drops
+    the memo (and the dir-bound cache singleton) so the next compile
+    re-reads the config."""
+    try:
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 - private API: a jax that moved it
+        # presumably also dropped the memoization; the config update
+        # above is then sufficient, and session creation must not die
+        pass

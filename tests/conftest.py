@@ -76,6 +76,15 @@ if "collective_call_terminate" not in flags and _jaxlib_knows(
     # (observed on q72's exchange at 1 core: "only 2 of them arrived")
     flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
               " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+if "parallel_codegen_split_count" not in flags and _jaxlib_knows(
+        "xla_cpu_parallel_codegen_split_count"):
+    # one codegen unit per module so every executable the plan-cache
+    # tests persist can DESERIALIZE: split codegen drops the secondary
+    # units' symbols from serialized CPU executables ("Symbols not
+    # found" on reload; nds_tpu/cache ensure_reloadable_codegen) and
+    # the pytest process initializes jax long before any cache test
+    # could pin the flag itself (~2% compile-time cost at 2 cores)
+    flags += " --xla_cpu_parallel_codegen_split_count=1"
 os.environ["XLA_FLAGS"] = flags
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 

@@ -334,6 +334,39 @@ def test_rule_direct_executor_construction():
     assert res.violations == [] and len(res.waived) == 1
 
 
+def test_rule_uncached_compile():
+    # jax.jit inside engine/ flags
+    src = ("import jax\n\n"
+           "def f(fn, bufs):\n"
+           "    return jax.jit(fn)\n")
+    assert _rules(_lint(src, enabled={"NDS111"}).violations) \
+        == {"NDS111"}
+    # .lower(args) AOT chain flags
+    aot = ("def f(jitted, bufs):\n"
+           "    return jitted.lower(bufs).compile()\n")
+    assert _rules(_lint(aot, path="nds_tpu/parallel/fixture.py",
+                        enabled={"NDS111"}).violations) == {"NDS111"}
+    # string lowering is NOT an AOT chain: no-arg method, np.char
+    # module form, str builtin
+    clean = ("import numpy as np\n\n"
+             "def f(s, arr):\n"
+             "    a = s.lower()\n"
+             "    b = np.char.lower(arr)\n"
+             "    return a, b, str.lower(s)\n")
+    assert _lint(clean, enabled={"NDS111"}).violations == []
+    # out of scope outside engine//parallel/ (the cache module is the
+    # one compile site)
+    assert _lint(aot, path="nds_tpu/cache/aot.py",
+                 enabled={"NDS111"}).violations == []
+    # waivable for build-only jit sites
+    waived = ("import jax\n\n"
+              "def f(fn):\n"
+              "    # ndslint: waive[NDS111] -- builds the traced callable only\n"
+              "    return jax.jit(fn)\n")
+    res = _lint(waived, enabled={"NDS111"})
+    assert res.violations == [] and len(res.waived) == 1
+
+
 def test_waiver_requires_justification_and_use():
     src = ("def f(a=[]):  # ndslint: waive[NDS106]\n"
            "    return a\n")

@@ -31,6 +31,16 @@ generated data:
   completes degraded with no deadlock, and
   ``placement_consensus_total`` / ``placement_demotions_total`` move.
 
+One plan-cache scenario (nds_tpu/cache/; README "Plan cache") rides on
+the same generated data:
+
+- **cache-corruption** — byte-flip every persisted AOT payload between
+  two identical device-placement streams: the second run must treat
+  every corrupt entry as a warned miss (``compile_cache_errors_total``
+  moves, zero hits, fresh compiles), complete every query with
+  ``retries=0`` and rows identical to the cold run, and re-persist —
+  a third run serves fully warm with ZERO compiles.
+
 Two watchdog/integrity scenarios ride on the same generated data:
 
 - **hang** — a 4-stream SUPERVISED subprocess throughput round with a
@@ -330,6 +340,120 @@ def run_consensus_demotion(workdir: str) -> int:
     return 0
 
 
+def run_cache_corruption(workdir: str) -> int:
+    """Byte-flip every persisted plan-cache payload between two runs of
+    the same stream: the second run must degrade every corrupt entry to
+    a warned fresh compile (``compile_cache_errors_total`` moves, zero
+    hits), complete every query with ``retries=0`` and rows identical
+    to the cold run, quarantine the bad entries, and re-persist fresh
+    ones — a third run serves fully warm with zero compiles."""
+    from nds_tpu import cache as plan_cache
+    from nds_tpu.cache.store import PAYLOAD_PREFIX
+    from nds_tpu.io.result_io import read_result
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "query_0.sql")
+    cache_dir = os.path.join(workdir, "plan_cache")
+
+    def _cfg():
+        # force the device placement so every query compiles through
+        # the cache (the cost model may otherwise pick the cacheless
+        # cpu rung for tiny inputs)
+        return EngineConfig(overrides={
+            "engine.backend": "tpu",
+            "engine.placement.force": "device",
+            "cache.dir": cache_dir,
+        })
+
+    def _one_run(tag: str):
+        jsons = os.path.join(workdir, f"json_cache_{tag}")
+        out = os.path.join(workdir, f"cache_rows_{tag}")
+        before = obs_metrics.snapshot()
+        failures = power_core.run_query_stream(
+            SUITE, raw, stream,
+            os.path.join(workdir, f"cache_{tag}.csv"), config=_cfg(),
+            input_format="raw", json_summary_folder=jsons,
+            output_prefix=out)
+        delta = obs_metrics.delta(before, obs_metrics.snapshot())
+        return failures, _stream_summaries(jsons), \
+            delta.get("counters", {}), out
+
+    try:
+        fail_cold, _sums, cold, cold_out = _one_run("cold")
+        if fail_cold:
+            return _fail(f"cold cache run failed {fail_cold} queries")
+        if not cold.get("compile_cache_bytes_written_total"):
+            return _fail(f"cold run persisted nothing: {cold}")
+
+        # flip one byte in EVERY payload: every later consult must see
+        # the sha256 mismatch
+        flipped = 0
+        for root, _dirs, files in os.walk(cache_dir):
+            for f in files:
+                if not f.startswith(PAYLOAD_PREFIX) \
+                        or f.endswith(".tmp"):
+                    continue
+                p = os.path.join(root, f)
+                with open(p, "r+b") as fh:
+                    fh.seek(137)
+                    b = fh.read(1)
+                    fh.seek(137)
+                    fh.write(bytes([b[0] ^ 0xFF]))
+                flipped += 1
+        if not flipped:
+            return _fail("no cache payloads found to corrupt")
+
+        fail_cor, sums, cor, cor_out = _one_run("corrupt")
+        if fail_cor:
+            return _fail(f"corrupt cache must NEVER fail a query: "
+                         f"{fail_cor} failed")
+        for q, s in sums.items():
+            if s["queryStatus"][-1] != "Completed" \
+                    or s.get("retries") != 0:
+                return _fail(f"{q} should complete with retries=0 "
+                             f"despite the corrupt cache: "
+                             f"status={s['queryStatus']} "
+                             f"retries={s.get('retries')}")
+        if not cor.get("compile_cache_errors_total"):
+            return _fail(f"corruption must warn via "
+                         f"compile_cache_errors_total: {cor}")
+        if cor.get("compile_cache_hits_total"):
+            return _fail(f"a flipped payload must never hit: {cor}")
+        if not cor.get("compiles_total"):
+            return _fail(f"corrupt entries must recompile fresh: {cor}")
+        for n in TEMPLATES:
+            a = read_result(os.path.join(cold_out, f"query{n}"))
+            b = read_result(os.path.join(cor_out, f"query{n}"))
+            if not a.equals(b):
+                return _fail(f"query{n} rows diverged after the "
+                             f"corrupt-cache recompile")
+
+        # recovery: the fresh compiles re-persisted; a third run is
+        # fully warm (0 compiles) and the store verifies clean
+        fail_warm, _sums, warm, _out = _one_run("warm")
+        if fail_warm:
+            return _fail(f"warm rerun failed {fail_warm} queries")
+        if warm.get("compiles_total") or warm.get("recompiles_total"):
+            return _fail(f"warm rerun should compile NOTHING: {warm}")
+        if not warm.get("compile_cache_hits_total"):
+            return _fail(f"warm rerun should serve from cache: {warm}")
+        store = plan_cache.PlanCache(cache_dir, readonly=True)
+        bad = store.verify()
+        if bad:
+            return _fail(f"re-persisted store should verify clean: "
+                         f"{bad}")
+    finally:
+        plan_cache.reset()
+    print("OK: cache corruption (byte-flipped entries warned + "
+          "recompiled fresh, queries Completed retries=0 with "
+          "identical rows, store re-persisted and fully warm)")
+    return 0
+
+
 def run_watchdog_stream(workdir: str) -> int:
     """Supervised 4-stream throughput round with one hung stream: the
     watchdog catches it, the supervisor restarts it once, the round
@@ -482,11 +606,17 @@ def run_corrupt_load(workdir: str) -> int:
 
 
 def main() -> int:
+    # pin the reloadable-codegen flag BEFORE any scenario initializes
+    # jax: the cache-corruption scenario's warm rerun asserts zero
+    # compiles, which needs persisted CPU executables to deserialize
+    from nds_tpu import cache as plan_cache
+    plan_cache.ensure_reloadable_codegen()
     with tempfile.TemporaryDirectory(prefix="nds_chaos_") as workdir:
         rc = run_chaos_stream(workdir)
         rc |= run_journal_check(workdir)
         rc |= run_ladder_stream(workdir)
         rc |= run_consensus_demotion(workdir)
+        rc |= run_cache_corruption(workdir)
         rc |= run_watchdog_stream(workdir)
         # LAST: really mutates the shared raw data
         rc |= run_corrupt_load(workdir)

@@ -19,7 +19,10 @@ BenchReport summary schema (``--summary``, README "Observability"):
   (device_hwm_bytes + source), retries / retry_backoff_s /
   gave_up_reason / deadline_exceeded, and the scheduling fields
   placement / reschedules / ladder / promoted_back
-  (engine/scheduler.py; README "Placement & degradation").
+  (engine/scheduler.py; README "Placement & degradation"), and the
+  plan-cache block cache (hits + misses required ints; optional
+  errors / bytes_read / bytes_written / load_ms — nds_tpu/cache/;
+  README "Plan cache").
 
 Exit 0 when every record validates; prints each offense otherwise.
 Run by tests/test_observability.py and tools/static_checks.py as a
@@ -190,6 +193,24 @@ def validate_summary(obj: object) -> list[str]:
         errs.append(f"bad ladder {obj['ladder']!r}")
     if "promoted_back" in obj and obj["promoted_back"] is not True:
         errs.append(f"bad promoted_back {obj['promoted_back']!r}")
+    # plan-cache block (nds_tpu/cache/; README "Plan cache"): hits +
+    # misses always travel together; byte counts / errors / load_ms
+    # are optional and non-negative
+    cache = obj.get("cache")
+    if cache is not None:
+        if (not isinstance(cache, dict)
+                or not isinstance(cache.get("hits"), int)
+                or not isinstance(cache.get("misses"), int)
+                or cache["hits"] < 0 or cache["misses"] < 0):
+            errs.append(f"bad cache block {cache!r}")
+        else:
+            for k in ("errors", "bytes_read", "bytes_written"):
+                if k in cache and (not isinstance(cache[k], int)
+                                   or cache[k] < 0):
+                    errs.append(f"bad cache.{k} {cache[k]!r}")
+            if "load_ms" in cache and (not _num(cache["load_ms"])
+                                       or cache["load_ms"] < 0):
+                errs.append(f"bad cache.load_ms {cache['load_ms']!r}")
     return errs
 
 

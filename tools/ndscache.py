@@ -1,0 +1,260 @@
+"""Admin CLI for the persistent AOT plan cache (nds_tpu/cache/).
+
+Verbs:
+
+- ``ls``     — list every entry's manifest (kind, size, age, platform,
+  jax version); pure filesystem, no jax import.
+- ``verify`` — re-hash every payload against its sha256 manifest and
+  report corrupt/unreadable entries (exit 1 when any fail).
+- ``prune``  — delete entries by age (``--days``), by jax-version skew
+  against the running jax (``--other-jax``), or failing verification
+  (``--corrupt``).
+- ``warm``   — compile every statement of a suite into a cold cache:
+  build a session exactly like a power run (unified pipeline,
+  ``--backend tpu|distributed|cpu``, ``--mesh N`` shards), register a
+  warehouse (``--data_dir``, or in-memory datagen at ``--sf`` when
+  omitted), and run all 125 statements so every compile persists. The
+  next process pointed at the cache answers the whole workload with
+  zero compiles.
+
+Warming EXECUTES each statement rather than stopping at ``.compile()``:
+staged plans register their sub-programs' result tables, whose content
+feeds the main program's fingerprint — the only way to mint the exact
+keys a real run will look up is to run the real pipeline. Results are
+discarded; the compile side effects are the product.
+
+Fingerprints fold in the backend platform and table content, so a warm
+is only useful to runs on the SAME platform against the SAME warehouse:
+warm on the TPU host for TPU runs (the acceptance sweep —
+``--suite all`` on bare CPU with ``JAX_PLATFORMS=cpu`` — proves the
+control plane needs no accelerator).
+
+Usage:
+  python tools/ndscache.py ls [--dir D]
+  python tools/ndscache.py verify [--dir D]
+  python tools/ndscache.py prune [--dir D] [--days N] [--other-jax] [--corrupt]
+  python tools/ndscache.py warm [--dir D] [--suite nds|nds_h|all]
+                                [--backend tpu|distributed|cpu]
+                                [--mesh N] [--data_dir PATH] [--sf F]
+                                [--input_format parquet|raw|...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nds_tpu import cache as plan_cache  # noqa: E402
+from nds_tpu.cache.store import PlanCache  # noqa: E402
+
+
+def _resolve_dir(args) -> str:
+    d = args.dir or os.environ.get(plan_cache.ENV_DIR)
+    if not d:
+        print("error: no cache dir (--dir or NDS_TPU_PLAN_CACHE)")
+        sys.exit(2)
+    return d
+
+
+def cmd_ls(args) -> int:
+    store = PlanCache(_resolve_dir(args), readonly=True)
+    entries = store.entries()
+    if not entries:
+        print("(empty cache)")
+        return 0
+    now = time.time()
+    total = 0
+    print(f"{'FINGERPRINT':16} {'KIND':22} {'SIZE':>10} {'AGE':>8} "
+          f"{'PLATFORM':8} JAX")
+    for m in entries:
+        fp = m.get("fingerprint", "?")
+        if m.get("unreadable"):
+            print(f"{fp[:16]:16} <unreadable manifest>")
+            continue
+        size = m.get("size_bytes", 0)
+        total += size
+        age_h = (now - m.get("created_unix", now)) / 3600.0
+        print(f"{fp[:16]:16} {str(m.get('kind', '?')):22} "
+              f"{size:>10} {age_h:>7.1f}h "
+              f"{str(m.get('platform', '?')):8} {m.get('jax', '?')}")
+    print(f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}, "
+          f"{total} bytes")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    store = PlanCache(_resolve_dir(args), readonly=True)
+    entries = store.entries()
+    bad = store.verify()
+    for fp in bad:
+        print(f"CORRUPT: {fp}")
+    print(f"{'FAIL' if bad else 'OK'}: {len(bad)} corrupt of "
+          f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}")
+    return 1 if bad else 0
+
+
+def cmd_prune(args) -> int:
+    store = PlanCache(_resolve_dir(args))
+    jax_version = None
+    if args.other_jax:
+        import jax
+        jax_version = jax.__version__
+    removed = store.prune(keep_days=args.days, jax_version=jax_version,
+                          corrupt=args.corrupt)
+    for fp in removed:
+        print(f"pruned: {fp}")
+    print(f"{len(removed)} entr{'y' if len(removed) == 1 else 'ies'} "
+          f"removed")
+    return 0
+
+
+# ------------------------------------------------------------------ warm
+
+def _gen_tables(suite_name: str, sf: float) -> dict:
+    """In-memory warehouse at scale ``sf`` (no --data_dir): the same
+    datagen the differential tests use."""
+    from nds_tpu.io.host_table import from_arrays
+    if suite_name == "nds_h":
+        from nds_tpu.datagen import tpch as gen
+        from nds_tpu.nds_h.schema import get_schemas
+    else:
+        from nds_tpu.datagen import tpcds as gen
+        from nds_tpu.nds.schema import get_schemas
+    schemas = get_schemas()
+    return {t: from_arrays(t, schemas[t], gen.gen_table(t, sf))
+            for t in schemas}
+
+
+def _warm_suite(suite_name: str, args, config) -> tuple:
+    """Run every statement of one suite through a power-run-equivalent
+    session; returns (statements, failures list)."""
+    from nds_tpu.utils import power_core
+    if suite_name == "nds_h":
+        from nds_tpu.nds_h import streams
+        from nds_tpu.nds_h.power import SUITE
+        units = [(f"q{qn}", list(streams.statements(qn)))
+                 for qn in streams.stream_order(0)]
+    else:
+        from nds_tpu.nds import streams
+        from nds_tpu.nds.power import SUITE
+        units = []
+        for qn in streams.available_templates():
+            parts = [s for s in streams.render_query(qn).split(";")
+                     if s.strip()]
+            units.append((f"q{qn}", parts))
+    session = power_core.make_session(SUITE, config)
+    if args.data_dir:
+        power_core.load_warehouse(
+            SUITE, session, args.data_dir, args.input_format,
+            schemas=power_core.suite_schemas(SUITE, config))
+    else:
+        for table in _gen_tables(suite_name, args.sf).values():
+            session.register_table(table)
+    n, failures = 0, []
+    subset = set(args.queries or [])
+    if subset:
+        units = [(q, s) for q, s in units if q in subset]
+    for qname, stmts in units:
+        for i, stmt in enumerate(stmts, 1):
+            label = (f"{suite_name} {qname}"
+                     + (f" part{i}" if len(stmts) > 1 else ""))
+            n += 1
+            try:
+                session.sql(stmt)
+            except Exception as exc:  # noqa: BLE001 - keep sweeping
+                failures.append(f"{label}: {type(exc).__name__}: {exc}")
+            else:
+                if args.verbose:
+                    print(f"  warmed {label}")
+    return n, failures
+
+
+def cmd_warm(args) -> int:
+    cache_dir = _resolve_dir(args)
+    if args.mesh and args.backend != "distributed":
+        print("error: --mesh requires --backend distributed")
+        return 2
+    if args.backend == "distributed" and args.mesh:
+        # a CPU host needs virtual devices BEFORE jax initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("xla_force_host_platform_device_count" not in flags
+                and os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.utils.config import EngineConfig
+    overrides = {"engine.backend": args.backend,
+                 "cache.dir": cache_dir}
+    if args.mesh:
+        overrides["engine.mesh.shards"] = args.mesh
+    before = obs_metrics.snapshot()
+    total, failures = 0, []
+    for suite_name in (("nds", "nds_h") if args.suite == "all"
+                       else (args.suite,)):
+        config = EngineConfig(overrides=dict(overrides))
+        n, fails = _warm_suite(suite_name, args, config)
+        total += n
+        failures.extend(fails)
+    d = obs_metrics.delta(before, obs_metrics.snapshot()
+                          ).get("counters", {})
+    for line in failures:
+        print(f"FAILED: {line}")
+    print(f"{'FAIL' if failures else 'OK'}: warmed {total} statement(s) "
+          f"({len(failures)} failed) into {cache_dir}: "
+          f"compiles={int(d.get('compiles_total', 0))} "
+          f"recompiles={int(d.get('recompiles_total', 0))} "
+          f"hits={int(d.get('compile_cache_hits_total', 0))} "
+          f"bytes_written="
+          f"{int(d.get('compile_cache_bytes_written_total', 0))}")
+    return 1 if failures else 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("ls", "verify", "prune", "warm"):
+        p = sub.add_parser(name)
+        p.add_argument("--dir", help="cache directory "
+                                     "(default: NDS_TPU_PLAN_CACHE)")
+        if name == "prune":
+            p.add_argument("--days", type=float,
+                           help="drop entries older than this many days")
+            p.add_argument("--other-jax", action="store_true",
+                           help="drop entries built by a jax other "
+                                "than the one running")
+            p.add_argument("--corrupt", action="store_true",
+                           help="drop entries failing sha256 verify")
+        if name == "warm":
+            p.add_argument("--suite", choices=("nds", "nds_h", "all"),
+                           default="all")
+            p.add_argument("--backend",
+                           choices=("tpu", "distributed", "cpu"),
+                           default="tpu")
+            p.add_argument("--mesh", type=int, default=0,
+                           help="mesh shards (engine.mesh.shards) for "
+                                "--backend distributed")
+            p.add_argument("--data_dir",
+                           help="warehouse to register (the warm is "
+                                "only valid for runs against this "
+                                "exact data)")
+            p.add_argument("--input_format", default="parquet")
+            p.add_argument("--sf", type=float, default=0.01,
+                           help="in-memory datagen scale factor when "
+                                "--data_dir is omitted")
+            p.add_argument("--queries", nargs="+",
+                           help="warm only these templates (e.g. q1 "
+                                "q6); default: every statement")
+            p.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    return {"ls": cmd_ls, "verify": cmd_verify,
+            "prune": cmd_prune, "warm": cmd_warm}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
