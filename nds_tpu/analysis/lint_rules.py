@@ -67,6 +67,18 @@ fails CI instead of waiting for a human audit:
                             callable (the ``jax.jit(fn)`` handed to
                             ``cache.aot``) carry waivers saying so.
 
+- NDS112 int64-emulation-hazard
+                            ``jnp.argsort`` / ``jnp.sort`` /
+                            ``jnp.searchsorted`` in ``engine/`` /
+                            ``parallel/`` with no explicit int32 cast
+                            in the call: under x64 these carry int64
+                            operands (argsort's implicit iota is the
+                            canonical trap — see ``_build_lookup``),
+                            and TPU emulates 64-bit sorts at ~4-8x the
+                            native i32 cost. Narrow explicitly
+                            (``_narrow_key`` / ``.astype(jnp.int32)``)
+                            or waive with why the width is required.
+
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
@@ -646,12 +658,52 @@ class UncachedCompileRule(Rule):
         return False
 
 
+class Int64EmulationHazardRule(Rule):
+    """NDS112: ``jnp.argsort``/``jnp.sort``/``jnp.searchsorted`` call
+    in the engine/parallel layers whose call text carries no explicit
+    int32 narrowing. Under ``jax_enable_x64`` the default integer (and
+    argsort's implicit index operand) is int64, which TPU sorts via
+    emulation at a multiple of the native i32 cost — the trap
+    ``_build_lookup``'s explicit-iota comment documents, promoted to a
+    rule. The check is textual-per-call on purpose: an ``int32``
+    mention anywhere in the call (an ``astype``, a ``dtype=``, a
+    ``_narrow_key``-produced name is NOT enough — narrowing helpers
+    live a line above) signals the author handled the width; anything
+    else needs a waiver explaining why 64-bit operands are required."""
+
+    id = "NDS112"
+    name = "int64-emulation-hazard"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+    _FUNCS = {"argsort", "sort", "searchsorted"}
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in self._FUNCS
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "jnp"):
+                continue
+            seg = ast.get_source_segment(src, n) or ""
+            if "int32" in seg:
+                continue
+            out.append(LintViolation(
+                self.id, path, n.lineno,
+                f"jnp.{n.func.attr}() without an explicit int32 cast: "
+                f"int64 operands under x64 push the sort/search onto "
+                f"TPU's emulated 64-bit path (narrow via _narrow_key/"
+                f".astype(jnp.int32), or waive with why the width is "
+                f"required)"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
-            UncachedCompileRule()]
+            UncachedCompileRule(), Int64EmulationHazardRule()]
 
 
 # -------------------------------------------------------------- driver

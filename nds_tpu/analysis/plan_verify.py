@@ -356,11 +356,42 @@ class _Verifier:
         self._check_join_like(node)
         if node.kind not in ("inner", "left", "full"):
             self.fail("join-kind", node, f"unknown kind {node.kind!r}")
+        # kernel-choice invariants (engine/kernels.py): the planner's
+        # stamp must name a real kernel AND one the trace can lower for
+        # this node shape — a direct/matmul probe needs the unique-
+        # build gather path, radix partitioning only exists for the
+        # M:N inner expansion
+        from nds_tpu.engine import kernels as KX
+        if node.kernel not in KX.JOIN_KERNELS:
+            self.fail("kernel-unknown", node,
+                      f"unknown join kernel {node.kernel!r} "
+                      f"(known: {[k for k in KX.JOIN_KERNELS if k]})")
+        elif (node.kernel in (KX.JOIN_DIRECT, KX.JOIN_MATMUL)
+                and not node.right_unique):
+            self.fail("kernel-shape", node,
+                      f"{node.kernel!r} requires a unique build side "
+                      f"(right_unique)")
+        elif node.kernel == KX.JOIN_PARTITIONED and (
+                node.right_unique or node.kind != "inner"):
+            self.fail("kernel-shape", node,
+                      f"{node.kernel!r} only lowers the M:N inner "
+                      f"expansion (kind={node.kind!r}, "
+                      f"right_unique={node.right_unique})")
 
     def _check_semijoin(self, node: P.SemiJoin) -> None:
         self._check_join_like(node)
+        from nds_tpu.engine import kernels as KX
+        if node.kernel not in KX.SEMI_KERNELS:
+            self.fail("kernel-unknown", node,
+                      f"unknown semi-join kernel {node.kernel!r} "
+                      f"(known: {[k for k in KX.SEMI_KERNELS if k]})")
 
     def _check_aggregate(self, node: P.Aggregate) -> None:
+        from nds_tpu.engine import kernels as KX
+        if node.kernel not in KX.AGG_KERNELS:
+            self.fail("kernel-unknown", node,
+                      f"unknown aggregate kernel {node.kernel!r} "
+                      f"(known: {[k for k in KX.AGG_KERNELS if k]})")
         ns = _namespace(node.child, self.ns_memo)
         for _n, e in node.group_keys:
             self.check_expr(e, ns, node)

@@ -50,6 +50,7 @@ FP_VERSION = 1
 _EPOCH_MODULES = (
     "nds_tpu/engine/device_exec.py",
     "nds_tpu/engine/chunked_exec.py",
+    "nds_tpu/engine/kernels.py",
     "nds_tpu/engine/staging.py",
     "nds_tpu/parallel/dist_exec.py",
     "nds_tpu/parallel/exchange.py",

@@ -760,14 +760,19 @@ class ChunkedExecutor(dx.DeviceExecutor):
         also skips the per-predicate ``skipped`` bookkeeping, matching
         the baked behavior of the program it restores."""
         from nds_tpu.cache import aot as cache_aot
+        from nds_tpu.engine import kernels as KX
         pc, fp = cache_aot.try_fingerprint(
             "chunkscan",
             {"table": table, "chunk": C, "cols": tuple(need_cols),
-             "float_dtype": str(self.float_dtype)},
+             "float_dtype": str(self.float_dtype),
+             "donate": KX.donate_enabled()},
             tables=self.tables, extra_roots=list(scans))
+        # chunk buffers are rebuilt per chunk and used exactly once:
+        # donating them halves the phase-A device residency (the keep
+        # mask no longer double-buffers against the chunk it scans)
+        KX.silence_donation_warnings()
         compiled, _extra, _hit = cache_aot.cached_compile(
-            # ndslint: waive[NDS111] -- builds the chunk-scan trace callable; lower+compile happens inside cache.aot
-            pc, fp, "chunkscan", lambda: jax.jit(fn),
+            pc, fp, "chunkscan", lambda: KX.donate_jit(fn, (0,)),
             (bufs, jnp.int32(0)))
         return compiled
 

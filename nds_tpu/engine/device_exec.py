@@ -55,6 +55,7 @@ if os.environ.get("NDS_TPU_PLATFORM"):
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from nds_tpu.engine import kernels as KX  # noqa: E402
 from nds_tpu.engine.cpu_exec import ResultTable, like_mask  # noqa: E402
 from nds_tpu.engine.types import (  # noqa: E402
     BoolType, DateType, DecimalType, DType, FloatType, IntType, StringType,
@@ -288,19 +289,13 @@ def _ss(ks, q, side="left"):
     (i32) to ~800ms (i64) per call at 1.8M rows on TPU, measured. One
     native sort of the concatenation is ~10ms, so every probe-scale
     searchsorted in the engine goes through here."""
+    # ndslint: waive[NDS112] -- central chokepoint: operand width is the caller's (all hot callers narrow via _narrow_key/bounds), and method="sort" already sidesteps the emulated-bisection pathology
     return jnp.searchsorted(ks, q, side=side, method="sort")
 
 
-def _seg_scan(op, vals, flags):
-    """Segmented inclusive scan: restart `op` accumulation at every True
-    flag. Classic (value, reset-flag) associative combiner — O(n log n)
-    on the VPU via lax.associative_scan."""
-    def comb(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf, bv, op(av, bv)), af | bf
-    out, _ = lax.associative_scan(comb, (vals, flags))
-    return out
+# segmented inclusive scan: shared with every scan-based kernel
+# (engine/kernels.py owns the implementation)
+_seg_scan = KX.seg_scan
 
 
 def _epoch_days_to_civil(days):
@@ -362,6 +357,12 @@ class DeviceExecutor:
         # (NOT named _reduced: ChunkedExecutor already uses that name
         # for its phase-B executor cache)
         self._scan_views: dict[tuple, object] = {}
+        # memoized string-dictionary unions keyed by the (left, right)
+        # dictionary identities: every execution of every join over the
+        # same two string columns otherwise recomputes np.union1d + two
+        # searchsorteds on the host. Entries pin both dictionaries
+        # (id-recycling cannot serve a stale union)
+        self._union_cache: dict[tuple, tuple] = {}
         # perf accounting for the last execute(): compile/execute/
         # materialize wall-clock ms (the breakdown the reference leaves to
         # the Spark UI; here it feeds the JSON summaries directly).
@@ -488,8 +489,12 @@ class DeviceExecutor:
                 rt = self.execute(sub, key=(key, "__stage__", i))
             for k, v in self.last_timings.items():
                 if k in ("compile_ms", "execute_ms", "materialize_ms",
-                         "bytes_scanned"):
+                         "bytes_scanned", "ops_est"):
                     agg[k] = agg.get(k, 0.0) + v
+                elif k == "__kernels":
+                    kacc = agg.setdefault("__kernels", {})
+                    for kn, cnt in v.items():
+                        kacc[kn] = kacc.get(kn, 0) + cnt
             self._register_staged(temp, staging.result_to_host_table(
                 temp, rt))
         if subs:
@@ -550,7 +555,12 @@ class DeviceExecutor:
         if not agg:
             return
         for k, v in agg.items():
-            timings[k] = timings.get(k, 0.0) + v
+            if k == "__kernels":
+                kacc = timings.setdefault("__kernels", {})
+                for kn, cnt in v.items():
+                    kacc[kn] = kacc.get(kn, 0) + cnt
+            else:
+                timings[k] = timings.get(k, 0.0) + v
         bs = timings.get("bytes_scanned", 0.0)
         if bs and timings.get("execute_ms", 0) > 0:
             timings["scan_gbps"] = bs / (timings["execute_ms"] / 1000) / 1e9
@@ -559,6 +569,9 @@ class DeviceExecutor:
                 timings["roofline_frac"] = round(
                     timings["scan_gbps"] / peak, 4)
                 timings["roofline_peak_gbps"] = peak
+        if bs and timings.get("ops_est"):
+            timings["ops_per_byte"] = round(
+                timings["ops_est"] / bs, 4)
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
         return self.execute_async(planned, key).result()
@@ -701,7 +714,9 @@ class DeviceExecutor:
                                             timings, args=(bufs,))
             if hit is not None:
                 entry["compiled"], extra = hit
-                entry["side"] = {"dicts": extra.get("dicts")}
+                entry["side"] = {"dicts": extra.get("dicts"),
+                                 "kernels": extra.get("kernels"),
+                                 "ops_est": extra.get("ops_est")}
                 # an overflow retry served from another process's
                 # persisted recompile consumed no compile here
                 entry.pop("recompile", None)
@@ -729,7 +744,9 @@ class DeviceExecutor:
         if fp:
             cache_aot.persist(pc, fp, type(self).__name__,
                               entry["compiled"],
-                              {"dicts": side.get("dicts")},
+                              {"dicts": side.get("dicts"),
+                               "kernels": side.get("kernels"),
+                               "ops_est": side.get("ops_est")},
                               meta={"slack": entry["slack"]})
 
     # capacity at or above which results compact ON DEVICE before the
@@ -769,10 +786,16 @@ class DeviceExecutor:
                         for a, v in outs_d])
             from nds_tpu.cache import aot as cache_aot
             pc, fp = cache_aot.try_fingerprint(
-                "compact", {"n": n, "sig": sig})
+                "compact", {"n": n, "sig": sig,
+                            "donate": KX.donate_enabled()})
+            # the masked full-capacity result arrays are single-use by
+            # construction (the compaction replaces them): donate, so
+            # the biggest intermediate of the query stops
+            # double-buffering
+            KX.silence_donation_warnings()
             cf, _extra, hit = cache_aot.cached_compile(
-                # ndslint: waive[NDS111] -- builds the compaction trace callable; lower+compile happens inside cache.aot
-                pc, fp, "compact", lambda: jax.jit(fn), avatars,
+                pc, fp, "compact",
+                lambda: KX.donate_jit(fn, (0, 1)), avatars,
                 timings=timings)
             # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; no device work is in flight here
             dt = (_time.perf_counter() - t0) * 1000
@@ -802,6 +825,12 @@ class DeviceExecutor:
                 timings["roofline_frac"] = round(
                     timings["scan_gbps"] / peak, 4)
                 timings["roofline_peak_gbps"] = peak
+        if bs and timings.get("ops_est"):
+            # arithmetic intensity of the compiled program: traced
+            # row-slots per scanned byte — the ops/byte model the
+            # ndsreport roofline column pairs with roofline_frac
+            timings["ops_per_byte"] = round(
+                timings["ops_est"] / bs, 4)
         self._merge_stage_timings(timings, key)
         self.last_timings = timings
 
@@ -870,6 +899,14 @@ class DeviceExecutor:
             memwatch.sample_device()
             timings["execute_ms"] = (t2 - t1) * 1000
             timings["materialize_ms"] = (t3 - t2) * 1000
+            side = entry.get("side") or {}
+            if side.get("ops_est"):
+                timings["ops_est"] = float(side["ops_est"])
+            if side.get("kernels"):
+                # dunder: a dict, not part of the numeric timings
+                # vocabulary (engineTimings strips it; report.py
+                # publishes it as the summary's "kernels" block)
+                timings["__kernels"] = dict(side["kernels"])
             self._finalize_timings(timings, key)
             if span:
                 # dunder keys are internal accounting state (e.g. the
@@ -910,6 +947,8 @@ class DeviceExecutor:
             tr = _Trace(self, bufs, slack)
             row, outs, dicts = tr.run_query(planned)
             side["dicts"] = dicts
+            side["kernels"] = dict(tr.kernels)
+            side["ops_est"] = int(tr.ops_est)
             return row, outs, tr.total_overflow()
 
         # ndslint: waive[NDS111] -- builds the traced callable only; AOT lower+compile routes through cache.aot (_compile_or_load)
@@ -1165,6 +1204,17 @@ class _Trace:
         self.scalars: dict[int, tuple] = {}
         self._cache: dict[int, DCtx] = {}
         self._overflows: list = []
+        # kernel-use accounting (engine/kernels.py): which kernel each
+        # hot operator actually compiled with, counted at trace time
+        # and published per query (BenchReport "kernels" block)
+        self.kernels: dict[str, int] = {}
+        # ops estimate: total row-slots processed across plan nodes —
+        # the numerator of the per-query ops/byte model ndsreport's
+        # roofline column reads
+        self.ops_est: int = 0
+
+    def _note(self, kernel: str) -> None:
+        self.kernels[kernel] = self.kernels.get(kernel, 0) + 1
 
     def total_overflow(self):
         if not self._overflows:
@@ -1211,6 +1261,9 @@ class _Trace:
         if nid in self._cache:
             return self._cache[nid]
         ctx = getattr(self, "_run_" + type(node).__name__.lower())(node)
+        # ops/byte model numerator: row-slots this node's context holds
+        # (deduplicated — shared CTE bodies count once via the cache)
+        self.ops_est += int(getattr(ctx, "n", 0))
         self.stash(node, ctx)
         return ctx
 
@@ -1295,19 +1348,25 @@ class _Trace:
     def _join_key_arrays(self, lvals, rvals, lctx, rctx):
         """Align key pairs (string dictionary union, decimal rescale), then
         bit-pack multi-column keys into one int64 per side.
-        Returns (lkey, lok, rkey, rok)."""
+        Returns (lkey, lok, rkey, rok, span): span is the host-known
+        (lo, hi) value range of the combined key — the dense-kernel
+        feasibility input (engine/kernels.py) — or None when either
+        side lacks bounds."""
         lok = lctx.row
         rok = rctx.row
         if len(lvals) == 1 and lvals[0].sdict is None \
                 and rvals[0].sdict is None:
             lv, rv = lvals[0], rvals[0]
             lk, rk = lv.arr.astype(jnp.int64), rv.arr.astype(jnp.int64)
-            # int32 keys sort/search natively on TPU; int64 is emulated
+            span = None
             if (lv.lo is not None and rv.lo is not None
-                    and min(lv.lo, rv.lo) > -2**31
-                    and max(lv.hi, rv.hi) < 2**31 - 1):
-                lk, rk = lk.astype(jnp.int32), rk.astype(jnp.int32)
-            return lk, _ok(lv, lok), rk, _ok(rv, rok)
+                    and lv.hi is not None and rv.hi is not None):
+                span = (min(lv.lo, rv.lo), max(lv.hi, rv.hi))
+                # int32 keys sort/search natively on TPU; int64 is
+                # emulated
+                if span[0] > -2**31 and span[1] < 2**31 - 1:
+                    lk, rk = lk.astype(jnp.int32), rk.astype(jnp.int32)
+            return lk, _ok(lv, lok), rk, _ok(rv, rok), span
         lks, rks, widths = [], [], []
         for lv, rv in zip(lvals, rvals):
             la, ra, lo, hi = self._align_pair(lv, rv)
@@ -1325,7 +1384,9 @@ class _Trace:
         if sum(widths) <= 30:
             lkey = lkey.astype(jnp.int32)
             rkey = rkey.astype(jnp.int32)
-        return lkey, lok, rkey, rok
+        # packed keys normalize each part to [0, hi-lo], so the combined
+        # key lives in [0, 2^sum(widths))
+        return lkey, lok, rkey, rok, (0, (1 << sum(widths)) - 1)
 
     @staticmethod
     def _pack(keys, widths):
@@ -1334,6 +1395,34 @@ class _Trace:
             norm = jnp.clip(arr.astype(jnp.int64) - lo, 0, hi - lo)
             acc = norm if acc is None else ((acc << w) | norm)
         return acc
+
+    # bound on memoized dictionary unions (each entry pins two host
+    # dictionaries plus two host remap tables)
+    MAX_UNION_CACHE = 256
+
+    def _dict_union(self, lsd, rsd):
+        """Memoized string-dictionary union for one (left, right)
+        dictionary pair: np.union1d + the two searchsorted remaps run
+        ONCE per pair per executor instead of once per execution of
+        every join over the same two string columns. The cache holds
+        HOST arrays only — a jnp array minted here would be a
+        trace-local constant, and replaying it into a later trace
+        desyncs that program's hoisted-constant inputs. Returns
+        (union[np str], lmap[device], rmap[device])."""
+        ex = self.ex
+        key = (id(lsd), id(rsd))
+        hit = ex._union_cache.get(key)
+        if hit is None or hit[0] is not lsd or hit[1] is not rsd:
+            union = np.union1d(lsd.astype(str), rsd.astype(str))
+            lmap = np.searchsorted(union, lsd.astype(str))
+            rmap = np.searchsorted(union, rsd.astype(str))
+            while len(ex._union_cache) >= self.MAX_UNION_CACHE:
+                ex._union_cache.pop(next(iter(ex._union_cache)))
+            # the stored tuple pins both keyed dictionaries, and the
+            # identity re-check above rejects any recycled address
+            hit = (lsd, rsd, union, lmap, rmap)
+            ex._union_cache[key] = hit
+        return hit[2], jnp.asarray(hit[3]), jnp.asarray(hit[4])
 
     def _align_pair(self, lv: DVal, rv: DVal):
         """Make one key pair comparable as integers; returns
@@ -1346,9 +1435,7 @@ class _Trace:
                     and np.array_equal(lv.sdict, rv.sdict)):
                 hi = max(len(lv.sdict) - 1, 0)
                 return lv.arr, rv.arr, 0, hi
-            union = np.union1d(lv.sdict.astype(str), rv.sdict.astype(str))
-            lmap = jnp.asarray(np.searchsorted(union, lv.sdict.astype(str)))
-            rmap = jnp.asarray(np.searchsorted(union, rv.sdict.astype(str)))
+            union, lmap, rmap = self._dict_union(lv.sdict, rv.sdict)
             return (jnp.take(lmap, lv.arr), jnp.take(rmap, rv.arr),
                     0, max(len(union) - 1, 0))
         la, ra = lv.arr, rv.arr
@@ -1439,24 +1526,45 @@ class _Trace:
             return self._cross_join(node, lctx, rctx)
         lvals = [self.eval(k, lctx) for k in node.left_keys]
         rvals = [self.eval(k, rctx) for k in node.right_keys]
-        lkey, lok, rkey, rok = self._join_key_arrays(lvals, rvals, lctx, rctx)
+        lkey, lok, rkey, rok, span = self._join_key_arrays(
+            lvals, rvals, lctx, rctx)
         if node.kind == "full":
             return self._full_join(node, lctx, rctx, lkey, lok, rkey,
                                    rok)
         if node.right_unique:
-            # gather join: probe from the left, build on the unique right
-            if (getattr(rctx, "pristine", False)
-                    and self._presorted_build(node.right,
-                                              node.right_keys)):
-                # host-proven sorted PK build on a pristine scan ctx:
-                # rok is the scan's prefix mask, so masked tail rows ->
-                # sentinel keeps ks ascending with NO device sort
-                sentinel = jnp.iinfo(rkey.dtype).max
-                ks = jnp.where(rok, rkey, sentinel)
-                order = jnp.arange(rkey.shape[0], dtype=jnp.int32)
-            else:
-                ks, order = self._build_lookup(rkey, rok)
-            ridx, hit = self._probe(ks, order, lkey, lok)
+            # gather join: probe from the left, build on the unique
+            # right. The planner's kernel choice (engine/kernels.py)
+            # picks the probe machinery; infeasible choices (missing
+            # bounds, oversized domain) demote to the sort path and the
+            # demotion shows in the per-query kernel counts
+            ridx = hit = None
+            if (node.kernel == KX.JOIN_MATMUL
+                    and rctx.n <= 4 * KX.MATMUL_MAX_BUILD):
+                ridx, hit = KX.matmul_probe_join(rkey, rok, lkey, lok)
+                self._note("join.matmul")
+            elif node.kernel in (KX.JOIN_MATMUL, KX.JOIN_DIRECT):
+                dom = (None if span is None
+                       else KX.domain_of(span[0], span[1]))
+                if KX.direct_feasible(dom, rctx.n):
+                    ridx, hit = KX.direct_lookup_join(
+                        rkey, rok, lkey, lok, int(span[0]), dom)
+                    self._note("join.direct")
+            if ridx is None:
+                if (getattr(rctx, "pristine", False)
+                        and self._presorted_build(node.right,
+                                                  node.right_keys)):
+                    # host-proven sorted PK build on a pristine scan
+                    # ctx: rok is the scan's prefix mask, so masked
+                    # tail rows -> sentinel keeps ks ascending with NO
+                    # device sort
+                    sentinel = jnp.iinfo(rkey.dtype).max
+                    ks = jnp.where(rok, rkey, sentinel)
+                    order = jnp.arange(rkey.shape[0], dtype=jnp.int32)
+                    self._note("join.presorted")
+                else:
+                    ks, order = self._build_lookup(rkey, rok)
+                    self._note("join.sortmerge")
+                ridx, hit = self._probe(ks, order, lkey, lok)
             if node.kind == "left":
                 out = DCtx(lctx.n, lctx.row)
                 out.cols.update(lctx.cols)
@@ -1481,6 +1589,26 @@ class _Trace:
             return out
         # right side not unique
         if node.kind == "inner":
+            K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
+            if (node.kernel == KX.JOIN_PARTITIONED
+                    and min(lctx.n, rctx.n) >= 2 * KX.NPART):
+                # radix-partitioned sort-merge (engine/kernels.py):
+                # per-partition sort depth is log(n/R) and all R sorts
+                # batch into one lax.sort — the q21-class large-by-
+                # large path. part_slack rides the executor's overflow
+                # retry (doubled slack grows partition AND output
+                # capacity together)
+                part_slack = max(2.0, self.slack)
+                lidx2, ridx, present, over = KX.partitioned_mn_join(
+                    lkey, lok, rkey, rok, K, part_slack)
+                self._overflows.append(over)
+                self._note("join.partitioned")
+                out = DCtx(int(lidx2.shape[0]), present)
+                out.cols.update(lctx.gather(lidx2).cols)
+                out.cols.update(rctx.gather(ridx).cols)
+                if node.residual is not None:
+                    out = self._apply_filter(out, node.residual)
+                return out
             # generic M:N join: sort the left side by key, find each
             # right row's match RANGE via two searchsorteds, expand into
             # a fixed-capacity slot array (cumsum offsets -> slot->pair
@@ -1488,13 +1616,13 @@ class _Trace:
             # counted in-program and the executor retries with doubled
             # slack — the static-shape answer to data-dependent join
             # cardinality (SURVEY §7 hard part 2)
+            self._note("join.sortmerge")
             ks, order = self._build_lookup(lkey, lok)
             lo = _ss(ks, rkey, side="left")
             hi = _ss(ks, rkey, side="right")
             cnt = jnp.where(rok, hi - lo, 0).astype(jnp.int64)
             offs = jnp.cumsum(cnt)
             total = offs[-1]
-            K = max(int(self.slack * max(lctx.n, rctx.n)), 1)
             slots = jnp.arange(K, dtype=jnp.int32)
             # slot->pair search runs on int32: offsets clamp to K+1
             # (order-preserving for every slot < K <= INT32_MAX, and
@@ -1521,6 +1649,7 @@ class _Trace:
         # left outer: probe from the right against a unique left
         # (FK-side expansion; the planner orients star joins the other
         # way, this path serves customer LEFT JOIN orders plans, q13)
+        self._note("join.sortmerge")
         ks, order = self._build_lookup(lkey, lok)
         lidx, hit = self._probe(ks, order, rkey, rok)
         # left outer with expansion: block A = matched right rows with
@@ -1579,27 +1708,48 @@ class _Trace:
         rvals = [self.eval(k, rctx) for k in node.right_keys]
         if not node.left_keys:
             raise DeviceExecError("semi join without keys")
-        lkey, lok, rkey, rok = self._join_key_arrays(lvals, rvals, lctx, rctx)
+        lkey, lok, rkey, rok, span = self._join_key_arrays(
+            lvals, rvals, lctx, rctx)
+        dom = None if span is None else KX.domain_of(span[0], span[1])
+        want_bitmask = (node.kernel == KX.SEMI_BITMASK
+                        and KX.direct_feasible(dom, rctx.n))
         if node.residual is None:
-            ks, order = self._build_lookup(rkey, rok)
-            _idx, hit = self._probe(ks, order, lkey, lok)
-            exists = hit
+            if want_bitmask:
+                # EXISTS as a dense membership bitmap: one scatter on
+                # the build, one gather on the probe — no sort anywhere
+                exists = KX.bitmask_semi(rkey, rok, lkey, lok,
+                                         int(span[0]), dom)
+                self._note("semi.bitmask")
+            else:
+                ks, order = self._build_lookup(rkey, rok)
+                _idx, hit = self._probe(ks, order, lkey, lok)
+                exists = hit
+                self._note("semi.sortmerge")
         else:
             exists = self._exists_with_residual(
-                node, lctx, rctx, lkey, lok, rkey, rok)
+                node, lctx, rctx, lkey, lok, rkey, rok,
+                dom if want_bitmask else None,
+                None if span is None else int(span[0]))
         keep = (lctx.row & ~exists) if node.anti else (lctx.row & exists)
         out = DCtx(lctx.n, keep)
         out.cols = lctx.cols
         return out
 
-    def _exists_with_residual(self, node, lctx, rctx, lkey, lok, rkey, rok):
+    def _exists_with_residual(self, node, lctx, rctx, lkey, lok, rkey,
+                              rok, dom=None, key_lo=None):
         """EXISTS with a cross-side residual of the q21 shape
         `r.col <> l.col`: exists a right row with the key and a DIFFERENT
         (non-NULL) col value  <=>  the per-key [min, max] of col over
-        right rows is not exactly [l.col, l.col]. One 2-key native sort
-        of (key, col) makes col sorted within each key run, so min/max
-        are gathers at the run's ends — no row expansion, no packed-int64
-        keys, no emulated 64-bit sorts or searches."""
+        right rows is not exactly [l.col, l.col].
+
+        Two formulations: when the kernel choice is ``bitmask`` and the
+        key domain is dense enough (``dom``/``key_lo`` from the
+        caller), the min/max tables build by scatter into domain-sized
+        arrays and the probe is three gathers — no sort at all
+        (engine/kernels.keyed_minmax_semi, the q21 EXISTS-chain path).
+        Otherwise one 2-key native sort of (key, col) makes col sorted
+        within each key run, so min/max are gathers at the run's ends —
+        still no row expansion and no emulated 64-bit sorts."""
         e = node.residual
         if not (isinstance(e, ir.Cmp) and e.op == "<>"):
             raise DeviceExecError(
@@ -1619,14 +1769,19 @@ class _Trace:
         # from the build entirely (the count-difference formulation this
         # replaces over-counted such rows)
         rok2 = _ok(rcol, rok)
-        k_sent = jnp.iinfo(rkey.dtype).max
-        rkey_s = jnp.where(rok2, rkey, k_sent)
         rcol_n = ra
         lcol_n = la
         if (rkey.dtype == jnp.int32 and -2**31 < lo
                 and hi < 2**31 - 1):
             rcol_n = ra.astype(jnp.int32)
             lcol_n = la.astype(jnp.int32)
+        if dom is not None and jnp.issubdtype(rcol_n.dtype, jnp.integer):
+            self._note("semi.minmax")
+            return lok2 & KX.keyed_minmax_semi(
+                rkey, rok2, rcol_n, lkey, lok2, lcol_n, key_lo, dom)
+        self._note("semi.sortmerge")
+        k_sent = jnp.iinfo(rkey.dtype).max
+        rkey_s = jnp.where(rok2, rkey, k_sent)
         sk, sc = lax.sort([rkey_s, rcol_n], num_keys=2, is_stable=False)
         pos_l = _ss(sk, lkey, side="left")
         pos_r = _ss(sk, lkey, side="right")
@@ -1668,7 +1823,8 @@ class _Trace:
             out.cols[(b, kname)] = kv.with_arrays(arr_g, valid_g)
         for name, spec in node.aggs:
             arr, valid, sdict = self._agg_grouped(
-                spec, ctx, perm, gid, present_s, G, starts2)
+                spec, ctx, perm, gid, present_s, G, starts2,
+                kernel=node.kernel)
             lo, hi = self._agg_bounds(spec, ctx)
             out.cols[(b, name)] = DVal(arr, valid, sdict, lo, hi)
         return out
@@ -1835,7 +1991,7 @@ class _Trace:
         raise DeviceExecError(spec.func)
 
     def _agg_grouped(self, spec: P.AggSpec, ctx: DCtx, perm, gid,
-                     present_s, G, starts2):
+                     present_s, G, starts2, kernel: str = ""):
         dv = self._agg_arg(spec, ctx)
         if spec.func == "count" and spec.distinct:
             return self._count_distinct_grouped(
@@ -1879,9 +2035,22 @@ class _Trace:
                 fill = (jnp.iinfo(arr_i.dtype).max if spec.func == "min"
                         else jnp.iinfo(arr_i.dtype).min)
                 data = jnp.where(w, arr_i, fill)
-            seg = (jax.ops.segment_min if spec.func == "min"
-                   else jax.ops.segment_max)
-            red = seg(data, gid, num_segments=G, indices_are_sorted=True)
+            if kernel == KX.AGG_SEGSCAN:
+                # scan-based grouped min/max over the sorted gids: a
+                # segmented scan + a gather at segment ends, riding the
+                # same group sort every other AggSpec of this node
+                # amortizes — no scatter (segment_min/max emulates
+                # element-at-a-time for 64-bit operands on TPU)
+                op = (jnp.minimum if spec.func == "min"
+                      else jnp.maximum)
+                red = KX.seg_reduce_at_ends(op, data, gid, starts2)
+                self._note("agg.segscan")
+            else:
+                seg = (jax.ops.segment_min if spec.func == "min"
+                       else jax.ops.segment_max)
+                red = seg(data, gid, num_segments=G,
+                          indices_are_sorted=True)
+                self._note("agg.scatter")
             if not isf and not isinstance(spec.dtype,
                                           (FloatType, DecimalType)):
                 red = red.astype(arr_s.dtype)
@@ -2082,11 +2251,11 @@ class _Trace:
             if running:
                 res = _seg_scan(op, data, part_start)
             else:
-                seg = (jax.ops.segment_min if spec.func == "min"
-                       else jax.ops.segment_max)
-                tot = seg(data, pid, num_segments=G,
-                          indices_are_sorted=True)
-                res = jnp.take(tot, pid)
+                # whole-partition min/max via the segmented scan's
+                # value at the partition's last row (pend is already
+                # per-row) — replaces the segment_min/max scatter
+                res = KX.part_reduce_broadcast(op, data, part_start,
+                                               pend)
         else:
             raise DeviceExecError(f"window func {spec.func}")
         return self._window_range_fix(
@@ -2100,19 +2269,17 @@ class _Trace:
         row. 'cum' (ROWS) keeps the per-row running value."""
         if running and spec.frame is None:
             n = res.shape[0]
-            iota = jnp.arange(n, dtype=jnp.int32)
             change = part_start
             for i in order_ops:
                 o = sorted_ops[i]
                 change = change | jnp.concatenate(
                     [jnp.ones(1, bool), o[1:] != o[:-1]])
-            g2 = jnp.cumsum(change.astype(jnp.int32)) - 1
-            last = jax.ops.segment_max(iota, g2, num_segments=n,
-                                       indices_are_sorted=True)
-            last = jnp.clip(last, 0, n - 1)
-            res = jnp.take(res, jnp.take(last, g2))
+            # each peer group's last row via a reversed running-min
+            # over future change positions — no segment_max scatter
+            last = KX.last_of_group(change, n)
+            res = jnp.take(res, last)
             if valid is not None:
-                valid = jnp.take(valid, jnp.take(last, g2))
+                valid = jnp.take(valid, last)
         return scatter(res, valid)
 
     # ------------------------------------------------------- sort and misc
@@ -2261,7 +2428,16 @@ class _Trace:
                     f"set-op row too wide to pack ({total_w} bits)")
             lkey = (lkey << w) | ln
             rkey = (rkey << w) | rn
-        ks = jnp.sort(jnp.where(rctx.row, rkey, I64_MAX))
+        sent = I64_MAX
+        if total_w <= 30:
+            # packed whole-row keys fit int32: the membership sort and
+            # search run on TPU's native i32 path instead of emulated
+            # 64-bit (NDS112)
+            lkey = lkey.astype(jnp.int32)
+            rkey = rkey.astype(jnp.int32)
+            sent = 2**31 - 1
+        # ndslint: waive[NDS112] -- keys narrow to int32 above whenever the pack fits 30 bits; wider whole-row packs genuinely need int64
+        ks = jnp.sort(jnp.where(rctx.row, rkey, sent))
         pos = jnp.clip(_ss(ks, lkey), 0, rctx.n - 1)
         hit = jnp.take(ks, pos) == lkey
         keep = hit if node.kind == "intersect" else ~hit
@@ -2269,17 +2445,14 @@ class _Trace:
         out.cols = lctx.cols
         return out
 
-    @staticmethod
-    def _union_dict(lv: DVal, rv: DVal):
+    def _union_dict(self, lv: DVal, rv: DVal):
         if lv.sdict is None or rv.sdict is None:
             raise DeviceExecError("union of string and non-string column")
         if lv.sdict is rv.sdict or (
                 len(lv.sdict) == len(rv.sdict)
                 and np.array_equal(lv.sdict, rv.sdict)):
             return lv.arr, rv.arr, lv.sdict
-        union = np.union1d(lv.sdict.astype(str), rv.sdict.astype(str))
-        lmap = jnp.asarray(np.searchsorted(union, lv.sdict.astype(str)))
-        rmap = jnp.asarray(np.searchsorted(union, rv.sdict.astype(str)))
+        union, lmap, rmap = self._dict_union(lv.sdict, rv.sdict)
         return (jnp.take(lmap, lv.arr), jnp.take(rmap, rv.arr),
                 union.astype(object))
 

@@ -137,6 +137,16 @@ def attribute_query(summary: dict) -> dict:
     if isinstance(cache, dict) and "hits" in cache:
         row["cache_hits"] = int(cache.get("hits", 0))
         row["cache_misses"] = int(cache.get("misses", 0))
+    # kernel use + roofline model (engine/kernels.py; README "Kernels
+    # & roofline"): which relational kernels the compiled program ran
+    # with, and the query's arithmetic intensity / bandwidth fraction
+    if isinstance(summary.get("kernels"), dict):
+        row["kernels"] = {str(k): int(v)
+                          for k, v in summary["kernels"].items()}
+    et = summary.get("engineTimings") or {}
+    for k in ("ops_per_byte", "roofline_frac"):
+        if isinstance(et.get(k), (int, float)):
+            row[k] = float(et[k])
     return row
 
 
@@ -276,11 +286,14 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     w = max([len(r["query"]) for r in rows] + [5])
     has_placement = any("placement" in r for r in rows)
     has_cache = any("cache_hits" in r for r in rows)
+    has_roofline = any("ops_per_byte" in r or "roofline_frac" in r
+                       for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
         f"{short.get(c, c):>9}" for c in cols)
         + ("  placement" if has_placement else "")
-        + ("  cache" if has_cache else "") + "  status")
+        + ("  cache" if has_cache else "")
+        + ("   roofline" if has_roofline else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
         vals = [r["categories"][c] for c in CATEGORIES]
@@ -306,10 +319,23 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             else:
                 verdict = "-"
             cache_col = f"  {verdict:>5}"
+        roof_col = ""
+        if has_roofline:
+            # "<ops/byte>@<bandwidth fraction>": distance from the
+            # roofline — a LOW ops/byte at a LOW fraction means the
+            # query moves bytes it barely computes on (README "Kernels
+            # & roofline" reads this column)
+            ob = r.get("ops_per_byte")
+            rf = r.get("roofline_frac")
+            cell = ("-" if ob is None and rf is None else
+                    (f"{ob:.2f}" if ob is not None else "?")
+                    + "@"
+                    + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
+            roof_col = f"  {cell:>9}"
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + cache_col + f"  {r['status']}")
+            + place + cache_col + roof_col + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -375,6 +401,45 @@ def diff_times(base: dict, cur: dict, pct: float = 10.0,
     }
 
 
+# the slow-path kernels (engine/kernels.py catalog): a per-query
+# increase in these counts between runs is a DEMOTION — the planner
+# (or a feasibility check) silently dropped the query off the fast
+# kernels — and fails the diff gate like a removed query does
+SLOW_KERNELS = ("join.sortmerge", "semi.sortmerge", "agg.scatter")
+
+
+def _slow_uses(row: dict) -> int:
+    kern = row.get("kernels") or {}
+    return sum(int(kern.get(k, 0)) for k in SLOW_KERNELS)
+
+
+def kernel_changes(base_rows: dict, cur_rows: dict) -> list:
+    """Per-query kernel-choice changes between two runs (the same
+    mechanism as the compile-count flag): any difference in the
+    ``kernels`` block is reported; entries whose slow-path use COUNT
+    grew carry ``demoted: True`` and fail the gate. Queries with no
+    kernel block on either side (pre-kernel run dirs) are skipped, so
+    old fixtures keep diffing byte-identically — and a side MISSING
+    the block entirely (a baseline recorded before the kernel layer
+    existed) is flagged as a change but never as a demotion: the gate
+    must not hard-fail the first diff across the feature boundary
+    when the absent counts merely read as zero."""
+    out = []
+    for name in sorted(set(base_rows) & set(cur_rows)):
+        b, c = base_rows[name], cur_rows[name]
+        bk, ck = b.get("kernels"), c.get("kernels")
+        if bk is None and ck is None:
+            continue
+        if bk == ck:
+            continue
+        entry = {"query": name, "base": bk or {}, "cur": ck or {}}
+        if (bk is not None and ck is not None
+                and _slow_uses(c) > _slow_uses(b)):
+            entry["demoted"] = True
+        out.append(entry)
+    return out
+
+
 def cache_hit_rate(analysis: dict) -> "dict | None":
     """Run-level plan-cache summary from the per-query rows:
     ``{"hits", "misses", "rate"}`` (rate = hits / consults), or None
@@ -423,13 +488,20 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
             })
     newly_failed = sorted(
         set(cur.get("failed", [])) - set(base.get("failed", [])))
+    # kernel-choice changes (engine/kernels.py): flagged like compile
+    # counts; a slow-path DEMOTION fails the gate — a planner
+    # regression that quietly re-sorts q21 must not pass just because
+    # the fixture machine was fast that day
+    kchanges = kernel_changes(b_rows, c_rows)
+    demoted = [e["query"] for e in kchanges if e.get("demoted")]
     d.update({
         "base_dir": base.get("run_dir"),
         "cur_dir": cur.get("run_dir"),
         "compile_changes": compile_changes,
+        "kernel_changes": kchanges,
         "newly_failed": newly_failed,
         "passed": not d["regressions"] and not d["removed"]
-                  and not newly_failed,
+                  and not newly_failed and not demoted,
     })
     # plan-cache hit-rate per run, the compile-count-change flag's
     # natural companion: a run whose compile counts dropped to 0
@@ -466,6 +538,14 @@ def format_diff(d: dict) -> str:
             f"{e['base_compiles']} compile(s)/"
             f"{e['base_compile_ms']:.0f} ms -> {e['cur_compiles']}/"
             f"{e['cur_compile_ms']:.0f} ms")
+    for e in d.get("kernel_changes", []):
+        def _mix(kern):
+            return ",".join(f"{k}x{v}" for k, v in sorted(kern.items())) \
+                or "none"
+        label = "KERNEL-DEMOTED" if e.get("demoted") else "kernel"
+        lines.append(
+            f"  {label:<11} {e['query']:<14} "
+            f"{_mix(e['base'])} -> {_mix(e['cur'])}")
     chr_ = d.get("cache_hit_rate") or {}
     if any(chr_.get(k) for k in ("base", "cur")):
         def _rate(r):
@@ -624,6 +704,7 @@ def render_html(analysis: dict, diff: dict | None = None,
         "<table><tr><th class='q'>query</th><th>wall ms</th>"
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
         "<th>cache</th><th>retries</th><th>placement</th>"
+        "<th>kernels</th><th>roofline</th>"
         "<th>mem HWM</th><th>status</th></tr>",
     ]
     for row in analysis["queries"]:
@@ -641,6 +722,14 @@ def render_html(analysis: dict, diff: dict | None = None,
                      f"{row['cache_misses']} miss")
         else:
             cache = ""
+        kern = ", ".join(
+            f"{_esc(k)}&times;{v}"
+            for k, v in sorted((row.get("kernels") or {}).items()))
+        ob, rf = row.get("ops_per_byte"), row.get("roofline_frac")
+        roof = ""
+        if ob is not None or rf is not None:
+            roof = ((f"{ob:.2f}" if ob is not None else "?") + " @ "
+                    + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
         out.append(
             f"<tr><td class='q'>{_esc(row['query'])}</td>"
             f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
@@ -648,6 +737,7 @@ def render_html(analysis: dict, diff: dict | None = None,
             f"<td>{row['compiles']}</td><td>{cache}</td>"
             f"<td>{row['retries']}</td>"
             f"<td>{place}</td>"
+            f"<td class='q'>{kern}</td><td>{roof}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")
     out.append("</table>")

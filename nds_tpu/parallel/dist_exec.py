@@ -180,6 +180,8 @@ class DistributedExecutor(dx.DeviceExecutor):
                 tr = _DistTrace(self, {**shard_bufs, **repl_bufs}, slack)
                 row, outs, dicts = tr.run_query(planned)
                 side["dicts"] = dicts
+                side["kernels"] = dict(tr.kernels)
+                side["ops_est"] = int(tr.ops_est)
                 overflow = tr.total_overflow()
                 return row, outs, overflow
             return fn
@@ -260,6 +262,8 @@ class DistributedExecutor(dx.DeviceExecutor):
             return False
         state["jitted"], state["sk"], state["rk"] = compiled, sk, rk
         side["dicts"] = extra.get("dicts")
+        side["kernels"] = extra.get("kernels")
+        side["ops_est"] = extra.get("ops_est")
         return True
 
     def _persist_sharded(self, planned, slack, state, side) -> None:
@@ -269,7 +273,9 @@ class DistributedExecutor(dx.DeviceExecutor):
             cache_aot.persist(pc, fp, type(self).__name__,
                               state["jitted"],
                               {"sk": state["sk"], "rk": state["rk"],
-                               "dicts": side.get("dicts")},
+                               "dicts": side.get("dicts"),
+                               "kernels": side.get("kernels"),
+                               "ops_est": side.get("ops_est")},
                               meta={"slack": slack})
 
     # survivor cap for turning a SHARDED filtered scan into a
@@ -471,6 +477,10 @@ class DistributedExecutor(dx.DeviceExecutor):
                 memwatch.sub_live(timings.pop("__live_bytes", 0.0))
                 timings["execute_ms"] = (t2 - t1) * 1000
                 timings["materialize_ms"] = (t3 - t2) * 1000
+                if side.get("ops_est"):
+                    timings["ops_est"] = float(side["ops_est"])
+                if side.get("kernels"):
+                    timings["__kernels"] = dict(side["kernels"])
                 self._finalize_timings(timings, key)
                 return out, timings
             memwatch.sub_live(timings.pop("__live_bytes", 0.0))
@@ -646,7 +656,7 @@ class _DistTrace(dx._Trace):
                 # be packed with PAIR-aligned bounds/dictionaries (the
                 # single-device _align_pair rules) or identical logical
                 # keys would hash differently per side
-                lkey, lok, rkey, rok = self._join_key_arrays(
+                lkey, lok, rkey, rok, _span = self._join_key_arrays(
                     [self.eval(k, lctx) for k in node.left_keys],
                     [self.eval(k, rctx) for k in node.right_keys],
                     lctx, rctx)
@@ -667,7 +677,7 @@ class _DistTrace(dx._Trace):
             return out
         # probe side is the right: left must be visible in full
         if ls and rs:
-            lkey, lok, rkey, rok = self._join_key_arrays(
+            lkey, lok, rkey, rok, _span = self._join_key_arrays(
                 [self.eval(k, lctx) for k in node.left_keys],
                 [self.eval(k, rctx) for k in node.right_keys],
                 lctx, rctx)

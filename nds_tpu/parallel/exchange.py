@@ -74,11 +74,14 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     # consume rank slots (a heavily filtered shard must not overflow its
     # own bucket with corpses)
     dest = jnp.where(ok, dest, jnp.int32(n_dev))
-    # stable-group rows by destination
-    order = jnp.argsort(dest)
+    # stable-group rows by destination. Explicit int32 iota operand:
+    # jnp.argsort would carry an int64 index operand under x64, pushing
+    # the whole shuffle-grouping sort onto the TPU's emulated 64-bit
+    # path (NDS112 — same trap as device_exec._build_lookup)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    _, order = lax.sort([dest, iota], num_keys=1, is_stable=True)
     dest_s = jnp.take(dest, order)
     ok_s = jnp.take(ok, order)
-    iota = jnp.arange(n, dtype=jnp.int32)
     first_of_dest = jnp.searchsorted(dest_s, jnp.arange(n_dev, dtype=jnp.int32))
     rank = iota - jnp.take(first_of_dest,
                            jnp.clip(dest_s, 0, n_dev - 1))

@@ -92,6 +92,10 @@ class Join(Node):
     right_unique: bool = False
     output: list = field(default_factory=list)
     binding: str = ""
+    # kernel choice (engine/kernels.py): stamped by the planner from
+    # catalog size estimates; "" = legacy trace heuristics. Lives ON
+    # the node so the AOT plan fingerprint distinguishes kernel choices
+    kernel: str = ""
 
 
 @dataclass
@@ -104,6 +108,9 @@ class SemiJoin(Node):
     right_keys: list = field(default_factory=list)
     residual: Optional[ir.IR] = None
     anti: bool = False
+    # kernel choice (engine/kernels.py): "bitmask" membership tables vs
+    # "sortmerge" gather machinery; "" = legacy
+    kernel: str = ""
 
     @property
     def output(self):
@@ -128,6 +135,9 @@ class Aggregate(Node):
     group_keys: list = field(default_factory=list)   # list[(name, ir.IR)]
     aggs: list = field(default_factory=list)         # list[(name, AggSpec)]
     binding: str = ""
+    # kernel choice (engine/kernels.py): "segscan" scan-based grouped
+    # min/max vs "scatter" segment_min/max; "" = legacy (scatter)
+    kernel: str = ""
 
     @property
     def output(self):

@@ -232,15 +232,26 @@ class Planner:
                     f"{len(root.output)} columns, table has "
                     f"{len(target.fields)}")
             names = [_display_name(n) for n, _ in root.output]
-            return ("insert", stmt.table,
-                    P.PlannedQuery(root, self.scalar_subplans, names))
+            return ("insert", stmt.table, self._annotated(
+                P.PlannedQuery(root, self.scalar_subplans, names)))
         if isinstance(stmt, ast.Delete):
             if not self.catalog.has_table(stmt.table):
                 raise PlanError(f"unknown delete target {stmt.table!r}")
             return ("delete", stmt.table, stmt.where)
         root = self.plan_select(stmt, None, {})
         names = [_display_name(n) for n, _ in root.output]
-        return P.PlannedQuery(root, self.scalar_subplans, names)
+        return self._annotated(
+            P.PlannedQuery(root, self.scalar_subplans, names))
+
+    def _annotated(self, planned: P.PlannedQuery) -> P.PlannedQuery:
+        """Stamp per-node kernel choices (engine/kernels.py) from the
+        catalog's size statistics — the same stats the greedy join
+        ordering and the scheduler cost model read. The choice lives on
+        the plan nodes, so the AOT fingerprint distinguishes it and the
+        executors never re-decide per trace."""
+        from nds_tpu.engine import kernels
+        kernels.annotate(planned, catalog=self.catalog)
+        return planned
 
     # ----------------------------------------------------------- helpers
 

@@ -533,6 +533,12 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # plan-cache activity for THIS query (hits/misses/bytes +
         # deserialize ms), derived from the same metrics delta
         report.attach_cache(mdelta, timings)
+        # which relational kernels the compiled program actually used
+        # (engine/kernels.py): the block ndsreport diff watches for
+        # silent demotions to the slow paths. Read from the executor's
+        # own dict — the span-fed timings strip dunder side-channels
+        report.attach_kernels(getattr(executor, "last_timings", None)
+                              or timings)
         tlog.add(qname, elapsed_ms)
         progress["queries_completed"] += 1
         watchdog.beat(unit, query=qname, phase="done")

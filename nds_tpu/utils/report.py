@@ -256,6 +256,21 @@ class BenchReport:
             block["load_ms"] = round(load_ms, 3)
         self.summary["cache"] = block
 
+    def attach_kernels(self, timings: dict | None) -> None:
+        """Record which relational kernels the query's compiled
+        program actually used (engine/kernels.py trace counts, carried
+        in engineTimings' dunder side-channel) as the ``kernels``
+        block: ``{"join.direct": 2, "semi.bitmask": 4, ...}``. Absent
+        for queries with no kernel-lowered operators (pure scans, the
+        CPU oracle). ``ndsreport diff`` watches this block for silent
+        demotions — a planner regression that drops q21 back to
+        ``join.sortmerge`` fails the gate like a compile-count change
+        does."""
+        kern = (timings or {}).get("__kernels")
+        if kern:
+            self.summary["kernels"] = {str(k): int(v)
+                                       for k, v in sorted(kern.items())}
+
     def attach_memory(self, hwm: dict | None) -> None:
         """Record the per-query device-memory high-water mark
         (obs/memwatch.py) as the ``memory`` block:

@@ -138,6 +138,77 @@ class TestDiffGate:
                                   pct=10, abs_ms=50)["regressions"] \
             == []
 
+    def test_kernel_demotion_fails_gate(self):
+        # engine/kernels.py choices travel in the summary "kernels"
+        # block; a per-query slow-path increase is a planner
+        # regression and must fail the diff even with identical times
+        a = analyze.analyze_run(RUN_A)
+        b = analyze.analyze_run(RUN_A)
+        q = a["queries"][0]["query"]
+        a["queries"][0] = dict(a["queries"][0],
+                               kernels={"join.direct": 2})
+        b["queries"][0] = dict(b["queries"][0],
+                               kernels={"join.direct": 1,
+                                        "join.sortmerge": 1})
+        d = analyze.diff_runs(a, b)
+        assert [e["query"] for e in d["kernel_changes"]] == [q]
+        assert d["kernel_changes"][0]["demoted"] is True
+        assert not d["passed"]
+        assert "KERNEL-DEMOTED" in analyze.format_diff(d)
+
+    def test_kernel_change_without_demotion_passes(self):
+        # a changed mix with NO extra slow-path use is flagged but
+        # does not fail (e.g. direct -> matmul is a lateral move)
+        a = analyze.analyze_run(RUN_A)
+        b = analyze.analyze_run(RUN_A)
+        a["queries"][0] = dict(a["queries"][0],
+                               kernels={"join.direct": 1})
+        b["queries"][0] = dict(b["queries"][0],
+                               kernels={"join.matmul": 1})
+        d = analyze.diff_runs(a, b)
+        assert len(d["kernel_changes"]) == 1
+        assert "demoted" not in d["kernel_changes"][0]
+        assert d["passed"]
+        # kernel-less fixture runs diff with no kernel_changes at all
+        clean = analyze.diff_runs(analyze.analyze_run(RUN_A),
+                                  analyze.analyze_run(RUN_A))
+        assert clean["kernel_changes"] == []
+
+    def test_pre_kernel_baseline_never_demotes(self):
+        # a baseline recorded BEFORE the kernel layer (no kernels
+        # block) vs a new run whose correct mix includes slow-path
+        # kernels: flagged as a change, but the gate must not read
+        # the absent counts as zero and hard-fail the first
+        # cross-feature diff
+        a = analyze.analyze_run(RUN_A)
+        b = analyze.analyze_run(RUN_A)
+        b["queries"][0] = dict(b["queries"][0],
+                               kernels={"join.sortmerge": 2})
+        d = analyze.diff_runs(a, b)
+        assert len(d["kernel_changes"]) == 1
+        assert "demoted" not in d["kernel_changes"][0]
+        assert d["passed"]
+
+    def test_attribution_row_carries_kernels_and_roofline(self):
+        row = analyze.attribute_query({
+            "query": "q", "queryStatus": ["Completed"],
+            "queryTimes": [10], "startTime": 1,
+            "kernels": {"join.direct": 3},
+            "engineTimings": {"ops_per_byte": 1.25,
+                              "roofline_frac": 0.4},
+        })
+        assert row["kernels"] == {"join.direct": 3}
+        assert row["ops_per_byte"] == 1.25
+        assert row["roofline_frac"] == 0.4
+        # and the table renders a roofline column for it
+        text = analyze.format_attribution(
+            {"queries": [row],
+             "totals": {"wall_ms": 10.0,
+                        "categories": row["categories"],
+                        "residual_ms": row["residual_ms"]},
+             "slowest": ["q"]})
+        assert "roofline" in text and "1.25@40%" in text
+
     def test_parse_gate(self):
         assert analyze.parse_gate(None) == {"pct": 10.0, "abs_ms": 50.0}
         assert analyze.parse_gate("pct=5,abs_ms=1") == {

@@ -84,6 +84,48 @@ def test_join_key_arity_mismatch():
         verify(P.PlannedQuery(j, [], ["k"])))
 
 
+def test_join_kernel_choice_invariants():
+    # unknown kernel name fails; direct/matmul need a unique build;
+    # partitioned only lowers the M:N inner expansion
+    # (engine/kernels.py catalog; the planner's annotate() can only
+    # stamp names the trace can lower)
+    def _join(kernel, unique=True, kind="inner"):
+        s1 = _scan("t1", "t1", (("k", INT32),))
+        s2 = _scan("t2", "t2", (("k", INT32),))
+        return P.Join(kind, s1, s2,
+                      [ir.ColRef("t1", "k", INT32)],
+                      [ir.ColRef("t2", "k", INT32)],
+                      None, unique, output=list(s1.output),
+                      binding="t1", kernel=kernel)
+
+    assert "kernel-unknown" in _rules(verify(
+        P.PlannedQuery(_join("warp9"), [], ["k"])))
+    assert "kernel-shape" in _rules(verify(
+        P.PlannedQuery(_join("direct", unique=False), [], ["k"])))
+    assert "kernel-shape" in _rules(verify(
+        P.PlannedQuery(_join("partitioned", unique=True), [], ["k"])))
+    assert "kernel-shape" in _rules(verify(P.PlannedQuery(
+        _join("partitioned", unique=False, kind="left"), [], ["k"])))
+    # the legal shapes stay clean
+    assert verify(P.PlannedQuery(_join("direct"), [], ["k"])) == []
+    assert verify(P.PlannedQuery(
+        _join("partitioned", unique=False), [], ["k"])) == []
+
+
+def test_semi_and_agg_kernel_choice_invariants():
+    s1 = _scan("t1", "t1", (("k", INT32),))
+    s2 = _scan("t2", "t2", (("k", INT32),))
+    sj = P.SemiJoin(s1, s2, [ir.ColRef("t1", "k", INT32)],
+                    [ir.ColRef("t2", "k", INT32)], None,
+                    kernel="holodeck")
+    assert "kernel-unknown" in _rules(
+        verify(P.PlannedQuery(sj, [], ["k"])))
+    agg = P.Aggregate(_scan(), [("g", ir.ColRef("t", "a", INT32))],
+                      [], binding="a", kernel="abacus")
+    assert "kernel-unknown" in _rules(
+        verify(P.PlannedQuery(agg, [], ["g"])))
+
+
 def test_out_of_range_aggref_flags():
     # the planner remaps every AggRef onto agg-output ColRefs; one
     # surviving (here with an absurd index) must trip the verifier
@@ -364,6 +406,33 @@ def test_rule_uncached_compile():
               "    # ndslint: waive[NDS111] -- builds the traced callable only\n"
               "    return jax.jit(fn)\n")
     res = _lint(waived, enabled={"NDS111"})
+    assert res.violations == [] and len(res.waived) == 1
+
+
+def test_rule_int64_emulation_hazard():
+    # argsort/sort/searchsorted without an int32 mention flag in
+    # engine//parallel/
+    for call in ("jnp.argsort(dest)",
+                 "jnp.sort(keys)",
+                 "jnp.searchsorted(ks, q, side='left')"):
+        src = f"def f(jnp, dest, keys, ks, q):\n    return {call}\n"
+        assert _rules(_lint(src, enabled={"NDS112"}).violations) \
+            == {"NDS112"}, call
+    # an explicit int32 in the CALL is the handled-width signal
+    clean = ("def f(jnp, ks, q, n):\n"
+             "    a = jnp.searchsorted(ks, q.astype(jnp.int32))\n"
+             "    b = jnp.sort(ks.astype(jnp.int32))\n"
+             "    return a, b\n")
+    assert _lint(clean, enabled={"NDS112"}).violations == []
+    # out of scope outside engine//parallel/
+    src = "def f(jnp, x):\n    return jnp.sort(x)\n"
+    assert _lint(src, path="nds_tpu/obs/fixture.py",
+                 enabled={"NDS112"}).violations == []
+    # waivable where the 64-bit operand is genuinely required
+    waived = ("def f(jnp, x):\n"
+              "    # ndslint: waive[NDS112] -- packed key needs 64 bits\n"
+              "    return jnp.sort(x)\n")
+    res = _lint(waived, enabled={"NDS112"})
     assert res.violations == [] and len(res.waived) == 1
 
 

@@ -22,7 +22,9 @@ BenchReport summary schema (``--summary``, README "Observability"):
   (engine/scheduler.py; README "Placement & degradation"), and the
   plan-cache block cache (hits + misses required ints; optional
   errors / bytes_read / bytes_written / load_ms — nds_tpu/cache/;
-  README "Plan cache").
+  README "Plan cache"), and the kernel-use block kernels (kernel
+  name -> positive use count — engine/kernels.py; README "Kernels &
+  roofline").
 
 Exit 0 when every record validates; prints each offense otherwise.
 Run by tests/test_observability.py and tools/static_checks.py as a
@@ -211,6 +213,14 @@ def validate_summary(obj: object) -> list[str]:
             if "load_ms" in cache and (not _num(cache["load_ms"])
                                        or cache["load_ms"] < 0):
                 errs.append(f"bad cache.load_ms {cache['load_ms']!r}")
+    # kernel-use block (engine/kernels.py; README "Kernels &
+    # roofline"): kernel name -> positive trace-time use count
+    kern = obj.get("kernels")
+    if kern is not None:
+        if (not isinstance(kern, dict)
+                or not all(isinstance(k, str) and isinstance(v, int)
+                           and v > 0 for k, v in kern.items())):
+            errs.append(f"bad kernels block {kern!r}")
     return errs
 
 

@@ -39,6 +39,13 @@ the one to run locally before pushing:
                         and every fixture BenchReport validates against
                         the summary schema (tools/ndsreport.py,
                         nds_tpu/obs/analyze.py)
+  7. ndsperf            operator-kernel microbenchmark smoke
+                        (tools/ndsperf.py --smoke): every lane runs
+                        BOTH the legacy sort-based path and the
+                        tensorized kernel (engine/kernels.py) at tiny
+                        sizes and cross-checks their results — tier-1
+                        proves both kernel paths stay runnable; the
+                        speed acceptance runs on real accelerators
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -57,6 +64,7 @@ import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
 import ndslint  # noqa: E402
+import ndsperf  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
 
@@ -123,6 +131,7 @@ def main() -> int:
         ("ndsverify", lambda: ndsverify.main([])),
         ("chaos", chaos_check.main),
         ("ndsreport", run_ndsreport_check),
+        ("ndsperf", lambda: ndsperf.main(["--smoke"])),
     ]
     failed = []
     for name, fn in sections:
