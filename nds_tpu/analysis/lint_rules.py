@@ -79,6 +79,15 @@ fails CI instead of waiting for a human audit:
                             (``_narrow_key`` / ``.astype(jnp.int32)``)
                             or waive with why the width is required.
 
+- NDS113 direct-profiler    ``jax.profiler.start_trace`` outside
+                            ``obs/profile.py``: profiler captures must
+                            route through the trigger policy so the
+                            single-active-trace invariant holds, the
+                            capture lands in the BenchReport
+                            ``profile`` block, and the on-stall hook
+                            can always grab the profiler — a stray
+                            start_trace wedges all of that.
+
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
@@ -698,12 +707,52 @@ class Int64EmulationHazardRule(Rule):
         return out
 
 
+class DirectProfilerRule(Rule):
+    """NDS113: a ``jax.profiler.start_trace`` call outside
+    ``obs/profile.py``. The profiler allows one active trace per
+    process; the profile module owns that invariant (trigger policy,
+    BenchReport ``profile`` block, the watchdog's on-stall capture),
+    and a stray start_trace elsewhere wedges every managed capture
+    after it. Route through ``obs.profile`` (``stream_trace`` /
+    ``Profiler.capture``) instead."""
+
+    id = "NDS113"
+    name = "direct-profiler"
+    paths = ("nds_tpu/", "tools/")
+    ALLOWED = ("obs/profile.py",)
+
+    def check(self, tree, src, path):
+        norm = path.replace("\\", "/")
+        if any(a in norm for a in self.ALLOWED):
+            return []
+        out = []
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "start_trace"):
+                continue
+            v = n.func.value
+            via_profiler = (
+                (isinstance(v, ast.Attribute) and v.attr == "profiler")
+                or (isinstance(v, ast.Name) and v.id == "profiler"))
+            if via_profiler:
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    "direct jax.profiler.start_trace outside "
+                    "obs/profile.py — captures must route through the "
+                    "profile trigger policy (obs.profile.stream_trace "
+                    "/ Profiler.capture), or waive with why this site "
+                    "must own the profiler"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
-            UncachedCompileRule(), Int64EmulationHazardRule()]
+            UncachedCompileRule(), Int64EmulationHazardRule(),
+            DirectProfilerRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -31,6 +31,7 @@ def _stream_specs(data_dir: str, stream_paths: list[str], out_dir: str,
     """Supervised-stream specs for a power-driver fleet (shared with
     NDS-H, which passes its own module + stream parser)."""
     from nds_tpu.obs.snapshot import SNAP_ENV, parse_spec
+    from nds_tpu.obs.trace import TRACE_ENV
     from nds_tpu.resilience.supervise import StreamSpec
     from nds_tpu.utils.power_core import subprocess_env
     specs = []
@@ -38,6 +39,14 @@ def _stream_specs(data_dir: str, stream_paths: list[str], out_dir: str,
         name = os.path.splitext(os.path.basename(sp))[0]
         env = subprocess_env(backend)
         hb = os.path.join(out_dir, f"{name}_hb.json")
+        if env.get(TRACE_ENV):
+            # one trace shard PER STREAM: N children appending to one
+            # JSONL interleave partial lines under buffered writes.
+            # Each child also pins its export pid to the stream index
+            # (obs/fleet.py reads NDS_TPU_STREAM), so the merged
+            # timeline's lanes are deterministic across runs
+            troot, text = os.path.splitext(env[TRACE_ENV])
+            env[TRACE_ENV] = f"{troot}_{name}{text or '.jsonl'}"
         if env.get(SNAP_ENV):
             # one snapshot file PER STREAM: N subprocesses inheriting
             # the same path would race on it (and on its .tmp),

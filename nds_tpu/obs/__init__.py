@@ -7,11 +7,16 @@ per-query device-memory high-water marks; ``nds_tpu.obs.snapshot`` —
 the live metrics emitter (``NDS_TPU_METRICS_SNAP``);
 ``nds_tpu.obs.analyze`` — run-dir ingestion, time attribution, the
 cross-run regression gate, and the HTML report behind
-``tools/ndsreport.py``.  ``query_timings`` is the span-fed replacement
-for scraping ``executor.last_timings`` by hand.
+``tools/ndsreport.py``; ``nds_tpu.obs.fleet`` — per-rank trace
+shards, the clock-alignment handshake, and the always-on flight
+recorder; ``nds_tpu.obs.profile`` — on-demand XLA profiler capture
+behind a trigger policy (``NDS_TPU_PROFILE``).  ``query_timings`` is
+the span-fed replacement for scraping ``executor.last_timings`` by
+hand.
 
-``analyze``/``snapshot`` import lazily on attribute access — the hot
-engine path pays for spans and counters only.
+``analyze``/``snapshot``/``fleet``/``profile`` import lazily on
+attribute access — the hot engine path pays for spans and counters
+only.
 """
 
 from __future__ import annotations
@@ -19,12 +24,12 @@ from __future__ import annotations
 from nds_tpu.obs import memwatch, metrics, trace
 from nds_tpu.obs.trace import get_tracer
 
-__all__ = ["analyze", "memwatch", "metrics", "snapshot", "trace",
-           "get_tracer", "query_timings"]
+__all__ = ["analyze", "fleet", "memwatch", "metrics", "profile",
+           "snapshot", "trace", "get_tracer", "query_timings"]
 
 
 def __getattr__(name: str):
-    if name in ("analyze", "snapshot"):
+    if name in ("analyze", "snapshot", "fleet", "profile"):
         import importlib
         return importlib.import_module(f"nds_tpu.obs.{name}")
     raise AttributeError(name)

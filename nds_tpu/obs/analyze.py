@@ -41,9 +41,12 @@ import json
 import os
 
 # attribution categories, in display order (retry_backoff comes from
-# the summary's retry accounting, not from spans; residual is computed)
+# the summary's retry accounting, straggler_wait from cross-rank span
+# pairing in fleet runs — both not from this rank's spans; residual is
+# computed)
 CATEGORIES = ("parse_plan", "compile", "execute", "materialize",
-              "host_staging", "exchange", "retry_backoff")
+              "host_staging", "exchange", "straggler_wait",
+              "retry_backoff")
 
 # span name -> category (exact names; see README span taxonomy)
 _SPAN_CATEGORY = {
@@ -147,6 +150,12 @@ def attribute_query(summary: dict) -> dict:
     for k in ("ops_per_byte", "roofline_frac"):
         if isinstance(et.get(k), (int, float)):
             row[k] = float(et[k])
+    # on-demand XLA capture (obs/profile.py; README "Fleet &
+    # profiling"): which trigger fired and where the capture landed
+    prof = summary.get("profile")
+    if isinstance(prof, dict) and prof.get("path"):
+        row["profile"] = {"trigger": str(prof.get("trigger", "query")),
+                          "path": str(prof["path"])}
     return row
 
 
@@ -182,14 +191,27 @@ def load_summaries(run_dir: str) -> list[dict]:
     return out
 
 
-def load_trace_events(run_dir: str) -> list[dict]:
+def load_trace_events(run_dir: str,
+                      fleet_meta: "list[dict] | None" = None
+                      ) -> list[dict]:
     """All Chrome trace events from ``*.jsonl`` files under
-    ``run_dir`` (the power loop's NDS_TPU_TRACE export)."""
+    ``run_dir`` (the power loop's NDS_TPU_TRACE export). When the run
+    dir carries fleet sidecars (``fleet-r<rank>.json``, obs/fleet.py),
+    each rank shard's timestamps are CLOCK-ALIGNED onto rank 0's
+    timeline by subtracting that rank's handshake offset — the merge
+    that makes one fleet timeline out of per-host clocks."""
+    offsets_us: dict[str, float] = {}
+    for meta in fleet_meta or []:
+        shard = meta.get("trace_shard")
+        off = meta.get("boot_offset_s")
+        if shard and meta.get("aligned") and off:
+            offsets_us[str(shard)] = float(off) * 1e6
     events = []
     for root, _dirs, files in os.walk(run_dir):
         for fname in sorted(files):
             if not fname.endswith(".jsonl"):
                 continue
+            shift = offsets_us.get(fname, 0.0)
             try:
                 with open(os.path.join(root, fname)) as f:
                     for line in f:
@@ -201,10 +223,69 @@ def load_trace_events(run_dir: str) -> list[dict]:
                         except ValueError:
                             continue
                         if isinstance(ev, dict) and ev.get("ph") == "X":
+                            if shift and isinstance(ev.get("ts"),
+                                                    (int, float)):
+                                ev["ts"] = ev["ts"] - shift
                             events.append(ev)
             except OSError:
                 continue
     return events
+
+
+# ------------------------------------------------------ fleet stragglers
+
+def straggler_stats(events: list[dict]) -> dict:
+    """Cross-rank pairing of per-query spans in a clock-aligned fleet
+    trace: for every query that ran on 2+ ranks (pid = rank, the
+    obs/fleet export contract), pair each rank's ARRIVAL at the
+    executor (its first ``device.execute`` event inside the query
+    span; the query span start as fallback) and derive the straggler
+    shape: the collective program cannot complete anywhere before the
+    LAST rank arrives, so per-rank wait = last_arrival - own_arrival,
+    the slowest rank is the last to arrive, and the skew is the full
+    arrive spread. Returns ``{query: {"wait_ms_by_rank": {rank: ms},
+    "slowest_rank", "skew_ms"}}`` — queries appearing more than once
+    on a rank are skipped (pairing instances across ranks would be
+    guesswork)."""
+    by_rank_q: dict = {}
+    dev_by_rank: dict = {}
+    for ev in events:
+        if not isinstance(ev.get("ts"), (int, float)):
+            continue
+        if ev.get("name") == "query":
+            q = (ev.get("args") or {}).get("query")
+            if q:
+                by_rank_q.setdefault(ev.get("pid"), {}).setdefault(
+                    str(q), []).append(ev)
+        elif ev.get("name") == "device.execute":
+            dev_by_rank.setdefault(ev.get("pid"), []).append(ev)
+    out: dict = {}
+    queries = set()
+    for qmap in by_rank_q.values():
+        queries.update(qmap)
+    for q in queries:
+        arrivals: dict = {}
+        for rank, qmap in by_rank_q.items():
+            evs = qmap.get(q) or []
+            if len(evs) != 1:
+                continue
+            ev = evs[0]
+            t0, t1 = ev["ts"], ev["ts"] + ev.get("dur", 0.0)
+            inside = [d["ts"] for d in dev_by_rank.get(rank, [])
+                      if t0 <= d["ts"] <= t1]
+            arrivals[rank] = min(inside) if inside else t0
+        if len(arrivals) < 2:
+            continue
+        last = max(arrivals.values())
+        slowest = max(arrivals, key=lambda r: arrivals[r])
+        out[q] = {
+            "wait_ms_by_rank": {r: round((last - t) / 1000.0, 3)
+                                for r, t in arrivals.items()},
+            "slowest_rank": slowest,
+            "skew_ms": round((last - min(arrivals.values())) / 1000.0,
+                             3),
+        }
+    return out
 
 
 def _dedupe_names(rows: list[dict]) -> None:
@@ -229,12 +310,50 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
     """Full run analysis: attribution rows, category totals, slowest-N,
     run-level metric aggregates, and trace events for the timeline.
     ``with_trace=False`` skips parsing the (potentially huge) trace
-    JSONL — the diff gate only needs the BenchReport-derived rows."""
+    JSONL — the diff gate only needs the BenchReport-derived rows
+    (fleet dirs then also skip straggler attribution, which needs the
+    merged shards)."""
     summaries = load_summaries(run_dir)
     if not summaries:
         raise ValueError(f"no BenchReport JSONs under {run_dir!r}")
     rows = [attribute_query(s) for s in summaries]
     _dedupe_names(rows)
+    # fleet runs (obs/fleet.py sidecars): merge the per-rank shards
+    # onto one clock-aligned timeline and re-bill the recording rank's
+    # execute time that was really WAITING on the slowest rank into
+    # the straggler_wait category. The move is execute -> straggler,
+    # so categories + residual still sum to wall-clock by construction
+    from nds_tpu.obs import fleet as _fleet
+    fleet_meta = _fleet.load_fleet(run_dir)
+    events = (load_trace_events(run_dir, fleet_meta) if with_trace
+              else [])
+    fleet_info = None
+    if fleet_meta:
+        fleet_info = {
+            "world": max(m.get("world", 1) for m in fleet_meta),
+            "ranks": [{k: m.get(k) for k in
+                       ("rank", "host", "pid", "boot_offset_s",
+                        "aligned", "trace_shard")}
+                      for m in fleet_meta],
+        }
+    if (fleet_info and fleet_info["world"] > 1 and events
+            and all(m.get("aligned") for m in fleet_meta)):
+        # an unaligned fleet (failed handshake) still merges, but
+        # arrival pairing against skewed clocks would invent
+        # stragglers — attribution needs the aligned timeline
+        strag = straggler_stats(events)
+        # summaries come from the primary (rank 0) recorder: its wait
+        # on the fleet's slowest rank is what re-bills
+        for row in rows:
+            s = strag.get(row["query"])
+            if not s:
+                continue
+            wait = float(s["wait_ms_by_rank"].get(0, 0.0))
+            wait = max(0.0, min(wait, row["categories"]["execute"]))
+            row["categories"]["straggler_wait"] = wait
+            row["categories"]["execute"] -= wait
+            row["straggler"] = {"skew_ms": s["skew_ms"],
+                                "slowest_rank": s["slowest_rank"]}
     totals = {c: 0.0 for c in CATEGORIES}
     residual = 0.0
     for row in rows:
@@ -254,7 +373,7 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
             # quantiles are point-in-time: keep the latest reported
             agg.update({k: h[k] for k in ("p50", "p95", "p99")
                         if k in h})
-    return {
+    out = {
         "run_dir": os.path.abspath(run_dir),
         "queries": rows,
         "totals": {"wall_ms": sum(r["wall_ms"] for r in rows),
@@ -264,9 +383,11 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
         "failed": [r["query"] for r in rows
                    if r["status"] != "Completed"],
         "metrics": {"counters": counters, "histograms": hists},
-        "trace_events": (load_trace_events(run_dir) if with_trace
-                         else []),
+        "trace_events": events,
     }
+    if fleet_info:
+        out["fleet"] = fleet_info
+    return out
 
 
 # ------------------------------------------------------------- CLI text
@@ -278,7 +399,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     short = {"parse_plan": "parse", "compile": "compile",
              "execute": "exec", "materialize": "mat",
              "host_staging": "stage", "exchange": "exch",
-             "retry_backoff": "retry"}
+             "straggler_wait": "stragl", "retry_backoff": "retry"}
     rows = analysis["queries"]
     if top:
         order = {q: i for i, q in enumerate(analysis["slowest"])}
@@ -288,12 +409,14 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     has_cache = any("cache_hits" in r for r in rows)
     has_roofline = any("ops_per_byte" in r or "roofline_frac" in r
                        for r in rows)
+    has_profile = any("profile" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
         f"{short.get(c, c):>9}" for c in cols)
         + ("  placement" if has_placement else "")
         + ("  cache" if has_cache else "")
-        + ("   roofline" if has_roofline else "") + "  status")
+        + ("   roofline" if has_roofline else "")
+        + ("  profile" if has_profile else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
         vals = [r["categories"][c] for c in CATEGORIES]
@@ -332,16 +455,38 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
                     + "@"
                     + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
             roof_col = f"  {cell:>9}"
+        prof_col = ""
+        if has_profile:
+            prof_col = ("  {:>7}".format(
+                r["profile"]["trigger"] if "profile" in r else "-"))
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + cache_col + roof_col + f"  {r['status']}")
+            + place + cache_col + roof_col + prof_col
+            + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
     lines.append("-" * len(head))
     lines.append(f"{'TOTAL':<{w}} "
                  + " ".join(f"{v:>9.1f}" for v in tvals) + "  (ms)")
+    fl = analysis.get("fleet")
+    if fl:
+        ranks = ", ".join(
+            f"r{r.get('rank')}@{r.get('host')}"
+            f"{'' if r.get('aligned') else ' (UNALIGNED)'}"
+            for r in fl.get("ranks", []))
+        lines.append(f"fleet: {fl.get('world')} rank(s): {ranks}")
+        # ALL rows, not the top-N slice: the worst-skew query need
+        # not be among the slowest by wall-clock
+        blamed = [(r["query"], r["straggler"])
+                  for r in analysis["queries"]
+                  if r.get("straggler")]
+        for q, s in sorted(blamed,
+                           key=lambda e: -e[1]["skew_ms"])[:5]:
+            lines.append(f"  straggler {q}: rank "
+                         f"{s['slowest_rank']} arrived last "
+                         f"(skew {s['skew_ms']:.1f} ms)")
     return "\n".join(lines)
 
 
@@ -572,11 +717,13 @@ def format_diff(d: dict) -> str:
 _LIGHT = {"parse_plan": "#2a78d6", "compile": "#eb6834",
           "execute": "#1baf7a", "materialize": "#eda100",
           "host_staging": "#e87ba4", "exchange": "#008300",
-          "retry_backoff": "#4a3aa7", "residual": "#b9b8b3"}
+          "straggler_wait": "#8a6d3b", "retry_backoff": "#4a3aa7",
+          "residual": "#b9b8b3"}
 _DARK = {"parse_plan": "#3987e5", "compile": "#d95926",
          "execute": "#199e70", "materialize": "#c98500",
          "host_staging": "#d55181", "exchange": "#008300",
-         "retry_backoff": "#9085e9", "residual": "#6e6d69"}
+         "straggler_wait": "#b0905a", "retry_backoff": "#9085e9",
+         "residual": "#6e6d69"}
 
 _CSS = """
 :root { color-scheme: light dark; }
@@ -656,15 +803,19 @@ def _fmt_bytes(n) -> str:
         n /= 1024
 
 
-def _timeline(events: list[dict]) -> str:
+def _timeline(events: list[dict],
+              fleet: "dict | None" = None) -> str:
     """Stream-overlap timeline: one lane per (pid, tid), one bar per
     root ``query`` event — concurrency (throughput streams) is visible
     as vertical overlap. Single-lane power runs render too (a gap map
-    is still informative)."""
+    is still informative). Fleet runs (obs/fleet.py: pid = rank,
+    shards clock-aligned at load) label each lane with its rank, so
+    the per-rank lanes read as the fleet timeline."""
     qevents = [e for e in events if e.get("name") == "query"
                and isinstance(e.get("ts"), (int, float))]
     if not qevents:
         return ""
+    ranks = {r.get("rank") for r in (fleet or {}).get("ranks", [])}
     t0 = min(e["ts"] for e in qevents)
     t1 = max(e["ts"] + e.get("dur", 0) for e in qevents)
     span_us = max(t1 - t0, 1.0)
@@ -672,7 +823,9 @@ def _timeline(events: list[dict]) -> str:
     for e in qevents:
         lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
     rows = []
-    for i, (lane, evs) in enumerate(sorted(lanes.items()), 1):
+    for i, (lane, evs) in enumerate(sorted(
+            lanes.items(), key=lambda kv: (str(kv[0][0]),
+                                           str(kv[0][1]))), 1):
         bars = "".join(
             f'<b class="c-execute" '
             f'style="left:{100.0 * (e["ts"] - t0) / span_us:.2f}%;'
@@ -680,9 +833,13 @@ def _timeline(events: list[dict]) -> str:
             f' title="{_esc(e.get("args", {}).get("query", "?"))}'
             f' {e.get("dur", 0) / 1000.0:.1f} ms"></b>'
             for e in sorted(evs, key=lambda e: e["ts"]))
+        label = (f"rank {lane[0]}" if lane[0] in ranks
+                 else f"stream {i}")
         rows.append(
-            f'<div class="lane" title="stream {i}">{bars}</div>')
-    return (f"<h2>Stream overlap timeline</h2>"
+            f'<div class="lane" title="{_esc(label)}">{bars}</div>')
+    title = ("Fleet timeline (clock-aligned)" if ranks
+             else "Stream overlap timeline")
+    return (f"<h2>{title}</h2>"
             f'<p class="muted">{len(lanes)} lane(s), '
             f"{span_us / 1e6:.2f} s span; hover a bar for the query."
             f"</p>{''.join(rows)}")
@@ -700,11 +857,23 @@ def render_html(analysis: dict, diff: dict | None = None,
         f"<p class='muted'>{len(analysis['queries'])} quer(ies), "
         f"{t['wall_ms'] / 1000.0:.2f} s total wall-clock, "
         f"{len(analysis['failed'])} failed</p>",
+    ]
+    fleet = analysis.get("fleet")
+    if fleet:
+        ranks = ", ".join(
+            f"rank {r.get('rank')} @ {_esc(r.get('host'))} "
+            f"(offset {r.get('boot_offset_s', 0):+.3f} s"
+            f"{'' if r.get('aligned') else ', UNALIGNED'})"
+            for r in fleet.get("ranks", []))
+        out.append(f"<p class='muted'>fleet: {fleet.get('world')} "
+                   f"rank(s) — {ranks}</p>")
+    out += [
         "<h2>Per-query time attribution</h2>", _legend(),
         "<table><tr><th class='q'>query</th><th>wall ms</th>"
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
         "<th>cache</th><th>retries</th><th>placement</th>"
         "<th>kernels</th><th>roofline</th>"
+        "<th>straggler</th><th>profile</th>"
         "<th>mem HWM</th><th>status</th></tr>",
     ]
     for row in analysis["queries"]:
@@ -730,6 +899,16 @@ def render_html(analysis: dict, diff: dict | None = None,
         if ob is not None or rf is not None:
             roof = ((f"{ob:.2f}" if ob is not None else "?") + " @ "
                     + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
+        strag = ""
+        if row.get("straggler"):
+            s = row["straggler"]
+            strag = (f"rank {_esc(s['slowest_rank'])} "
+                     f"(+{s['skew_ms']:.1f} ms)")
+        prof = ""
+        if row.get("profile"):
+            p = row["profile"]
+            prof = (f"<span title='{_esc(p['path'])}'>"
+                    f"{_esc(p['trigger'])}</span>")
         out.append(
             f"<tr><td class='q'>{_esc(row['query'])}</td>"
             f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
@@ -738,6 +917,7 @@ def render_html(analysis: dict, diff: dict | None = None,
             f"<td>{row['retries']}</td>"
             f"<td>{place}</td>"
             f"<td class='q'>{kern}</td><td>{roof}</td>"
+            f"<td>{strag}</td><td>{prof}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")
     out.append("</table>")
@@ -777,7 +957,8 @@ def render_html(analysis: dict, diff: dict | None = None,
                 out.append(f"<tr><td class='q'>{_esc(name)}</td>"
                            f"{cells}</tr>")
             out.append("</table>")
-    out.append(_timeline(analysis["trace_events"]))
+    out.append(_timeline(analysis["trace_events"],
+                         analysis.get("fleet")))
     out.append("</body></html>")
     return "".join(out)
 

@@ -1,0 +1,353 @@
+"""On-demand XLA profiler capture behind a trigger policy.
+
+The span tracer sees the engine's phases; it cannot see INSIDE a
+compiled program — which fusion dominated, whether a collective sat
+waiting, what the MXU actually did. ``jax.profiler`` can, but leaving
+it on for a multi-hour run is a disk- and overhead-disaster. This
+module is the Execution-Templates-shaped compromise (PAPERS.md):
+validate cheaply always (spans + metrics), pay for deep capture only
+when a trigger says a query deserves it.
+
+Triggers (``engine.profile.{mode,slow_query_ms,dir}`` config keys, or
+``NDS_TPU_PROFILE=<mode>@<dir>`` for subprocess fleets):
+
+- ``mode`` names queries explicitly (``query21`` or
+  ``query21,query72``) — those queries capture on every run;
+- ``mode=all`` captures every query (short diagnostic streams);
+- ``mode=slow`` captures any query whose PREVIOUS run in this process
+  exceeded ``slow_query_ms`` (the first slow run arms the trigger, the
+  next run pays the capture — a steady-state profile, not the
+  compile-tainted first one);
+- ``mode=stall`` (the env default) arms only the watchdog hook below.
+
+Whenever a profiler is configured, a watchdog stall additionally
+REQUESTS an on-demand capture (via the resilience/watchdog stall-hook
+registry): the hook reserves the capture path — pure bookkeeping, so
+the stall report can point at it (``profile`` key) — and the MAIN
+thread takes the capture at its next dispatch safe-point, bracketing
+the first post-stall query into exactly that path. Deferred on
+purpose: ``start_trace`` from a non-main thread wedges against an
+active main thread on this jaxlib (and a wedged hook would disarm the
+watchdog's own kill action), so a transient stall leaves device-level
+evidence and a hard hang still leaves the flight dump + stacks.
+
+Every capture lands under ``dir`` as its own subdirectory, is recorded
+in the query's BenchReport as the ``profile`` block
+``{path, trigger, bytes}`` (validated by ``tools/check_trace_schema.py
+--summary``), and counts on ``profile_captures_total``. All
+``jax.profiler`` entry points live HERE — ndslint NDS113 flags
+``start_trace`` calls anywhere else — and every capture failure
+degrades to a warning, never a query failure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from nds_tpu.obs import metrics as obs_metrics
+
+PROFILE_ENV = "NDS_TPU_PROFILE"
+
+# trigger vocabulary the BenchReport profile block carries
+TRIGGERS = ("query", "slow", "stall", "stream")
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                continue
+    return total
+
+
+class ProfilePolicy:
+    """Parsed trigger configuration (pure — unit-testable without
+    jax)."""
+
+    def __init__(self, out_dir: str, mode: str = "stall",
+                 slow_query_ms: float = 0.0):
+        self.out_dir = out_dir
+        self.mode = (mode or "stall").strip()
+        self.slow_query_ms = float(slow_query_ms or 0.0)
+        self.queries = ()
+        if self.mode not in ("all", "slow", "stall"):
+            self.queries = tuple(
+                q.strip() for q in self.mode.split(",") if q.strip())
+
+    @classmethod
+    def from_config(cls, config) -> "ProfilePolicy | None":
+        """``engine.profile.dir`` activates; mode/slow_query_ms shape
+        the trigger. Falls back to ``NDS_TPU_PROFILE=<mode>@<dir>``
+        (mode optional — bare ``dir`` arms stall-only capture;
+        ``slow=MS`` spells the slow trigger inline)."""
+        d = config.get("engine.profile.dir") if config else None
+        if d:
+            return cls(str(d),
+                       str(config.get("engine.profile.mode", "stall")),
+                       float(config.get("engine.profile.slow_query_ms",
+                                        0) or 0))
+        spec = os.environ.get(PROFILE_ENV)
+        if not spec:
+            return None
+        mode, sep, out_dir = spec.rpartition("@")
+        if not sep:
+            return cls(spec)
+        slow_ms = 0.0
+        if mode.startswith("slow="):
+            slow_ms, mode = float(mode[len("slow="):]), "slow"
+        return cls(out_dir, mode, slow_ms)
+
+    def trigger_for(self, qname: str,
+                    prev_ms: "float | None") -> "str | None":
+        """Pre-query decision: capture this run? (``stall`` mode never
+        pre-triggers — it only arms the watchdog hook.)"""
+        if self.mode == "all" or qname in self.queries:
+            return "query"
+        if (self.mode == "slow" and self.slow_query_ms > 0
+                and prev_ms is not None
+                and prev_ms > self.slow_query_ms):
+            return "slow"
+        return None
+
+
+class Profiler:
+    """The engine's ONE ``jax.profiler`` owner: programmatic
+    start/stop captures with per-query history for the slow trigger."""
+
+    def __init__(self, policy: ProfilePolicy):
+        self.policy = policy
+        # query name -> last observed wall-clock ms (the slow trigger's
+        # "previous run" memory; process-local by design — a serving
+        # process watches its own latency)
+        self.history: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._active = False
+        self._warned = False
+        self._seq = 0
+        # capture path a stall hook reserved for the main thread to
+        # fill at its next dispatch safe-point (take_pending)
+        self._pending: "str | None" = None
+
+    # ------------------------------------------------------- decisions
+
+    def trigger_for(self, qname: str) -> "str | None":
+        return self.policy.trigger_for(qname, self.history.get(qname))
+
+    def observe(self, qname: str, elapsed_ms: float) -> None:
+        self.history[qname] = float(elapsed_ms)
+
+    # -------------------------------------------------------- captures
+
+    def _capture_dir(self, label: str) -> str:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in label)
+        # pid-suffixed: ranks/streams of one fleet share out_dir, and
+        # the profiler names its files by HOSTNAME — two processes on
+        # one host writing the same capture dir would collide
+        return os.path.join(self.policy.out_dir,
+                            f"{safe}-p{os.getpid()}-{seq}")
+
+    def _start(self, path: str) -> bool:
+        """Begin a capture (False when one is already running — jax
+        allows a single active trace per process)."""
+        with self._lock:
+            if self._active:
+                return False
+            self._active = True
+        try:
+            import jax
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            return True
+        except Exception as exc:  # noqa: BLE001 - never fail the query
+            with self._lock:
+                self._active = False
+            self._warn(exc)
+            return False
+
+    def _stop(self) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - never fail the query
+            self._warn(exc)
+        finally:
+            with self._lock:
+                self._active = False
+
+    def _warn(self, exc: BaseException) -> None:
+        obs_metrics.counter("profile_errors_total").inc()
+        if not self._warned:
+            self._warned = True
+            print(f"[obs] XLA profiler capture failed: "
+                  f"{type(exc).__name__}: {exc}")
+
+    @contextlib.contextmanager
+    def capture(self, qname: str, trigger: str,
+                path: "str | None" = None):
+        """Context manager bracketing one query's capture; yields the
+        ``profile`` block dict (empty when the capture could not run —
+        callers attach it only when a ``path`` landed). ``path``
+        overrides the capture directory — the stall-drain path, where
+        the stall report already published where the capture will
+        be."""
+        info: dict = {}
+        path = path or self._capture_dir(qname)
+        started = self._start(path)
+        try:
+            yield info
+        finally:
+            if started:
+                self._stop()
+                info.update({"path": path, "trigger": trigger,
+                             "bytes": _dir_bytes(path)})
+                obs_metrics.counter("profile_captures_total").inc()
+
+    def request_stall_capture(self, label: str) -> str:
+        """Reserve (and return) the capture path for a stall — called
+        from the WATCHDOG thread, so it must not touch the profiler or
+        jax at all: ``start_trace`` from a non-main thread wedges
+        against an active main thread on this jaxlib, and a wedged
+        hook would disarm the watchdog's kill action. The main thread
+        drains the reservation at its next dispatch safe-point
+        (``take_pending``) and captures the first post-stall query
+        into exactly this path; repeat stalls before the drain share
+        the one reservation."""
+        # path computed BEFORE taking the lock: _capture_dir takes the
+        # same (non-reentrant) lock for its sequence number
+        path = self._capture_dir(f"stall-{label}")
+        with self._lock:
+            if self._pending is None:
+                self._pending = path
+            return self._pending
+
+    def take_pending(self) -> "str | None":
+        """Claim the reserved stall-capture path (main thread, once)."""
+        with self._lock:
+            path, self._pending = self._pending, None
+            return path
+
+    def requeue_pending(self, path: str) -> None:
+        """Put a claimed-but-unfilled reservation back (the capture
+        failed to start): the stall report's pointer keeps its chance
+        of being filled by a later query."""
+        with self._lock:
+            if self._pending is None:
+                self._pending = path
+
+
+_PROFILER: "Profiler | None" = None
+
+
+def _stall_hook(run_dir: str, entry: dict) -> "dict | None":
+    prof = _PROFILER
+    if prof is None:
+        return None
+    path = prof.request_stall_capture(
+        str(entry.get("query") or entry.get("phase") or "unknown"))
+    if not path:
+        return None
+    # forward declaration, stated as one: the capture lands at this
+    # path when the run reaches its next dispatch — a hard hang or a
+    # kill-action exit leaves the pointer unfilled by design
+    return {"profile": path, "profile_pending": True}
+
+
+def configure(config) -> "Profiler | None":
+    """Build + install the process profiler for this run (None when no
+    policy is configured — the common case costs one dict lookup).
+    Registers the watchdog stall hook while armed. A malformed spec
+    (``NDS_TPU_PROFILE=slow=fast@/d``, a non-numeric
+    ``engine.profile.slow_query_ms``) degrades to a warned no-profiler
+    run — an observability typo must never fail the benchmark."""
+    global _PROFILER
+    from nds_tpu.resilience import watchdog
+    try:
+        policy = ProfilePolicy.from_config(config)
+    except Exception as exc:  # noqa: BLE001 - degrade, never fail a run
+        obs_metrics.counter("profile_errors_total").inc()
+        print(f"[obs] bad profile config ignored: "
+              f"{type(exc).__name__}: {exc}")
+        policy = None
+    if policy is None:
+        _PROFILER = None
+        watchdog.unregister_stall_hook(_stall_hook)
+        return None
+    _PROFILER = Profiler(policy)
+    watchdog.register_stall_hook(_stall_hook)
+    return _PROFILER
+
+
+def profiler() -> "Profiler | None":
+    return _PROFILER
+
+
+def teardown() -> None:
+    """End-of-run teardown: drop the trigger profiler, its stall hook,
+    and any stream trace an exception carried past the power loop."""
+    global _PROFILER
+    from nds_tpu.resilience import watchdog
+    _PROFILER = None
+    watchdog.unregister_stall_hook(_stall_hook)
+    end_stream_trace()
+
+
+# whole-stream trace state: begin/end split (instead of only a context
+# manager) so the power loop's OUTER finally can close a trace an
+# exception carried past the loop — a leaked active trace wedges every
+# later capture in the process (single-active-trace invariant)
+_stream_active = False
+
+
+def begin_stream_trace(profile_dir: "str | None") -> bool:
+    """Open the whole-stream capture (the power drivers'
+    ``--profile_dir``): one trace spanning every query, each
+    annotated. The jax.profiler start/stop pair lives here so NDS113
+    holds stack-wide. Returns whether a trace is now active."""
+    global _stream_active
+    if not profile_dir or _stream_active:
+        return bool(_stream_active)
+    import jax
+    os.makedirs(profile_dir, exist_ok=True)
+    jax.profiler.start_trace(profile_dir)
+    _stream_active = True
+    return True
+
+
+def end_stream_trace() -> None:
+    """Close the whole-stream capture (idempotent — the power loop
+    calls it on the normal path AND from its outer finally)."""
+    global _stream_active
+    if not _stream_active:
+        return
+    _stream_active = False
+    import jax
+    try:
+        jax.profiler.stop_trace()
+    except Exception as exc:  # noqa: BLE001 - teardown best effort
+        print(f"[obs] stream trace stop failed: "
+              f"{type(exc).__name__}: {exc}")
+
+
+@contextlib.contextmanager
+def stream_trace(profile_dir: "str | None"):
+    """Context-managed form of begin/end_stream_trace."""
+    try:
+        yield begin_stream_trace(profile_dir)
+    finally:
+        end_stream_trace()
+
+
+def annotate(qname: str):
+    """Named TraceAnnotation for one query inside a stream capture
+    (the jax-profiler analog of the reference's setJobGroup)."""
+    import jax
+    return jax.profiler.TraceAnnotation(qname)

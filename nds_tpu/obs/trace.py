@@ -49,6 +49,53 @@ _EPOCH_OFFSET = time.time() - time.perf_counter()
 
 _EXPORT_LOCK = threading.Lock()
 
+# deterministic export identity (obs/fleet.py): multi-process fleets
+# export with pid=rank and supervised throughput streams with
+# pid=stream index, so merged Chrome traces get stable, collision-free
+# lanes instead of OS pids that can collide across hosts (and are
+# arbitrary between runs). None = the legacy os.getpid() default.
+_EXPORT_PID: int | None = None
+# thread ident -> small stable lane id (1 = first exporting thread,
+# usually main): Chrome/Perfetto lanes stay readable and two shards
+# merged into one timeline cannot alias each other's giant pthread ids
+_TID_MAP: dict[int, int] = {}
+
+
+def set_export_pid(pid: int | None) -> None:
+    """Pin the pid every exported event carries (rank in a fleet,
+    stream index in a subprocess throughput fleet). ``None`` restores
+    the os.getpid() default."""
+    global _EXPORT_PID
+    _EXPORT_PID = None if pid is None else int(pid)
+
+
+def export_pid() -> int:
+    return os.getpid() if _EXPORT_PID is None else _EXPORT_PID
+
+
+def _compact_tid(ident: int) -> int:
+    tid = _TID_MAP.get(ident)
+    if tid is None:
+        with _EXPORT_LOCK:
+            tid = _TID_MAP.setdefault(ident, len(_TID_MAP) + 1)
+    return tid
+
+
+def epoch_offset() -> float:
+    """The perf_counter->epoch calibration exported ``ts`` values use —
+    the clock basis the fleet clock handshake (obs/fleet.py) must
+    measure, or per-rank offsets would correct a different clock than
+    the one stamping the events."""
+    return _EPOCH_OFFSET
+
+
+def _shift_epoch_offset(seconds: float) -> None:
+    """TEST HOOK: skew this process's export clock by ``seconds`` —
+    how the fleet-merge tests simulate two hosts with disagreeing
+    wall clocks without touching the host clock."""
+    global _EPOCH_OFFSET
+    _EPOCH_OFFSET += seconds
+
 # begin() default-parent sentinel: "whatever span is current on this
 # thread" (None must stay expressible as "force a root")
 _CURRENT = object()
@@ -119,8 +166,12 @@ class Span:
 
     def to_events(self, pid: int | None = None) -> list[dict]:
         """Chrome trace-event dicts ("X" complete events) for this span
-        and every descendant."""
-        pid = os.getpid() if pid is None else pid
+        and every descendant. ``pid`` defaults to the process's export
+        identity (``set_export_pid`` — rank in a fleet, stream index in
+        a throughput fleet, os.getpid() otherwise); tids are compact
+        per-process lane ids, not raw pthread idents, so merged
+        multi-shard traces never alias lanes."""
+        pid = export_pid() if pid is None else pid
         out = []
         for s in self.walk():
             out.append({
@@ -130,7 +181,7 @@ class Span:
                 "ts": (s.t0 + _EPOCH_OFFSET) * 1e6,
                 "dur": s.dur_ms * 1000.0,
                 "pid": pid,
-                "tid": s.tid,
+                "tid": _compact_tid(s.tid),
                 "args": _json_safe(s.attrs),
             })
         return out

@@ -178,12 +178,29 @@ class DistributedExecutor(dx.DeviceExecutor):
         def make(slack):
             def fn(shard_bufs, repl_bufs):
                 tr = _DistTrace(self, {**shard_bufs, **repl_bufs}, slack)
-                row, outs, dicts = tr.run_query(planned)
+                # collect per-shuffle destination-skew ratios at trace
+                # time (parallel/exchange.skew_trace): the program
+                # returns the worst one so the executor can publish
+                # the exchange_skew_ratio gauge host-side — an output,
+                # not a debug callback, so the executable still
+                # serializes into the AOT plan cache
+                from nds_tpu.parallel.exchange import skew_trace
+                with skew_trace() as skews:
+                    row, outs, dicts = tr.run_query(planned)
                 side["dicts"] = dicts
                 side["kernels"] = dict(tr.kernels)
                 side["ops_est"] = int(tr.ops_est)
                 overflow = tr.total_overflow()
-                return row, outs, overflow
+                if skews:
+                    skew = skews[0]
+                    for s in skews[1:]:
+                        skew = jnp.maximum(skew, s)
+                    # every device sees every exchange; the fleet-wide
+                    # worst is the gauge's value
+                    skew = lax.pmax(skew, tr.axes)
+                else:
+                    skew = jnp.zeros((), jnp.float32)
+                return row, outs, overflow, skew
             return fn
 
         def build(slack):
@@ -460,10 +477,17 @@ class DistributedExecutor(dx.DeviceExecutor):
             memwatch.sample_device()
             # ndslint: waive[NDS102] -- execute bracket start; closed below after device_get
             t1 = _time.perf_counter()
-            row, outs, overflow = state["jitted"](shard_bufs, repl_bufs)
+            row, outs, overflow, skew = state["jitted"](shard_bufs,
+                                                        repl_bufs)
             # one batched device->host round trip (see DeviceExecutor)
-            row_h, outs_h, overflow_h = jax.device_get(
-                (row, outs, overflow))
+            row_h, outs_h, overflow_h, skew_h = jax.device_get(
+                (row, outs, overflow, skew))
+            if float(skew_h) > 0:
+                # worst per-shuffle destination skew this program saw:
+                # visible in live snapshots before it becomes a
+                # straggler (README "Fleet & profiling")
+                obs_metrics.gauge("exchange_skew_ratio").set(
+                    round(float(skew_h), 4))
             # ndslint: waive[NDS102] -- bracket endpoint after device_get; becomes the device.run span
             t2 = _time.perf_counter()
             if int(overflow_h) == 0:

@@ -16,12 +16,36 @@ recompile, never silent).
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.parallel.mesh import DATA_AXIS
+
+# trace-time skew collector: while a sink is active (the distributed
+# executor's program build opens one around run_query), every
+# exchange_by_dest appends its per-shuffle destination-skew ratio
+# (max/mean destination rows, a TRACED scalar) so the program can
+# return the worst skew alongside the overflow count and the executor
+# can publish the ``exchange_skew_ratio`` gauge host-side. NOT a
+# debug callback on purpose: callback-bearing executables cannot
+# serialize into the persistent AOT plan cache (PyCapsule pickling).
+_SKEW_SINK: "list | None" = None
+
+
+@contextlib.contextmanager
+def skew_trace():
+    """Collect per-shuffle skew ratios appended during one program
+    trace; yields the list the traced scalars land in."""
+    global _SKEW_SINK
+    prev, _SKEW_SINK = _SKEW_SINK, []
+    try:
+        yield _SKEW_SINK
+    finally:
+        _SKEW_SINK = prev
 
 
 def _mix64(x):
@@ -82,7 +106,26 @@ def exchange_by_dest(arrays: list, dest, ok, n_dev: int,
     _, order = lax.sort([dest, iota], num_keys=1, is_stable=True)
     dest_s = jnp.take(dest, order)
     ok_s = jnp.take(ok, order)
-    first_of_dest = jnp.searchsorted(dest_s, jnp.arange(n_dev, dtype=jnp.int32))
+    # per-destination boundaries: [:-1] are the bucket starts the rank
+    # derivation needs; the full fencepost vector also yields the
+    # per-destination row COUNTS behind the skew gauge below
+    bounds = jnp.searchsorted(dest_s,
+                              jnp.arange(n_dev + 1, dtype=jnp.int32))
+    first_of_dest = bounds[:-1]
+    if _SKEW_SINK is not None:
+        # partition-skew visibility (README "Fleet & profiling"):
+        # max/mean valid rows per destination for THIS shuffle — the
+        # signal that a key distribution is loading one device before
+        # it becomes a straggler. bounds[-1] counts the valid rows
+        # (dead rows carry the sentinel dest and sort past every
+        # real bucket)
+        counts = (bounds[1:] - bounds[:-1]).astype(jnp.float32)
+        total = bounds[-1].astype(jnp.float32)
+        ratio = jnp.where(
+            total > 0,
+            jnp.max(counts) / jnp.maximum(total / n_dev, 1e-9),
+            jnp.float32(1.0))
+        _SKEW_SINK.append(ratio)
     rank = iota - jnp.take(first_of_dest,
                            jnp.clip(dest_s, 0, n_dev - 1))
     overflow = ok_s & (rank >= bucket)

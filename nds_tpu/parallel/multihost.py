@@ -101,6 +101,76 @@ def gather_votes(vote: int) -> "list[int] | None":
         return None
 
 
+# per-process sequence for coordination-service keys/barriers: every
+# rank performs the SAME number of handshakes (one per power run), so
+# the derived ids agree across the world; a drifted count times out
+# the barrier and degrades instead of mispairing
+_kv_seq = 0
+
+_KV_TIMEOUT_MS = 15_000
+
+
+def coordination_client():
+    """The jax.distributed coordination-service client (gRPC KV store
+    + named barriers), or None single-process / when the private API
+    moved. This is the fleet-handshake transport: it works on EVERY
+    backend — XLA collectives (process_allgather) are unavailable on
+    the multi-process CPU backend that tier-1's virtual fleets run
+    on — and a barrier/KV round costs no device compilation."""
+    import jax
+    if jax.process_count() == 1:
+        return None
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - private-API drift: degrade
+        return None
+
+
+def barrier(tag: str) -> bool:
+    """Fleet-wide named barrier (True when every rank arrived; False
+    on timeout/failure — the caller degrades, never hangs). Trivially
+    True single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return True
+    client = coordination_client()
+    if client is None:
+        return False
+    try:
+        client.wait_at_barrier(tag, timeout_in_ms=_KV_TIMEOUT_MS)
+        return True
+    except Exception:  # noqa: BLE001 - alignment must degrade, not hang
+        return False
+
+
+def gather_floats(value: float) -> "list[float] | None":
+    """Allgather one float from every process over the coordination
+    service — the transport under the fleet clock handshake
+    (obs/fleet.py): a barrier releases every rank at (approximately)
+    one instant, each rank publishes its clock reading under its rank
+    key, and every rank reads all of them back. Returns None when the
+    round fails (dead coordinator, lagging rank) — the caller degrades
+    to unaligned (offset-0) shards rather than hanging the run."""
+    global _kv_seq
+    import jax
+    if jax.process_count() == 1:
+        return [float(value)]
+    client = coordination_client()
+    if client is None:
+        return None
+    _kv_seq += 1
+    prefix = f"nds_tpu/gatherf/{_kv_seq}"
+    try:
+        client.key_value_set(f"{prefix}/{jax.process_index()}",
+                             repr(float(value)))
+        return [float(client.blocking_key_value_get(
+                    f"{prefix}/{r}", _KV_TIMEOUT_MS))
+                for r in range(jax.process_count())]
+    except Exception:  # noqa: BLE001 - alignment must degrade, not hang
+        return None
+
+
 def make_global_array(mesh, spec, full_value: np.ndarray):
     """Build a global jax.Array laid out per (mesh, spec) from host data.
 

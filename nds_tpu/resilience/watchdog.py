@@ -52,6 +52,28 @@ EXIT_STALLED = 86
 _lock = threading.Lock()
 _beats: dict[str, dict] = {}
 
+# stall hooks (obs/fleet.py flight-recorder dump, obs/profile.py
+# on-stall XLA capture): called from the watchdog thread while the
+# stall report is being assembled; whatever dict a hook returns merges
+# into the report, so the report POINTS AT the artifacts the stall
+# triggered (``flight``/``profile`` keys). Hooks must be fast-ish and
+# may never raise into the watchdog (guarded below).
+_stall_hooks: list = []
+
+
+def register_stall_hook(fn) -> None:
+    """Register ``fn(run_dir, entry) -> dict | None`` to run during
+    stall-report assembly (idempotent per function object)."""
+    with _lock:
+        if fn not in _stall_hooks:
+            _stall_hooks.append(fn)
+
+
+def unregister_stall_hook(fn) -> None:
+    with _lock:
+        if fn in _stall_hooks:
+            _stall_hooks.remove(fn)
+
 
 def beat(unit: str, query: str | None = None, phase: str | None = None,
          attempt: int | None = None, **info) -> None:
@@ -139,6 +161,21 @@ def dump_stall_report(run_dir: str, unit: str, entry: dict,
         "threads": _thread_stacks(),
         "metrics": obs_metrics.snapshot(),
     }
+    # stall hooks: a registered flight recorder dumps its span ring,
+    # a registered profiler grabs an on-demand XLA capture — and the
+    # report carries pointers to both, so the post-mortem trail starts
+    # here instead of in a directory listing
+    with _lock:
+        hooks = list(_stall_hooks)
+    for hook in hooks:
+        try:
+            extra = hook(run_dir or ".", dict(entry))
+        except Exception as exc:  # noqa: BLE001 - never kill the report
+            doc.setdefault("hook_errors", []).append(
+                f"{type(exc).__name__}: {exc}")
+            continue
+        if isinstance(extra, dict):
+            doc.update(extra)
     os.makedirs(run_dir or ".", exist_ok=True)
     path = os.path.join(run_dir or ".", f"stall-{label}.json")
     n = 1

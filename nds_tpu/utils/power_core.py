@@ -51,8 +51,10 @@ from dataclasses import dataclass, field
 
 from nds_tpu import obs
 from nds_tpu.engine.session import Session
+from nds_tpu.obs import fleet as obs_fleet
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs import profile as obs_profile
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience import faults, watchdog
 from nds_tpu.resilience.retry import (
@@ -335,6 +337,10 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         if wd:
             wd.stop()
         watchdog.clear_unit(stream_name or f"power-{suite.name}")
+        # fleet teardown: the next run in this process re-arms its own
+        # flight recorder / profiler against its own run dir
+        obs_fleet.disarm_flight_recorder()
+        obs_profile.teardown()
         if snap:
             progress["current_query"] = None
             snap.stop()
@@ -361,6 +367,28 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     if backend == "distributed":
         from nds_tpu.parallel.multihost import is_primary
         primary = is_primary()
+    run_dir = (json_summary_folder
+               or os.path.dirname(time_log_path) or ".")
+    # fleet wiring (obs/fleet.py): on a multi-rank world this runs the
+    # clock handshake (every rank enters — the session above already
+    # initialized the SPMD runtime), re-points NDS_TPU_TRACE at this
+    # rank's trace-r<rank> shard, pins the Chrome export pid to the
+    # rank, and drops the fleet-r<rank>.json sidecar ndsreport's merge
+    # reads; single-rank worlds only pin the deterministic stream pid
+    fleet_meta = obs_fleet.init_fleet(run_dir,
+                                      distributed=(backend
+                                                   == "distributed"))
+    if fleet_meta and fleet_meta.get("rank"):
+        # rank-0-writes holds for ANY multi-rank world, not only the
+        # distributed backend: a fleet of rank-local sessions (each
+        # rank executing on its own devices) still shares the run dir
+        primary = False
+    flight = obs_fleet.arm_flight_recorder(
+        run_dir, rank=(fleet_meta or {}).get("rank", 0))
+    # on-demand XLA profiler (obs/profile.py): trigger policy from
+    # engine.profile.* / NDS_TPU_PROFILE; also arms the on-stall
+    # capture hook the watchdog report points at
+    profiler = obs_profile.configure(config)
     app_id = f"{suite.name}-tpu-{backend}-{int(time.time())}"
     tlog = TimeLog(app_id)
     total_start = time.perf_counter()
@@ -394,6 +422,16 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     load_report.report_on(_load_bracket)
     load_report.attach_retry(lstats)
     if "error" in load_hold:
+        # post-mortem before the raise: a CorruptArtifact (or any
+        # final load failure) dumps the flight ring so the run leaves
+        # metrics + heartbeats even though no query ever ran
+        if flight:
+            err = load_hold["error"]
+            fpath = flight.dump(
+                f"load-failed:{type(err).__name__}")
+            load_report.attach_flight(fpath,
+                                      reason=f"{type(err).__name__}",
+                                      entries=len(flight.ring))
         if json_summary_folder and primary:
             os.makedirs(json_summary_folder, exist_ok=True)
             load_report.write_summary(prefix=f"power-{app_id}",
@@ -411,15 +449,25 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     progress["queries_total"] = len(queries)
     if json_summary_folder:
         os.makedirs(json_summary_folder, exist_ok=True)
-    profiler_cm = None
-    if profile_dir:
-        # device-level traces for the whole stream (XLA op timeline per
-        # query via named TraceAnnotations) — the jax-profiler analog of
-        # the reference's setJobGroup Spark-UI hook
-        import jax
-        os.makedirs(profile_dir, exist_ok=True)
-        jax.profiler.start_trace(profile_dir)
-        profiler_cm = True
+    # device-level traces for the whole stream (XLA op timeline per
+    # query via named TraceAnnotations) — the jax-profiler analog of
+    # the reference's setJobGroup Spark-UI hook; begin/end live in
+    # obs/profile.py (NDS113: the engine's one jax.profiler owner),
+    # and the outer finally's obs_profile.teardown() closes the trace
+    # even when an exception carries past this loop
+    from contextlib import nullcontext
+    if profile_dir and profiler:
+        # single-active-trace invariant: with the whole stream under
+        # capture, every per-query/stall trigger would fail to start —
+        # and a stall report would publish a capture path that could
+        # never be filled. Explicitly one or the other, decided BEFORE
+        # the stream trace starts (no junk capture from a start/stop/
+        # restart dance).
+        print("[obs] --profile_dir stream trace active: per-query/"
+              "stall profile triggers disabled for this run")
+        obs_profile.teardown()
+        profiler = None
+    stream_prof = obs_profile.begin_stream_trace(profile_dir)
     failures = 0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
@@ -483,17 +531,36 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                         front_policy, _ex, unit, _q,
                         lambda: run_one_query(session, sql, _q, _o))
 
+        # per-query XLA capture when a trigger fires: a stall-reserved
+        # capture (the watchdog hook published the path in its stall
+        # report; the first post-stall query fills it — obs/profile.py
+        # explains why the capture cannot run on the watchdog thread),
+        # an explicitly listed query, or one whose previous run
+        # exceeded engine.profile.slow_query_ms
+        trigger = profiler.trigger_for(qname) if profiler else None
+        stall_path = profiler.take_pending() if profiler else None
+        if trigger or stall_path:
+            # a stall reservation drains into THIS query's capture —
+            # into the reserved path (the stall report already points
+            # there), under the query's own trigger when it has one
+            # (with mode=all every query is triggered; the reservation
+            # must not dangle forever)
+            cap_cm = profiler.capture(qname, trigger or "stall",
+                                      path=stall_path)
+        else:
+            cap_cm = nullcontext({})
         # exports park during the bracket (even a ~ms inline write
         # would skew span totals vs the TimeLog row) and flush after
         tracer.defer_exports = True
         try:
-            if profiler_cm:
-                import jax
-                with jax.profiler.TraceAnnotation(qname):
+            with cap_cm as cap_info:
+                if stream_prof:
+                    with obs_profile.annotate(qname):
+                        summary = report.report_on(traced_query,
+                                                   session, sql)
+                else:
                     summary = report.report_on(traced_query, session,
                                                sql)
-            else:
-                summary = report.report_on(traced_query, session, sql)
         finally:
             tracer.defer_exports = False
             tracer.flush_exports()
@@ -539,6 +606,30 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # own dict — the span-fed timings strip dunder side-channels
         report.attach_kernels(getattr(executor, "last_timings", None)
                               or timings)
+        # XLA capture bookkeeping: the profile block when a trigger
+        # fired, and the wall-clock observation arming the slow
+        # trigger for this query's NEXT run
+        if cap_info:
+            report.attach_profile(cap_info)
+        elif stall_path and profiler:
+            # the drained reservation's capture never started: put it
+            # back so a later query can still fill the stall report's
+            # forward pointer
+            profiler.requeue_pending(stall_path)
+        if profiler:
+            profiler.observe(qname, elapsed_ms)
+        # flight recorder (obs/fleet.py): the ring holds the last N
+        # span trees; a FINAL-attempt failure dumps it so the failed
+        # query's summary points at a post-mortem
+        if flight:
+            flight.record(qname, summary["queryStatus"][-1],
+                          qhold.get("span"), wall_ms=elapsed_ms,
+                          metrics_delta=mdelta)
+            if summary["queryStatus"][-1] == "Failed":
+                fpath = flight.dump(f"query-failed:{qname}")
+                report.attach_flight(
+                    fpath, reason=f"query-failed:{qname}",
+                    entries=len(flight.ring))
         tlog.add(qname, elapsed_ms)
         progress["queries_completed"] += 1
         watchdog.beat(unit, query=qname, phase="done")
@@ -547,9 +638,7 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         if json_summary_folder and primary:
             report.write_summary(prefix=f"power-{app_id}",
                                  out_dir=json_summary_folder)
-    if profiler_cm:
-        import jax
-        jax.profiler.stop_trace()
+    obs_profile.end_stream_trace()
     power_ms = int((time.perf_counter() - power_start) * 1000)
     tlog.add("Power Test Time", power_ms)
     total_ms = int((time.perf_counter() - total_start) * 1000)

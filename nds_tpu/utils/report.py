@@ -271,6 +271,33 @@ class BenchReport:
             self.summary["kernels"] = {str(k): int(v)
                                        for k, v in sorted(kern.items())}
 
+    def attach_profile(self, info: dict | None) -> None:
+        """Record an on-demand XLA profiler capture (obs/profile.py)
+        as the ``profile`` block: ``{"path", "trigger", "bytes"}``.
+        Absent when no trigger fired for this query — the common
+        summary shape is unchanged."""
+        if info and info.get("path"):
+            block = {"path": str(info["path"]),
+                     "trigger": str(info.get("trigger", "query"))}
+            if "bytes" in info:
+                block["bytes"] = int(info["bytes"])
+            self.summary["profile"] = block
+
+    def attach_flight(self, path: str | None,
+                      reason: str | None = None,
+                      entries: int | None = None) -> None:
+        """Record a flight-recorder dump (obs/fleet.py) triggered by
+        this query's final failure as the ``flight`` block:
+        ``{"path", "reason", "entries"}`` — the summary points at the
+        post-mortem instead of leaving it to a directory listing."""
+        if path:
+            block: dict = {"path": str(path)}
+            if reason:
+                block["reason"] = str(reason)
+            if entries is not None:
+                block["entries"] = int(entries)
+            self.summary["flight"] = block
+
     def attach_memory(self, hwm: dict | None) -> None:
         """Record the per-query device-memory high-water mark
         (obs/memwatch.py) as the ``memory`` block:

@@ -1,0 +1,499 @@
+"""Fleet observability tests (nds_tpu/obs/fleet.py + obs/profile.py):
+the clock-alignment handshake + per-rank shard merge on a REAL
+2-process world with artificially skewed clocks, the flight-recorder
+ring/dump schema round-trip, the watchdog stall-hook registry, the
+profiler trigger policy, straggler attribution in the analyzer, the
+deterministic Chrome-export identities, and the exchange skew gauge."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nds_tpu.obs import analyze, fleet
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.obs import trace as obs_trace
+from nds_tpu.obs.profile import ProfilePolicy, Profiler
+from nds_tpu.resilience import watchdog
+from nds_tpu.utils.config import EngineConfig
+from tools.check_trace_schema import (
+    validate_flight, validate_flight_file, validate_summary,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------- profiler triggers
+
+class TestProfilePolicy:
+    def test_explicit_query_list(self):
+        p = ProfilePolicy("/tmp/x", "query21,query72")
+        assert p.trigger_for("query21", None) == "query"
+        assert p.trigger_for("query72", 5.0) == "query"
+        assert p.trigger_for("query1", None) is None
+
+    def test_all_and_stall_modes(self):
+        assert ProfilePolicy("/t", "all").trigger_for("q", None) \
+            == "query"
+        assert ProfilePolicy("/t", "stall").trigger_for("q", 1e9) \
+            is None
+
+    def test_slow_trigger_needs_prior_run(self):
+        p = ProfilePolicy("/t", "slow", slow_query_ms=500)
+        assert p.trigger_for("q", None) is None       # no history yet
+        assert p.trigger_for("q", 400.0) is None      # under threshold
+        assert p.trigger_for("q", 501.0) == "slow"
+
+    def test_from_config_keys(self):
+        cfg = EngineConfig(overrides={
+            "engine.profile.dir": "/tmp/prof",
+            "engine.profile.mode": "slow",
+            "engine.profile.slow_query_ms": "750",
+        })
+        p = ProfilePolicy.from_config(cfg)
+        assert p.out_dir == "/tmp/prof" and p.mode == "slow"
+        assert p.slow_query_ms == 750.0
+
+    def test_from_env_spec(self, monkeypatch):
+        monkeypatch.setenv("NDS_TPU_PROFILE", "query5@/tmp/d")
+        p = ProfilePolicy.from_config(EngineConfig())
+        assert p.queries == ("query5",) and p.out_dir == "/tmp/d"
+        monkeypatch.setenv("NDS_TPU_PROFILE", "slow=250@/tmp/d")
+        p = ProfilePolicy.from_config(EngineConfig())
+        assert p.mode == "slow" and p.slow_query_ms == 250.0
+        monkeypatch.setenv("NDS_TPU_PROFILE", "/tmp/bare")
+        p = ProfilePolicy.from_config(EngineConfig())
+        assert p.mode == "stall" and p.out_dir == "/tmp/bare"
+
+    def test_profiler_history_arms_slow(self):
+        prof = Profiler(ProfilePolicy("/t", "slow", slow_query_ms=100))
+        assert prof.trigger_for("q") is None
+        prof.observe("q", 150.0)
+        assert prof.trigger_for("q") == "slow"
+
+
+# --------------------------------------------------- flight recorder
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        rec = fleet.FlightRecorder(str(tmp_path), maxlen=3)
+        for i in range(7):
+            rec.record(f"q{i}", "Completed")
+        assert [e["query"] for e in rec.ring] == ["q4", "q5", "q6"]
+
+    def test_dump_round_trips_schema(self, tmp_path):
+        rec = fleet.FlightRecorder(str(tmp_path), rank=2, maxlen=4)
+        tr = obs_trace.Tracer(enabled=True)
+        with tr.span("query", query="q1") as sp:
+            with tr.span("device.execute"):
+                pass
+        rec.record("q1", "Completed", sp, wall_ms=12.5,
+                    metrics_delta={"counters": {"queries_total": 1}})
+        rec.record("q2", "Failed")
+        path = rec.dump("query-failed:q2")
+        assert path and path.endswith("flight-r2.json")
+        assert validate_flight_file(path) == []
+        doc = json.load(open(path))
+        assert doc["rank"] == 2 and doc["reason"] == "query-failed:q2"
+        assert [e["query"] for e in doc["entries"]] == ["q1", "q2"]
+        assert doc["entries"][0]["spans"]["name"] == "query"
+
+    def test_repeat_dumps_keep_reason_history(self, tmp_path):
+        rec = fleet.FlightRecorder(str(tmp_path), maxlen=4)
+        rec.record("q", "Completed")
+        rec.dump("first")
+        path = rec.dump("second")
+        doc = json.load(open(path))
+        assert doc["reasons"] == ["first", "second"]
+        assert doc["dumps"] == 2
+
+    def test_env_zero_disables(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(fleet.FLIGHT_ENV, "0")
+        rec = fleet.FlightRecorder(str(tmp_path))
+        assert not rec.enabled
+        rec.record("q", "Completed")
+        assert rec.dump("x") is None
+        assert not os.path.exists(rec.path)
+
+    def test_arm_registers_stall_hook(self, tmp_path, monkeypatch):
+        rec = fleet.arm_flight_recorder(str(tmp_path), rank=0)
+        try:
+            assert rec is not None
+            rec.record("q7", "Completed")
+            out = fleet._flight_stall_hook(str(tmp_path),
+                                           {"query": "q7"})
+            assert out and os.path.exists(out["flight"])
+            assert validate_flight_file(out["flight"]) == []
+        finally:
+            fleet.disarm_flight_recorder()
+        assert fleet._flight_stall_hook(str(tmp_path), {}) is None
+
+
+# ------------------------------------------------ watchdog stall hooks
+
+class TestStallHooks:
+    def _stall_report(self, tmp_path):
+        wd = watchdog.Watchdog(stall_s=0.01, run_dir=str(tmp_path))
+        watchdog.reset()
+        watchdog.beat("unit-x", query="qz", phase="exec")
+        time.sleep(0.05)
+        return wd.check_once()
+
+    def test_hook_result_merges_into_report(self, tmp_path):
+        def hook(run_dir, entry):
+            return {"flight": os.path.join(run_dir, "fl.json"),
+                    "profile": "/cap/1"}
+        watchdog.register_stall_hook(hook)
+        try:
+            path = self._stall_report(tmp_path)
+            doc = json.load(open(path))
+            assert doc["flight"].endswith("fl.json")
+            assert doc["profile"] == "/cap/1"
+        finally:
+            watchdog.unregister_stall_hook(hook)
+            watchdog.reset()
+
+    def test_hook_errors_never_kill_the_report(self, tmp_path):
+        def bad(run_dir, entry):
+            raise RuntimeError("boom")
+        watchdog.register_stall_hook(bad)
+        try:
+            path = self._stall_report(tmp_path)
+            doc = json.load(open(path))
+            assert any("boom" in e for e in doc["hook_errors"])
+            assert doc["query"] == "qz"  # report itself intact
+        finally:
+            watchdog.unregister_stall_hook(bad)
+            watchdog.reset()
+
+
+# ----------------------------------------------- schema + report blocks
+
+class TestSchemaBlocks:
+    BASE = {"query": "q", "queryStatus": ["Completed"],
+            "queryTimes": [5], "startTime": 1, "env": {}}
+
+    def test_profile_block_validates(self):
+        good = {**self.BASE,
+                "profile": {"path": "/p", "trigger": "slow",
+                            "bytes": 10}}
+        assert validate_summary(good) == []
+        for bad in ({"path": "", "trigger": "query"},
+                    {"path": "/p", "trigger": "nope"},
+                    {"path": "/p"},
+                    {"path": "/p", "trigger": "query", "bytes": -1}):
+            assert validate_summary({**self.BASE, "profile": bad}), bad
+
+    def test_flight_block_validates(self):
+        good = {**self.BASE,
+                "flight": {"path": "/f", "reason": "x", "entries": 3}}
+        assert validate_summary(good) == []
+        for bad in ({"path": ""}, {"reason": "x"},
+                    {"path": "/f", "entries": -2}):
+            assert validate_summary({**self.BASE, "flight": bad}), bad
+
+    def test_flight_dump_negatives(self):
+        assert validate_flight([]) != []
+        assert validate_flight({"rank": -1}) != []
+        good = {"rank": 0, "pid": 1, "reason": "r", "ts": 1.0,
+                "entries": [{"query": "q", "status": "Completed",
+                             "ts": 1.0}],
+                "metrics": {}}
+        assert validate_flight(good) == []
+        assert validate_flight(
+            {**good, "entries": [{"query": "", "status": "Completed",
+                                  "ts": 1.0}]}) != []
+
+    def test_report_attach_helpers(self):
+        from nds_tpu.utils.report import BenchReport
+        rep = BenchReport("q")
+        rep.attach_profile({"path": "/p", "trigger": "query",
+                            "bytes": 5})
+        rep.attach_flight("/f", reason="r", entries=2)
+        assert rep.summary["profile"] == {"path": "/p",
+                                          "trigger": "query",
+                                          "bytes": 5}
+        assert rep.summary["flight"] == {"path": "/f", "reason": "r",
+                                         "entries": 2}
+        rep2 = BenchReport("q")
+        rep2.attach_profile({})      # no capture -> no block
+        rep2.attach_flight(None)
+        assert "profile" not in rep2.summary
+        assert "flight" not in rep2.summary
+
+
+# -------------------------------------------------- export identities
+
+class TestExportIds:
+    def test_export_pid_override(self):
+        tr = obs_trace.Tracer(enabled=True)
+        with tr.span("query", query="x") as sp:
+            pass
+        try:
+            obs_trace.set_export_pid(3)
+            assert sp.to_events()[0]["pid"] == 3
+        finally:
+            obs_trace.set_export_pid(None)
+        assert sp.to_events()[0]["pid"] == os.getpid()
+
+    def test_tids_are_compact_and_stable(self):
+        tr = obs_trace.Tracer(enabled=True)
+        with tr.span("query") as sp:
+            pass
+        evs = sp.to_events()
+        assert 1 <= evs[0]["tid"] <= len(obs_trace._TID_MAP)
+        assert sp.to_events()[0]["tid"] == evs[0]["tid"]
+
+    def test_stream_env_pins_export_pid(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("NDS_TPU_STREAM", "query_5")
+        try:
+            assert fleet.init_fleet(str(tmp_path)) is None
+            assert obs_trace.export_pid() == 5
+        finally:
+            obs_trace.set_export_pid(None)
+        # restarted incarnations keep the SAME lane
+        monkeypatch.setenv("NDS_TPU_STREAM", "query_5#r1")
+        try:
+            fleet.init_fleet(str(tmp_path))
+            assert obs_trace.export_pid() == 5
+        finally:
+            obs_trace.set_export_pid(None)
+
+
+# -------------------------------------------- straggler attribution
+
+def _query_event(pid, q, ts_us, dur_us):
+    return {"name": "query", "cat": "query", "ph": "X", "ts": ts_us,
+            "dur": dur_us, "pid": pid, "tid": 1, "args": {"query": q}}
+
+
+def _dev_event(pid, ts_us, dur_us):
+    return {"name": "device.execute", "cat": "device", "ph": "X",
+            "ts": ts_us, "dur": dur_us, "pid": pid, "tid": 1,
+            "args": {}}
+
+
+class TestStragglers:
+    def test_pairs_arrivals_and_blames_last_rank(self):
+        events = [
+            _query_event(0, "q1", 1_000_000, 500_000),
+            _dev_event(0, 1_050_000, 400_000),
+            _query_event(1, "q1", 1_010_000, 500_000),
+            _dev_event(1, 1_250_000, 200_000),   # rank 1 arrives late
+        ]
+        s = analyze.straggler_stats(events)
+        assert s["q1"]["slowest_rank"] == 1
+        assert s["q1"]["wait_ms_by_rank"][0] == pytest.approx(200.0)
+        assert s["q1"]["wait_ms_by_rank"][1] == pytest.approx(0.0)
+        assert s["q1"]["skew_ms"] == pytest.approx(200.0)
+
+    def test_single_rank_and_dup_instances_skipped(self):
+        events = [_query_event(0, "q1", 0, 10),
+                  _query_event(0, "q2", 0, 10),
+                  _query_event(0, "q2", 50, 10),
+                  _query_event(1, "q2", 0, 10)]
+        s = analyze.straggler_stats(events)
+        assert s == {}
+
+    def _fleet_run_dir(self, tmp_path, aligned=True):
+        """Synthetic 2-rank run dir: sidecars + shards + one rank-0
+        summary whose spans give the query 300 ms of execute."""
+        run = tmp_path / "run"
+        run.mkdir()
+        for rank, off in ((0, 0.0), (1, 2.0)):
+            (run / f"fleet-r{rank}.json").write_text(json.dumps({
+                "rank": rank, "world": 2, "host": f"h{rank}",
+                "pid": 100 + rank, "boot_offset_s": off,
+                "aligned": aligned,
+                "trace_shard": f"trace-r{rank}.jsonl", "ts": 1.0}))
+        # rank 1's shard is written 2 s AHEAD (its skewed clock); when
+        # aligned, its events land back on rank 0's timeline
+        shift = 2_000_000
+        ev0 = [_query_event(0, "query9", 1_000_000, 400_000),
+               _dev_event(0, 1_050_000, 300_000)]
+        ev1 = [_query_event(1, "query9", 1_000_000 + shift, 400_000),
+               _dev_event(1, 1_150_000 + shift, 300_000)]
+        (run / "trace-r0.jsonl").write_text(
+            "\n".join(json.dumps(e) for e in ev0) + "\n")
+        (run / "trace-r1.jsonl").write_text(
+            "\n".join(json.dumps(e) for e in ev1) + "\n")
+        summary = {
+            "query": "query9", "queryStatus": ["Completed"],
+            "queryTimes": [400], "startTime": 1, "env": {},
+            "spans": {"name": "query", "dur_ms": 400.0, "attrs": {},
+                      "children": [
+                          {"name": "device.execute", "dur_ms": 350.0,
+                           "attrs": {}, "children": [
+                               {"name": "device.run", "dur_ms": 300.0,
+                                "attrs": {}, "children": []}]}]},
+        }
+        (run / "power-x-query9-1.json").write_text(json.dumps(summary))
+        return str(run)
+
+    def test_fleet_merge_moves_execute_into_straggler_wait(
+            self, tmp_path):
+        run = self._fleet_run_dir(tmp_path)
+        a = analyze.analyze_run(run)
+        assert a["fleet"]["world"] == 2
+        row = a["queries"][0]
+        # rank 1 arrived 100 ms after rank 0 (aligned clocks): that
+        # 100 ms of rank 0's execute was really straggler wait
+        assert row["categories"]["straggler_wait"] == pytest.approx(
+            100.0, abs=1.0)
+        assert row["categories"]["execute"] == pytest.approx(
+            200.0, abs=1.0)
+        assert row["straggler"]["slowest_rank"] == 1
+        total = sum(row["categories"].values()) + row["residual_ms"]
+        assert total == pytest.approx(row["wall_ms"], abs=1e-9)
+        # alignment undid the 2 s skew: both ranks' spans overlap
+        spans = {e["pid"]: e["ts"] for e in a["trace_events"]
+                 if e["name"] == "query"}
+        assert abs(spans[0] - spans[1]) < 500_000
+        text = analyze.format_attribution(a)
+        assert "stragl" in text and "straggler query9: rank 1" in text
+        html = analyze.render_html(a)
+        assert "Fleet timeline" in html and "rank 1" in html
+
+    def test_unaligned_sidecars_merge_without_shift(self, tmp_path):
+        run = self._fleet_run_dir(tmp_path, aligned=False)
+        a = analyze.analyze_run(run)
+        spans = {e["pid"]: e["ts"] for e in a["trace_events"]
+                 if e["name"] == "query"}
+        assert spans[1] - spans[0] == pytest.approx(2_000_000)
+
+
+# ----------------------------------------------- fleet helper units
+
+class TestFleetHelpers:
+    def test_shard_path(self):
+        assert fleet.shard_path("/r/trace.jsonl", 3) \
+            == "/r/trace-r3.jsonl"
+        assert fleet.shard_path("/r/trace", 0) == "/r/trace-r0.jsonl"
+
+    def test_rank_info_single_process(self):
+        info = fleet.rank_info()
+        assert info["rank"] == 0 and info["world"] == 1
+        assert info["pid"] == os.getpid()
+
+    def test_clock_handshake_single_process(self):
+        offsets = fleet.clock_handshake()
+        assert offsets == [0.0]
+
+    def test_load_fleet_ignores_junk(self, tmp_path):
+        (tmp_path / "fleet-r0.json").write_text(
+            json.dumps({"rank": 0, "world": 2}))
+        (tmp_path / "fleet-rX.json").write_text("not json")
+        (tmp_path / "other.json").write_text("{}")
+        metas = fleet.load_fleet(str(tmp_path))
+        assert [m["rank"] for m in metas] == [0]
+
+
+# ----------------------------------------- exchange skew ratio gauge
+
+class TestExchangeSkew:
+    def test_skewed_shuffle_moves_the_gauge(self):
+        """A heavily skewed key distribution through the distributed
+        executor publishes exchange_skew_ratio > 1 after the query:
+        every lineitem row carries ONE order key, so a single
+        destination device receives the whole shuffle."""
+        import numpy as np
+
+        from nds_tpu.datagen import tpch
+        from nds_tpu.engine.session import Session
+        from nds_tpu.io.host_table import from_arrays
+        from nds_tpu.nds_h.schema import get_schemas
+        from nds_tpu.parallel.dist_exec import make_distributed_factory
+
+        schemas = get_schemas()
+        raw = tpch.gen_table("lineitem", 0.002)
+        raw["l_orderkey"] = np.ones_like(raw["l_orderkey"])
+        s = Session.for_nds_h(
+            make_distributed_factory(shard_threshold=100))
+        s.register_table(from_arrays("lineitem", schemas["lineitem"],
+                                     raw))
+        obs_metrics.gauge("exchange_skew_ratio").set(0)
+        out = s.sql(
+            "select l_orderkey, sum(l_quantity) as q from lineitem "
+            "group by l_orderkey")
+        assert len(out.to_pandas()) == 1
+        val = obs_metrics.gauge("exchange_skew_ratio").value
+        assert val > 1.5, val
+
+
+# ------------------------------------- 2-process clock-aligned merge
+
+SKEW_S = 30.0
+
+
+def test_two_rank_clock_alignment(tmp_path):
+    """Satellite acceptance: two REAL ranks with clocks skewed 30 s
+    apart produce shards + sidecars whose merge puts the paired query
+    spans back on one timeline — they overlap within tolerance after
+    alignment, and are ~30 s apart without it."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    child = os.path.join(REPO, "tests", "_fleet_child.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "NDS_TPU_TRACE")}
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(port), str(rank), "2", "2",
+         str(tmp_path), str(SKEW_S), "session"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert f"FLEET_OK rank={rank}" in out, out[-4000:]
+
+    run_dir = str(tmp_path / "run")
+    metas = fleet.load_fleet(run_dir)
+    assert [m["rank"] for m in metas] == [0, 1]
+    assert all(m["aligned"] for m in metas)
+    # the handshake measured the artificial skew (barrier jitter on
+    # localhost is far under a second)
+    assert metas[1]["boot_offset_s"] == pytest.approx(SKEW_S, abs=1.0)
+    for rank in range(2):
+        assert os.path.exists(
+            os.path.join(run_dir, f"trace-r{rank}.jsonl"))
+
+    def spans_by_query(events):
+        out = {}
+        for e in events:
+            if e.get("name") == "query":
+                q = (e.get("args") or {}).get("query")
+                out.setdefault(q, {})[e["pid"]] = (
+                    e["ts"], e["ts"] + e.get("dur", 0))
+        return out
+
+    aligned = spans_by_query(
+        analyze.load_trace_events(run_dir, metas))
+    raw = spans_by_query(analyze.load_trace_events(run_dir))
+    assert set(aligned) == {"q1", "q6", "q3"}
+    for q, by_rank in aligned.items():
+        assert set(by_rank) == {0, 1}, f"{q} missing a rank lane"
+        (s0, e0), (s1, e1) = by_rank[0], by_rank[1]
+        # collectives pair the ranks inside each query: aligned spans
+        # must overlap...
+        assert max(s0, s1) < min(e0, e1), (q, by_rank)
+        # ...while the unaligned shards sit ~SKEW_S apart
+        rs0, rs1 = raw[q][0][0], raw[q][1][0]
+        assert abs(rs1 - rs0) > (SKEW_S - 5) * 1e6
+    strag = analyze.straggler_stats(
+        analyze.load_trace_events(run_dir, metas))
+    assert set(strag) == {"q1", "q6", "q3"}
+    for q, s in strag.items():
+        assert set(s["wait_ms_by_rank"]) == {0, 1}
+        assert s["skew_ms"] < 30_000.0  # aligned: real skew, not clock

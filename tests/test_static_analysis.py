@@ -436,6 +436,39 @@ def test_rule_int64_emulation_hazard():
     assert res.violations == [] and len(res.waived) == 1
 
 
+def test_rule_direct_profiler():
+    # jax.profiler.start_trace outside obs/profile.py flags, both
+    # spellings
+    for call in ("jax.profiler.start_trace('/tmp/x')",
+                 "profiler.start_trace(d)"):
+        src = f"def f(jax, profiler, d):\n    {call}\n"
+        assert _rules(_lint(src, enabled={"NDS113"}).violations) \
+            == {"NDS113"}, call
+    # the profile module itself is the one legitimate owner
+    src = "def f(jax, d):\n    jax.profiler.start_trace(d)\n"
+    assert _lint(src, path="nds_tpu/obs/profile.py",
+                 enabled={"NDS113"}).violations == []
+    # stop_trace / unrelated start_trace attrs don't match
+    clean = ("def f(jax, server):\n"
+             "    jax.profiler.stop_trace()\n"
+             "    server.start_trace('/x')\n")
+    assert _lint(clean, enabled={"NDS113"}).violations == []
+    # the production tree holds the invariant: the only start_trace
+    # sites under nds_tpu/ + tools/ live in obs/profile.py
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    offenders = []
+    for root in ("nds_tpu", "tools"):
+        for p in (repo / root).rglob("*.py"):
+            if "start_trace" in p.read_text() \
+                    and not str(p).endswith("obs/profile.py"):
+                res = lint_rules.lint_sources(
+                    {str(p.relative_to(repo)): p.read_text()},
+                    enabled={"NDS113"})
+                offenders += res.violations
+    assert offenders == [], offenders
+
+
 def test_waiver_requires_justification_and_use():
     src = ("def f(a=[]):  # ndslint: waive[NDS106]\n"
            "    return a\n")

@@ -115,11 +115,7 @@ def run_chaos_stream(workdir: str) -> int:
     if failures != 1:
         return _fail(f"expected exactly the deterministic failure, "
                      f"got {failures}")
-    summaries = {}
-    for f in os.listdir(jsons):
-        with open(os.path.join(jsons, f)) as fh:
-            s = json.load(fh)
-        summaries[s["query"]] = s
+    summaries = _stream_summaries(jsons)
     q96, q7, q93 = (summaries.get(f"query{n}") for n in TEMPLATES)
     if not (q96 and q7 and q93):
         return _fail(f"missing summaries: {sorted(summaries)}")
@@ -185,11 +181,15 @@ def run_journal_check(workdir: str) -> int:
 
 
 def _stream_summaries(jsons: str) -> dict:
+    """BenchReport summaries in a run dir — failed queries drop
+    flight-recorder dumps (obs/fleet.py) next to them, so only files
+    with the summary keys count."""
     out = {}
     for f in os.listdir(jsons):
         with open(os.path.join(jsons, f)) as fh:
             s = json.load(fh)
-        out[s["query"]] = s
+        if isinstance(s, dict) and "query" in s and "queryStatus" in s:
+            out[s["query"]] = s
     return out
 
 

@@ -22,9 +22,12 @@ BenchReport summary schema (``--summary``, README "Observability"):
   (engine/scheduler.py; README "Placement & degradation"), and the
   plan-cache block cache (hits + misses required ints; optional
   errors / bytes_read / bytes_written / load_ms — nds_tpu/cache/;
-  README "Plan cache"), and the kernel-use block kernels (kernel
+  README "Plan cache"), the kernel-use block kernels (kernel
   name -> positive use count — engine/kernels.py; README "Kernels &
-  roofline").
+  roofline"), the XLA-capture block profile (path + trigger from the
+  obs/profile.py trigger vocabulary, optional bytes), and the
+  flight-recorder pointer flight (path + optional reason/entries —
+  obs/fleet.py; README "Fleet & profiling").
 
 Exit 0 when every record validates; prints each offense otherwise.
 Run by tests/test_observability.py and tools/static_checks.py as a
@@ -97,6 +100,9 @@ def validate_file(path: str) -> list[str]:
 
 _STATUS_VOCAB = {"Completed", "CompletedWithTaskFailures", "Failed"}
 _HWM_SOURCES = {"device", "accounted"}
+# obs/profile.py TRIGGERS — duplicated by value, not imported: this
+# validator must stay runnable standalone with no package import
+_PROFILE_TRIGGERS = {"query", "slow", "stall", "stream"}
 
 
 def _num(v) -> bool:
@@ -221,6 +227,38 @@ def validate_summary(obj: object) -> list[str]:
                 or not all(isinstance(k, str) and isinstance(v, int)
                            and v > 0 for k, v in kern.items())):
             errs.append(f"bad kernels block {kern!r}")
+    # XLA-capture block (obs/profile.py; README "Fleet & profiling"):
+    # path + trigger always travel together, bytes is optional
+    prof = obj.get("profile")
+    if prof is not None:
+        if (not isinstance(prof, dict)
+                or not isinstance(prof.get("path"), str)
+                or not prof.get("path")
+                or prof.get("trigger") not in _PROFILE_TRIGGERS):
+            errs.append(f"bad profile block {prof!r}")
+        elif "bytes" in prof and (not isinstance(prof["bytes"], int)
+                                  or isinstance(prof["bytes"], bool)
+                                  or prof["bytes"] < 0):
+            errs.append(f"bad profile.bytes {prof['bytes']!r}")
+    # flight-recorder pointer (obs/fleet.py): the failed query's
+    # summary names its post-mortem dump
+    flight = obj.get("flight")
+    if flight is not None:
+        if (not isinstance(flight, dict)
+                or not isinstance(flight.get("path"), str)
+                or not flight.get("path")):
+            errs.append(f"bad flight block {flight!r}")
+        else:
+            if "reason" in flight and not isinstance(
+                    flight["reason"], str):
+                errs.append(f"bad flight.reason "
+                            f"{flight['reason']!r}")
+            if "entries" in flight and (
+                    not isinstance(flight["entries"], int)
+                    or isinstance(flight["entries"], bool)
+                    or flight["entries"] < 0):
+                errs.append(f"bad flight.entries "
+                            f"{flight['entries']!r}")
     return errs
 
 
@@ -233,16 +271,72 @@ def validate_summary_file(path: str) -> list[str]:
     return [f"{path}: {e}" for e in validate_summary(obj)]
 
 
+def validate_flight(obj: object) -> list[str]:
+    """Schema errors for one flight-recorder dump
+    (``flight-r<rank>.json``, obs/fleet.py): rank/pid/reason/ts
+    header, a list of ring entries (query + status + ts, optional
+    span tree), and the metrics/heartbeats snapshots."""
+    if not isinstance(obj, dict):
+        return [f"flight dump is {type(obj).__name__}, not an object"]
+    errs = []
+    if not isinstance(obj.get("rank"), int) or obj["rank"] < 0:
+        errs.append(f"bad rank {obj.get('rank')!r}")
+    if not isinstance(obj.get("pid"), int):
+        errs.append("missing/invalid pid")
+    if not isinstance(obj.get("reason"), str) or not obj.get("reason"):
+        errs.append("missing/empty reason")
+    if not _num(obj.get("ts")):
+        errs.append("missing/invalid ts")
+    entries = obj.get("entries")
+    if not isinstance(entries, list):
+        errs.append(f"entries is {type(entries).__name__}, not a list")
+        entries = []
+    for i, e in enumerate(entries):
+        where = f"entries[{i}]"
+        if not isinstance(e, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        if not isinstance(e.get("query"), str) or not e.get("query"):
+            errs.append(f"{where}: missing/empty query")
+        if e.get("status") not in _STATUS_VOCAB:
+            errs.append(f"{where}: bad status {e.get('status')!r}")
+        if not _num(e.get("ts")):
+            errs.append(f"{where}: missing/invalid ts")
+        if "wall_ms" in e and (not _num(e["wall_ms"])
+                               or e["wall_ms"] < 0):
+            errs.append(f"{where}: bad wall_ms {e['wall_ms']!r}")
+        if "spans" in e:
+            errs.extend(_validate_span_tree(e["spans"],
+                                            f"{where}.spans"))
+    if not isinstance(obj.get("metrics"), dict):
+        errs.append("missing metrics object")
+    if "heartbeats" in obj and not isinstance(obj["heartbeats"], dict):
+        errs.append("heartbeats is not an object")
+    return errs
+
+
+def validate_flight_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"{path}: not JSON ({exc})"]
+    return [f"{path}: {e}" for e in validate_flight(obj)]
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) == 2 and argv[0] == "--summary":
         errors = validate_summary_file(argv[1])
         target = argv[1]
+    elif len(argv) == 2 and argv[0] == "--flight":
+        errors = validate_flight_file(argv[1])
+        target = argv[1]
     elif len(argv) == 1:
         errors = validate_file(argv[0])
         target = argv[0]
     else:
-        print("usage: check_trace_schema.py [--summary] FILE")
+        print("usage: check_trace_schema.py [--summary|--flight] FILE")
         return 2
     for e in errors:
         print(e)

@@ -1,0 +1,248 @@
+"""Fleet observability gate: a REAL 2-process NDS-H power run on a
+virtual mesh, asserted end-to-end.
+
+tier-1 (via tools/static_checks.py) launches two OS processes — each
+with 2 virtual CPU devices, joined into one jax.distributed world —
+running the NDS-H power driver path (``power_core.run_query_stream``,
+``--backend distributed``) over a tiny raw warehouse, with:
+
+- **artificially skewed clocks** (30 s apart): the fleet clock
+  handshake (obs/fleet.py) must measure the skew, each rank must
+  write its own ``trace-r<rank>.jsonl`` shard + ``fleet-r<rank>.json``
+  sidecar, and ``ndsreport analyze`` must merge the shards into ONE
+  clock-aligned timeline — paired per-rank query spans overlap after
+  alignment (they are 30 s apart before), the attribution table
+  carries the ``straggler_wait`` column, and categories + residual
+  still sum to wall-clock by construction;
+
+- **an induced stall** (``stream.query:hang`` at one query, injected
+  in BOTH ranks so the SPMD world stays paired, watchdog armed at
+  ``stall_s=2``): every rank's watchdog must dump a flight-recorder
+  ``flight-r<rank>.json`` that round-trips the flight schema
+  (tools/check_trace_schema.py --flight) AND grab an on-demand XLA
+  profiler capture, with the stall report pointing at both;
+
+- **a profile trigger** (``engine.profile.mode=query1`` — the first
+  query in stream order, so its capture happens before the induced
+  stall): the triggered query's BenchReport must carry a nonzero
+  ``profile`` block (path on disk, bytes > 0) that validates against
+  the summary schema, and the stall's reserved capture path must be
+  filled by the first post-stall query (query6 here).
+
+This is the gate behind ROADMAP items 3 and 4: a multi-host run that
+stalls or straggles must leave a merged timeline, a post-mortem dump,
+and device-level evidence — proven here on every CI run, not first
+discovered on a real pod.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import check_trace_schema  # noqa: E402
+
+SKEW_S = 30.0
+HANG_QUERY = "query3"
+PROFILED_QUERY = "query1"
+SCALE = 0.005
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _launch_fleet(workdir: str) -> "list[str] | None":
+    """Two power-run ranks over one warehouse; returns their stdouts
+    (None on failure, after printing the offender's tail)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.join(repo, "tests", "_fleet_child.py")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "NDS_TPU_TRACE",
+                        "NDS_TPU_FAULTS", "NDS_TPU_PROFILE")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    # both ranks hang at the same query: the stall is fleet-wide (the
+    # SPMD world stays paired), and every rank's watchdog must leave a
+    # post-mortem
+    env["NDS_TPU_FAULTS"] = f"stream.query:hang=8@{HANG_QUERY}"
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(port), str(rank), "2", "2",
+         workdir, str(SKEW_S), "power"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=570)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("FAIL: fleet children timed out")
+        return None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"FLEET_OK rank={rank}" not in out:
+            print(f"FAIL: rank {rank} rc={p.returncode}:\n"
+                  f"{out[-4000:]}")
+            return None
+    return outs
+
+
+def check_fleet_run(workdir: str) -> int:
+    from nds_tpu.nds_h import gen_data, streams
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "streams")
+    run_dir = os.path.join(workdir, "run")
+    gen_data.generate_data_local(SCALE, 2, raw, workers=2)
+    streams.generate_query_streams(sdir, 1)
+    if _launch_fleet(workdir) is None:
+        return 1
+
+    # 1. per-rank artifacts: trace shards, sidecars, flight dumps
+    errors = []
+    for rank in range(2):
+        for name in (f"trace-r{rank}.jsonl", f"fleet-r{rank}.json",
+                     f"flight-r{rank}.json"):
+            if not os.path.exists(os.path.join(run_dir, name)):
+                errors.append(f"missing {name} in run dir")
+    if errors:
+        return _fail("; ".join(errors))
+    for rank in range(2):
+        errs = check_trace_schema.validate_flight_file(
+            os.path.join(run_dir, f"flight-r{rank}.json"))
+        if errs:
+            return _fail(f"flight-r{rank}.json schema: {errs}")
+        errs = check_trace_schema.validate_file(
+            os.path.join(run_dir, f"trace-r{rank}.jsonl"))
+        if errs:
+            return _fail(f"trace-r{rank}.jsonl schema: {errs[:5]}")
+    with open(os.path.join(run_dir, "fleet-r1.json")) as f:
+        side1 = json.load(f)
+    if not side1.get("aligned"):
+        return _fail(f"rank 1 handshake not aligned: {side1}")
+    off = float(side1.get("boot_offset_s", 0.0))
+    if abs(off - SKEW_S) > 2.0:
+        return _fail(f"rank 1 offset {off:.3f}s should measure the "
+                     f"{SKEW_S:.0f}s skew")
+
+    # 2. the induced stall left reports pointing at flight + profile
+    stall_docs = []
+    for name in sorted(os.listdir(run_dir)):
+        if name.startswith("stall-"):
+            with open(os.path.join(run_dir, name)) as f:
+                stall_docs.append(json.load(f))
+    pointed = [d for d in stall_docs
+               if d.get("flight") and d.get("profile")]
+    if not pointed:
+        return _fail(f"no stall report carries flight+profile "
+                     f"pointers ({len(stall_docs)} report(s))")
+    for key in ("flight", "profile"):
+        if not os.path.exists(pointed[0][key]):
+            return _fail(f"stall report points at missing {key}: "
+                         f"{pointed[0][key]}")
+
+    # 3. the profile-triggered query's BenchReport carries a nonzero
+    # profile block (and every summary validates)
+    prof_block = None
+    for name in sorted(os.listdir(run_dir)):
+        if not name.endswith(".json") or "power-" not in name:
+            continue
+        path = os.path.join(run_dir, name)
+        errs = check_trace_schema.validate_summary_file(path)
+        if errs:
+            return _fail(f"summary schema: {errs[:5]}")
+        with open(path) as f:
+            s = json.load(f)
+        if s.get("query") == PROFILED_QUERY and "profile" in s:
+            prof_block = s["profile"]
+    if not prof_block:
+        return _fail(f"{PROFILED_QUERY} summary lacks the profile "
+                     f"block")
+    if prof_block.get("trigger") != "query" \
+            or not os.path.isdir(prof_block.get("path", "")) \
+            or prof_block.get("bytes", 0) <= 0:
+        return _fail(f"profile block should name an on-disk capture "
+                     f"with bytes > 0: {prof_block}")
+
+    # 4. ndsreport analyze: one clock-aligned fleet timeline with
+    # straggler attribution, invariant intact
+    from nds_tpu.obs import analyze
+    a = analyze.analyze_run(run_dir)
+    fleet = a.get("fleet")
+    if not fleet or fleet.get("world") != 2:
+        return _fail(f"analysis lacks the 2-rank fleet block: {fleet}")
+    for row in a["queries"]:
+        total = sum(row["categories"].values()) + row["residual_ms"]
+        if abs(total - row["wall_ms"]) > 1e-6:
+            return _fail(f"{row['query']}: categories+residual "
+                         f"{total:.3f} != wall {row['wall_ms']:.3f}")
+        if "straggler_wait" not in row["categories"]:
+            return _fail(f"{row['query']}: no straggler_wait category")
+    table = analyze.format_attribution(a)
+    if "stragl" not in table:
+        return _fail("attribution table lacks the straggler column")
+    pids = {e.get("pid") for e in a["trace_events"]
+            if e.get("name") == "query"}
+    if not {0, 1} <= pids:
+        return _fail(f"merged timeline should carry both rank lanes, "
+                     f"got pids {pids}")
+    # alignment: both ranks' spans for the same query overlap (they
+    # are SKEW_S apart before alignment)
+    spans_by_q: dict = {}
+    for e in a["trace_events"]:
+        if e.get("name") == "query":
+            q = (e.get("args") or {}).get("query")
+            spans_by_q.setdefault(q, {})[e["pid"]] = (
+                e["ts"], e["ts"] + e.get("dur", 0))
+    overlapped, max_gap_us = 0, 0.0
+    for q, by_rank in spans_by_q.items():
+        if len(by_rank) < 2:
+            continue
+        (s0, e0), (s1, e1) = by_rank[0], by_rank[1]
+        if max(s0, s1) < min(e0, e1):
+            overlapped += 1
+        max_gap_us = max(max_gap_us, abs(s1 - s0))
+    # alignment proof: without the shift the lanes sit SKEW_S apart;
+    # aligned they differ only by real scheduling drift. A loaded box
+    # can drift a short query past strict overlap — the gap bound is
+    # the hard invariant, overlap the common case
+    if max_gap_us > (SKEW_S / 2) * 1e6:
+        return _fail(f"aligned rank lanes still {max_gap_us / 1e6:.1f}s "
+                     f"apart: { {q: sorted(r) for q, r in spans_by_q.items()} }")
+    if not overlapped:
+        print(f"note: no strict span overlap (max gap "
+              f"{max_gap_us / 1e6:.1f}s) — alignment holds via the "
+              f"gap bound")
+    html = analyze.render_html(a)
+    if "Fleet timeline" not in html:
+        return _fail("HTML report lacks the fleet timeline")
+    print(f"OK: fleet run (2 ranks, {SKEW_S:.0f}s skew aligned, "
+          f"{overlapped} paired span(s) overlap, stall -> flight + "
+          f"XLA capture, {PROFILED_QUERY} profile block "
+          f"{prof_block['bytes']} bytes)")
+    return 0
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="nds_fleet_") as workdir:
+        return check_fleet_run(workdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,17 @@ the one to run locally before pushing:
                         sizes and cross-checks their results — tier-1
                         proves both kernel paths stay runnable; the
                         speed acceptance runs on real accelerators
+  8. fleet              2-process NDS-H power run on a virtual mesh
+                        with 30s artificial clock skew and an induced
+                        stall: per-rank trace shards merge into ONE
+                        clock-aligned timeline with straggler
+                        attribution, every rank's watchdog dumps a
+                        schema-valid flight-r<rank>.json plus an
+                        on-demand XLA capture pointed at from the
+                        stall report, and a profile-triggered query's
+                        BenchReport carries a nonzero profile block
+                        (tools/fleet_check.py; obs/fleet.py +
+                        obs/profile.py)
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -63,6 +74,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
+import fleet_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
 import ndsreport  # noqa: E402
@@ -132,6 +144,7 @@ def main() -> int:
         ("chaos", chaos_check.main),
         ("ndsreport", run_ndsreport_check),
         ("ndsperf", lambda: ndsperf.main(["--smoke"])),
+        ("fleet", fleet_check.main),
     ]
     failed = []
     for name, fn in sections:
