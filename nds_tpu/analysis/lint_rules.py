@@ -88,6 +88,21 @@ fails CI instead of waiting for a human audit:
                             can always grab the profiler — a stray
                             start_trace wedges all of that.
 
+- NDS114 unchained-signal-handler
+                            ``signal.signal(...)`` installing a real
+                            handler without the enclosing scope ever
+                            calling ``signal.getsignal``: the install
+                            silently DISCARDS whatever handler was
+                            there — the flight-dump chain
+                            (obs/fleet._install_sigterm) or the
+                            preemption drain
+                            (resilience/drain.DrainManager), both of
+                            which capture and chain/restore the
+                            previous handler (the blessed pattern).
+                            Restores to ``SIG_DFL``/``SIG_IGN`` are
+                            clean; anything else needs the chain or a
+                            waiver saying why replacement is intended.
+
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
@@ -746,13 +761,80 @@ class DirectProfilerRule(Rule):
         return out
 
 
+class UnchainedSignalHandlerRule(Rule):
+    """NDS114: a ``signal.signal(sig, handler)`` call whose enclosing
+    scope never calls ``signal.getsignal``. Installing a handler
+    without capturing the previous one silently discards it — in this
+    tree that means losing the SIGTERM flight-dump chain
+    (obs/fleet.py) or the preemption drain (resilience/drain.py),
+    whose chaining installs are the blessed pattern. Restoring
+    ``SIG_DFL``/``SIG_IGN`` (the re-raise idiom inside a handler) is
+    clean by design."""
+
+    id = "NDS114"
+    name = "unchained-signal-handler"
+    paths = ("nds_tpu/",)
+
+    @staticmethod
+    def _is_restore(arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Attribute):
+            return arg.attr in ("SIG_DFL", "SIG_IGN")
+        return (isinstance(arg, ast.Name)
+                and arg.id in ("SIG_DFL", "SIG_IGN"))
+
+    @staticmethod
+    def _has_getsignal(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr == "getsignal") \
+                    or (isinstance(f, ast.Name)
+                        and f.id == "getsignal"):
+                return True
+        return False
+
+    def check(self, tree, src, path):
+        out = []
+        funcs = list(_walk_funcs(tree))
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "signal"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id.lstrip("_") == "signal"
+                    and len(n.args) >= 2):
+                continue
+            if self._is_restore(n.args[1]):
+                continue
+            # chained when ANY enclosing function (nearest or an
+            # ancestor closure that captured prev) calls getsignal;
+            # module-level installs check the whole module
+            enclosing = [f for f in funcs
+                         if any(ch is n for ch in ast.walk(f))]
+            if enclosing:
+                if any(self._has_getsignal(f) for f in enclosing):
+                    continue
+            elif self._has_getsignal(tree):
+                continue
+            out.append(LintViolation(
+                self.id, path, n.lineno,
+                "signal.signal() discards the previous handler (no "
+                "signal.getsignal in scope): chain it like the "
+                "flight-dump/drain installs (obs/fleet.py, "
+                "resilience/drain.py), or waive with why replacement "
+                "is intended"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
             UncachedCompileRule(), Int64EmulationHazardRule(),
-            DirectProfilerRule()]
+            DirectProfilerRule(), UnchainedSignalHandlerRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -202,13 +202,16 @@ class CostModel:
 
     def choose(self, planned, universe: tuple,
                tables: "dict | None" = None, catalog=None,
-               qname: "str | None" = None) -> tuple:
+               qname: "str | None" = None, est=None) -> tuple:
         """-> (placement, reason). Deterministic over identical inputs,
         which multi-process SPMD relies on: every rank computes the
-        same initial placement without a consensus round."""
+        same initial placement without a consensus round. ``est``
+        accepts a precomputed plan estimate (the pipeline shares one
+        estimate between this choice and the memory governor)."""
         from nds_tpu.analysis import plan_verify
-        est = plan_verify.estimate_plan(planned, tables=tables,
-                                        catalog=catalog)
+        if est is None:
+            est = plan_verify.estimate_plan(planned, tables=tables,
+                                            catalog=catalog)
         fast = universe[0]
         if CHUNKED in universe and fast != CHUNKED:
             hwm = self.hwm_history.get(qname or "")
@@ -226,6 +229,75 @@ class CostModel:
                 return CHUNKED, (f"working-set:{est.bytes}b"
                                  f"x{factor:.1f}")
         return fast, f"fits:{est.bytes}b"
+
+
+# ------------------------------------------------------ memory governor
+
+# once governing, projections must fall below this fraction of the
+# budget before the governor stands down (hysteresis: borderline
+# queries must not flap between device and chunked every other query)
+GOVERNOR_LOW_FRAC = 0.8
+
+
+class MemoryGovernor:
+    """Proactive memory-pressure pre-admission check.
+
+    Today OOM is handled REACTIVELY: the query dies on device, the
+    ladder walks it to chunked at halved chunk_rows, and the whole
+    program re-executes. On a multi-hour run every one of those walks
+    is minutes of wasted re-execution. The governor moves the decision
+    BEFORE dispatch: project the post-admission high-water mark as
+
+        live bytes now (obs/memwatch.live_bytes — allocator stats when
+        a backend is live, accounted buffers otherwise)
+      + the plan verifier's size estimate x the expansion factor
+
+    and when the projection exceeds
+    ``engine.placement.device_budget_bytes``, demote the query's
+    placement (device -> chunked) or — when it is already bound for
+    the chunked placement — pre-shrink its ``chunk_rows``, before
+    anything is dispatched. Hysteresis keeps the decision sticky: once
+    governing, projections must fall below ``GOVERNOR_LOW_FRAC`` x
+    budget to stand down. Every preemptive demotion counts on
+    ``governor_preemptive_demotions_total``; on the summary side the
+    query carries ``governed: true`` (BenchReport.attach_schedule).
+
+    Rank-local by construction (live memory diverges across ranks), so
+    the pipeline only consults it on single-process worlds — the same
+    rule the HWM history follows."""
+
+    def __init__(self, budget: int = DEFAULT_DEVICE_BUDGET,
+                 expansion: float = EXPANSION,
+                 low_frac: float = GOVERNOR_LOW_FRAC):
+        self.budget = int(budget)
+        self.expansion = expansion
+        self.low_frac = low_frac
+        self.governing = False
+
+    def project(self, est) -> int:
+        est_bytes = int(getattr(est, "bytes", 0) or 0)
+        if est_bytes <= 0:
+            return 0
+        return memwatch.live_bytes() + int(est_bytes * self.expansion)
+
+    def decide(self, est) -> "str | None":
+        """Non-None reason string when the query must be demoted /
+        pre-shrunk before dispatch."""
+        if self.budget <= 0:
+            return None
+        projected = self.project(est)
+        if projected <= 0:
+            return None
+        limit = (int(self.budget * self.low_frac) if self.governing
+                 else self.budget)
+        if projected > limit:
+            self.governing = True
+            obs_metrics.counter(
+                "governor_preemptive_demotions_total").inc()
+            return (f"governor:projected:{projected}"
+                    f">budget:{self.budget}")
+        self.governing = False
+        return None
 
 
 # ------------------------------------------------------------- pipeline
@@ -327,6 +399,15 @@ class ExecutionPipeline:
                 "engine.placement.device_budget_bytes",
                 DEFAULT_DEVICE_BUDGET),
             stream_bytes=stream_bytes)
+        # proactive memory-pressure governor (engine.placement.governor,
+        # default on): pre-admission demotion/pre-shrink against the
+        # same budget the cost model plans with
+        self.governor = None
+        if str(self._cfg("engine.placement.governor", "on")) not in (
+                "off", "0", "false"):
+            self.governor = MemoryGovernor(
+                budget=self.cost_model.device_budget)
+        self._gov_shrink = False
         self.ladder_on = self._cfg("engine.placement.ladder",
                                    "on") not in ("off", "0", "false")
         floor = self._cfg("engine.placement.floor", CPU)
@@ -491,14 +572,56 @@ class ExecutionPipeline:
         return rungs
 
     def _initial_placement(self, planned, qname) -> tuple:
+        self._gov_shrink = False
         if self.forced:
             return self.forced, "forced"
         if self._demoted_to:
             return self._demoted_to, "sticky-demotion"
         catalog = None
-        return self.cost_model.choose(
+        from nds_tpu.analysis import plan_verify
+        est = plan_verify.estimate_plan(planned, tables=self._tables,
+                                        catalog=catalog)
+        placement, why = self.cost_model.choose(
             planned, self.universe, tables=self._tables,
-            catalog=catalog, qname=qname)
+            catalog=catalog, qname=qname, est=est)
+        # pre-admission governor: projected HWM (live bytes + estimate
+        # x expansion) over budget demotes BEFORE dispatch — every
+        # avoided OOM is an avoided ladder walk and re-execute.
+        # Single-process worlds only: live memory is rank-local, and a
+        # divergent projection would start peers at different
+        # placements (the consensus-avoidance rule the HWM history
+        # follows)
+        # only consult the governor when it could actually act: a
+        # placement with no relief rung (the CPU oracle, a universe
+        # without chunked) must not count phantom demotions or latch
+        # the hysteresis
+        if (self.governor is not None and not self._multi
+                and CHUNKED in self.universe
+                and placement in (DEVICE, SHARDED, CHUNKED)):
+            reason = self.governor.decide(est)
+            if reason and placement in (DEVICE, SHARDED):
+                return CHUNKED, reason
+            if reason and placement == CHUNKED:
+                self._gov_shrink = True
+                return CHUNKED, reason
+        return placement, why
+
+    def _apply_governor(self, sched: dict, placement: str) -> None:
+        """Post-schedule governor bookkeeping: stamp ``governed`` on
+        the summary and pre-shrink chunk_rows for THIS query (restored
+        by _run_ladder's finally) when the governed placement is
+        already the chunked one."""
+        if not str(sched.get("reason", "")).startswith("governor:"):
+            return
+        sched["governed"] = True
+        if self._gov_shrink and placement == CHUNKED:
+            from nds_tpu.engine.chunked_exec import ChunkedExecutor
+            ex = self._executor(CHUNKED)
+            sched.setdefault("_restore", []).append(
+                (ex, "chunk_rows", ex.chunk_rows))
+            ex.chunk_rows = max(ex.chunk_rows // 2,
+                                ChunkedExecutor.MIN_CHUNK_ROWS)
+        self._gov_shrink = False
 
     def choose_placement(self, planned, qname: "str | None" = None,
                          catalog=None) -> tuple:
@@ -514,6 +637,7 @@ class ExecutionPipeline:
         qname = self._current_query()
         placement, why = self._initial_placement(planned, qname)
         stats, sched = self._new_schedule(placement, why)
+        self._apply_governor(sched, placement)
         self.last_stats, self.last_schedule = stats, sched
         return self._run_ladder(planned, key=key, placement=placement,
                                 stats=stats, sched=sched)
@@ -528,13 +652,17 @@ class ExecutionPipeline:
         qname = self._current_query()
         placement, why = self._initial_placement(planned, qname)
         stats, sched = self._new_schedule(placement, why)
+        self._apply_governor(sched, placement)
         self.last_stats, self.last_schedule = stats, sched
         ex = self._executor(placement)
         dispatch = getattr(ex, "execute_async", None)
         # multi-rank worlds run synchronously: the per-query boundary
         # vote must fire in dispatch order on every rank, and the
-        # compiled collective programs serialize execution anyway
-        if dispatch is None or placement == CPU or self._multi:
+        # compiled collective programs serialize execution anyway.
+        # Governed queries run synchronously too — the per-query
+        # chunk-shrink restore rides _run_ladder's finally
+        if dispatch is None or placement == CPU or self._multi \
+                or sched.get("governed"):
             out = self._run_ladder(planned, key=key, placement=placement,
                                    stats=stats, sched=sched)
             return _CompletedHandle(out, self, stats, sched)

@@ -170,6 +170,25 @@ def _manifest_for(path: str) -> tuple[dict, str] | None:
     return None
 
 
+def verify_manifest(table_dir: str) -> bool:
+    """True when ``table_dir`` holds a manifest and EVERY recorded file
+    re-hashes to its recorded digest (unconditionally — the verify
+    gate does not apply: callers ask this question to decide whether
+    finished work can be trusted, e.g. a resumed transcode skipping
+    tables the interrupted run already completed). False on a missing/
+    unreadable manifest, a missing file, or any digest mismatch."""
+    doc = _load_manifest(os.path.join(table_dir, MANIFEST_NAME))
+    if doc is None or not doc.get("files"):
+        return False
+    try:
+        for rel, expected in doc["files"].items():
+            if file_digest(os.path.join(table_dir, rel)) != expected:
+                return False
+    except OSError:
+        return False
+    return True
+
+
 def clear_cache() -> None:
     """Drop cached manifests (tests that rewrite files in place)."""
     _manifest_cache.clear()

@@ -49,6 +49,41 @@ def write_result(result: ResultTable, out_dir: str) -> str:
     return path
 
 
+def result_digest(result) -> str | None:
+    """Stable sha256 (hex, 16 chars) over a ResultTable's schema +
+    values + null masks — the per-query fingerprint the resume journal
+    records (resilience/journal.QueryJournal) so the soak gate can
+    prove an interrupted-then-resumed run produced byte-identical
+    results to an uninterrupted one. None for resultless statements
+    (DML) or anything that does not quack like a ResultTable."""
+    import hashlib
+    if result is None or not hasattr(result, "cols"):
+        return None
+    h = hashlib.sha256()
+    try:
+        for name, arr, dt, valid in zip(result.names, result.cols,
+                                        result.dtypes, result.valids):
+            h.update(f"{name}|{dt}|".encode())
+            a = np.asarray(arr)
+            if a.dtype == object:
+                mask = None if valid is None else ~np.asarray(valid)
+                for j in range(len(a)):
+                    if mask is not None and mask[j]:
+                        h.update(b"\x00N")
+                    else:
+                        h.update(str(a[j]).encode())
+                    h.update(b"\x1f")
+            else:
+                h.update(np.ascontiguousarray(a).tobytes())
+            if valid is not None:
+                h.update(np.ascontiguousarray(
+                    np.asarray(valid, dtype=np.uint8)).tobytes())
+            h.update(b"\x1e")
+    except Exception:  # noqa: BLE001 - a digest must never fail a query
+        return None
+    return h.hexdigest()[:16]
+
+
 def read_result(out_dir: str):
     """-> pandas DataFrame (dates as date32 -> object, fine for diffing)."""
     paths = sorted(os.path.join(out_dir, f) for f in os.listdir(out_dir)

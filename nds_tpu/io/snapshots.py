@@ -60,9 +60,14 @@ class SnapshotLog:
         if isinstance(doc, dict) and integrity.check_crc(doc):
             return doc.get("entries", [])
         # torn/corrupt: committed version files are immutable, so the
-        # on-disk baseline (version 0) is always a valid fallback
+        # on-disk baseline (version 0) is always a valid fallback —
+        # counted (snapshot_resets_total) and surfaced in BenchReport
+        # ``degradations`` + flight dumps, so committed maintenance
+        # versions silently reverting to v0 can't hide in a long run
         print(f"WARNING: snapshot manifest {path} is torn/corrupt — "
               f"falling back to the version-0 baseline")
+        from nds_tpu.obs import metrics as obs_metrics
+        obs_metrics.counter("snapshot_resets_total").inc()
         return []
 
     def _write(self) -> None:

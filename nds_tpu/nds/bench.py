@@ -57,6 +57,25 @@ def _run(cmd: list[str], backend: str | None = None,
     subprocess.run(cmd, check=True, env=env)
 
 
+def _run_rc(cmd: list[str], backend: str | None = None,
+            extra_env: dict | None = None) -> int:
+    """Like _run but returns the exit code instead of raising — the
+    caller distinguishes RESUMABLE exits (75, a graceful preemption
+    drain; resilience/drain.py) from real failures."""
+    from nds_tpu.utils.power_core import subprocess_env
+    print("+", " ".join(cmd))
+    env = subprocess_env(backend)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(cmd, env=env).returncode
+
+
+# how many graceful-drain (exit 75) resumes the power phase tolerates
+# before the bench gives up — the query journal makes each retry cost
+# only the statements not yet journaled
+MAX_PHASE_RESUMES = 5
+
+
 def _analyze_phase(phase_name: str, run_dir: str) -> None:
     """Post-phase run analysis (nds_tpu/obs/analyze.py): write
     ``analysis.json`` + ``report.html`` next to the phase's BenchReport
@@ -189,8 +208,14 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
 
     def _load_test():
         if not skip.get("load_test", False):
-            _run([sys.executable, "-m", "nds_tpu.nds.transcode",
-                  raw_dir, wh_dir, load_report], backend="cpu")
+            cmd = [sys.executable, "-m", "nds_tpu.nds.transcode",
+                   raw_dir, wh_dir, load_report]
+            if resume:
+                # an interrupted load resumes table-granular: tables
+                # whose _manifest.json digests verify are not
+                # re-transcoded (nds/transcode.py --resume)
+                cmd.append("--resume")
+            _run(cmd, backend="cpu")
         return {"load_time_s": get_load_time(load_report),
                 "rngseed": get_rngseed(load_report)}
 
@@ -217,12 +242,33 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
 
     def _power_test():
         if not skip.get("power_test", False):
-            _run([sys.executable, "-m", "nds_tpu.nds.power",
-                  wh_dir, os.path.join(stream_dir, "query_0.sql"),
-                  power_log, "--backend", backend,
-                  "--json_summary_folder",
-                  os.path.join(report_dir, "json")],
-                 backend=backend, extra_env=_snap_env("power"))
+            from nds_tpu.resilience.drain import EXIT_RESUMABLE
+            base_cmd = [sys.executable, "-m", "nds_tpu.nds.power",
+                        wh_dir,
+                        os.path.join(stream_dir, "query_0.sql"),
+                        power_log, "--backend", backend,
+                        "--json_summary_folder",
+                        os.path.join(report_dir, "json")]
+            # a bench-level --resume also resumes mid-phase: the query
+            # journal in the json dir replays finished statements
+            cmd = base_cmd + (["--resume"] if resume else [])
+            resumes = 0
+            while True:
+                rc = _run_rc(cmd, backend=backend,
+                             extra_env=_snap_env("power"))
+                if rc == 0:
+                    break
+                if rc == EXIT_RESUMABLE and resumes < MAX_PHASE_RESUMES:
+                    # graceful preemption drain: re-run with --resume —
+                    # only the statements not yet journaled execute,
+                    # and the retry never counts as a failed phase
+                    resumes += 1
+                    print(f"== power phase drained (exit "
+                          f"{EXIT_RESUMABLE}) — resuming "
+                          f"({resumes}/{MAX_PHASE_RESUMES}) ==")
+                    cmd = base_cmd + ["--resume"]
+                    continue
+                raise subprocess.CalledProcessError(rc, cmd)
             _analyze_phase("power", os.path.join(report_dir, "json"))
         return {"power_time_s": get_power_time(power_log)}
 
@@ -254,12 +300,14 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
                 ttt, codes = run_streams_inprocess(
                     wh_dir, tstreams, tdir, backend=backend)
             else:
-                # YAML ``watchdog: {stall_s: ...}`` arms subprocess
-                # stream supervision (kill + restart-once; README
-                # Resilience)
+                # YAML ``watchdog: {stall_s, max_restarts}`` arms
+                # subprocess stream supervision (kill + bounded
+                # restarts; README Resilience)
+                wd_cfg = cfg.get("watchdog") or {}
                 ttt, codes = run_streams(
                     wh_dir, tstreams, tdir, backend=backend,
-                    stall_s=(cfg.get("watchdog") or {}).get("stall_s"))
+                    stall_s=wd_cfg.get("stall_s"),
+                    max_restarts=wd_cfg.get("max_restarts"))
         finally:
             for k, v in saved.items():
                 if v is None:

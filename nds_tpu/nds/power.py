@@ -68,6 +68,11 @@ def main(argv=None) -> None:
                    help="run only these query names (e.g. query96)")
     p.add_argument("--floats", action="store_true",
                    help="schema uses doubles instead of decimals")
+    p.add_argument("--resume", action="store_true",
+                   help="replay completed statements from the run "
+                        "dir's query journal and restart mid-stream "
+                        "at the next unfinished one (README "
+                        "'Preemption & resume')")
     power_core.add_config_args(p)
     args = p.parse_args(argv)
     config = power_core.config_from_args(args)
@@ -81,7 +86,7 @@ def main(argv=None) -> None:
         json_summary_folder=args.json_summary_folder,
         output_prefix=args.output_prefix, warmup=args.warmup,
         query_subset=args.query_subset, profile_dir=args.profile_dir,
-        extra_time_log=args.extra_time_log)
+        extra_time_log=args.extra_time_log, resume=args.resume)
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 
 

@@ -80,12 +80,16 @@ def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                 backend: str = "tpu",
                 input_format: str = "parquet",
                 allow_failure: bool = False,
-                stall_s: float | None = None) -> tuple[float, list[int]]:
+                stall_s: float | None = None,
+                max_restarts: int | None = None
+                ) -> tuple[float, list[int]]:
     """Launch one supervised power-run subprocess per stream; returns
     (throughput_elapse_seconds, per-stream final exit codes). With
-    ``stall_s`` set, hung streams are killed and restarted once from
-    their last completed query; ``throughput_summary.json`` in
-    ``out_dir`` records the supervision verdicts either way."""
+    ``stall_s`` set, hung streams are killed and restarted (up to
+    ``max_restarts`` times, default once) from their last completed
+    query; ``throughput_summary.json`` in ``out_dir`` records the
+    supervision verdicts either way — including the exact queries a
+    degraded stream skipped."""
     from nds_tpu.nds.streams import parse_query_stream
     from nds_tpu.resilience.supervise import (
         StreamSupervisor, describe_summary,
@@ -94,11 +98,13 @@ def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
     specs = _stream_specs(data_dir, stream_paths, out_dir, backend,
                           input_format, allow_failure,
                           "nds_tpu.nds.power", parse_query_stream)
-    # restart-once needs the heartbeat plumbing stall_s arms: without
-    # it a completed-with-failures stream (exit 1, no snapshot) would
-    # be indistinguishable from a crash and get re-run
+    # restarts need the heartbeat plumbing stall_s arms: without it a
+    # completed-with-failures stream (exit 1, no snapshot) would be
+    # indistinguishable from a crash and get re-run
+    if max_restarts is None:
+        max_restarts = 1 if stall_s else 0
     sup = StreamSupervisor(specs, out_dir, stall_s=stall_s,
-                           max_restarts=1 if stall_s else 0)
+                           max_restarts=max_restarts)
     elapse, codes, summary = sup.run()
     print(describe_summary(summary))
     # round up to 0.1 s, the reference's Ttt granularity
@@ -146,6 +152,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
                            ) -> tuple[float, list[int]]:
     from nds_tpu.nds.power import SUITE
     from nds_tpu.resilience import faults
+    from nds_tpu.resilience.journal import QueryJournal, config_digest
     from nds_tpu.resilience.retry import (
         TRANSIENT, RetryPolicy, RetryStats, classify,
     )
@@ -169,6 +176,14 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
     streams = []
     for sp in stream_paths:
         name = os.path.splitext(os.path.basename(sp))[0]
+        # per-stream query journal (resilience/journal.py): every
+        # completed statement lands on disk as it finishes, so an
+        # interrupted round leaves a per-stream completion record with
+        # result digests, not just whatever stdout survived
+        qj = QueryJournal(
+            os.path.join(out_dir, f"{name}_queries.json"), phase=name,
+            digest=config_digest(config.as_dict()))
+        qj.reset()
         streams.append({
             "name": name,
             "queries": list(SUITE.parse_query_stream(sp).items()),
@@ -182,6 +197,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
             "qtimes": [],
             "retries": 0,
             "reschedules": 0,
+            "journal": qj,
         })
     # flatten round-robin, then run with `engine.concurrent_tasks`
     # queries in flight: dispatch is async on the device engine
@@ -198,6 +214,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
 
     def _finish_one():
         s, qname, sql, t0, handle, err = inflight.pop(0)
+        res = None
         if err is None:
             try:
                 # retry + the degradation ladder run INSIDE the
@@ -207,7 +224,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
                 # pipelining for the healthy queries and pays the
                 # recovery only on the sick one
                 with faults.context(query=qname, stream=s["name"]):
-                    handle.result()
+                    res = handle.result()
             except Exception as exc:  # noqa: BLE001
                 err = exc
         # per-query recovery accounting comes from the pipeline's
@@ -239,10 +256,16 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         # dispatch->result bracket; queue wait from pipelining is
         # inherent to a time-shared chip, exactly as a query inside a
         # reference throughput stream waits on cluster resources
-        s["tlog"].add(qname, int((done - t0) * 1000))
-        s["qtimes"].append(int((done - t0) * 1000))
+        wall_ms = int((done - t0) * 1000)
+        s["tlog"].add(qname, wall_ms)
+        s["qtimes"].append(wall_ms)
         s["first_t0"] = min(s.get("first_t0", t0), t0)
         s["last_done"] = done
+        # journal the completion (status + wall + result digest): the
+        # same per-statement durability contract as the power loop
+        from nds_tpu.io.result_io import result_digest
+        s["journal"].record(qname, wall_ms, s["statuses"][-1],
+                            result_digest=result_digest(res))
 
     from nds_tpu.resilience import watchdog
     for s, qname, sql in interleaved:
@@ -250,6 +273,7 @@ def _run_streams_inprocess(data_dir, stream_paths, out_dir, backend,
         # heartbeat per dispatch: the in-process fleet shows liveness
         # to any armed watchdog exactly like a subprocess stream does
         watchdog.beat(s["name"], query=qname, phase="dispatch")
+        s["journal"].start(qname)
         t0 = time.time()
         handle, err = None, None
         try:
@@ -332,8 +356,12 @@ def main(argv=None) -> None:
     p.add_argument("--stall_s", type=float, default=None,
                    help="supervise subprocess streams: kill a stream "
                         "whose heartbeats stall past this budget and "
-                        "restart it once from its last completed query "
+                        "restart it from its last completed query "
                         "(README Resilience)")
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="restart budget per supervised stream (default "
+                        "1 when --stall_s is set; graceful-drain exits "
+                        "75 resume without charging it)")
     args = p.parse_args(argv)
     if args.in_process:
         elapse, codes = run_streams_inprocess(
@@ -344,7 +372,8 @@ def main(argv=None) -> None:
                                     args.out_dir, args.backend,
                                     args.input_format,
                                     args.allow_failure,
-                                    stall_s=args.stall_s)
+                                    stall_s=args.stall_s,
+                                    max_restarts=args.max_restarts)
     print(f"Throughput Time: {elapse} s over {len(args.streams)} streams")
     sys.exit(1 if any(codes) and not args.allow_failure else 0)
 

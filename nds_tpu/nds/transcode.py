@@ -17,6 +17,7 @@ re-sorted on read (`nds_tpu/io/csv_io.py`).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import time
 
@@ -93,7 +94,8 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
               tables: list[str] | None = None,
               compression: str = "snappy", update: bool = False,
               use_decimal: bool = True, partition: bool = True,
-              output_format: str = "parquet") -> dict:
+              output_format: str = "parquet",
+              resume: bool = False) -> dict:
     schemas = (get_maintenance_schemas(use_decimal) if update
                else get_schemas(use_decimal))
     if tables:
@@ -102,8 +104,44 @@ def transcode(input_dir: str, output_dir: str, report_path: str,
             raise ValueError(f"unknown tables: {sorted(unknown)}")
         schemas = {t: schemas[t] for t in tables}
     os.makedirs(output_dir, exist_ok=True)
+    # options stamp: resuming under DIFFERENT transcode options would
+    # silently keep tables built with the old schema/format (their
+    # manifests still verify — they hash the old bytes) and yield a
+    # mixed warehouse; refuse loudly, like the resume journals'
+    # config-digest guard
+    from nds_tpu.io import integrity
+    opts = {"use_decimal": use_decimal, "compression": compression,
+            "partition": partition, "output_format": output_format,
+            "update": update}
+    opts_path = os.path.join(output_dir, "_transcode_options.json")
+    if resume and os.path.exists(opts_path):
+        try:
+            with open(opts_path) as f:
+                prior = json.load(f)
+        except (OSError, ValueError):
+            prior = None
+        if prior is not None and prior != opts:
+            raise ValueError(
+                f"--resume under different transcode options: "
+                f"{opts_path} records {prior}, current run wants "
+                f"{opts} — delete the warehouse (or drop --resume) "
+                f"to rebuild consistently")
+    integrity.write_json_atomic(opts_path, opts)
     timings = {}
     for name, schema in schemas.items():
+        if resume:
+            # preemption-safe resume: a table whose _manifest.json
+            # digests all verify was FULLY transcoded by an earlier
+            # incarnation (the manifest is written last, after every
+            # data file) — re-transcoding it would burn the load-phase
+            # budget re-doing finished work. A missing/torn manifest or
+            # any mismatch re-transcodes from scratch.
+            if integrity.verify_manifest(os.path.join(output_dir,
+                                                      name)):
+                timings[name] = 0.0
+                print(f"Skipped table {name} (manifest verified, "
+                      f"already transcoded)")
+                continue
         timings[name] = transcode_table(
             name, schema, input_dir, output_dir, compression, partition,
             output_format)
@@ -145,12 +183,17 @@ def main(argv=None) -> None:
                    help="warehouse file format "
                         "(`nds/nds_transcode.py:69-152`; avro via the "
                         "built-in container codec, io/avro_io.py)")
+    p.add_argument("--resume", action="store_true",
+                   help="skip tables whose _manifest.json digests "
+                        "already verify (an interrupted load resumes "
+                        "table-granular; README 'Preemption & "
+                        "resume')")
     args = p.parse_args(argv)
     transcode(args.input_dir, args.output_dir, args.report_file,
               args.tables, args.compression, update=args.update,
               use_decimal=not args.floats,
               partition=not args.no_partition,
-              output_format=args.output_format)
+              output_format=args.output_format, resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -111,6 +111,11 @@ def main(argv=None) -> None:
     p.add_argument("--query_subset", nargs="+",
                    help="run only these query names (supervised-stream "
                         "restarts resume with the remaining subset)")
+    p.add_argument("--resume", action="store_true",
+                   help="replay completed statements from the run "
+                        "dir's query journal and restart mid-stream "
+                        "at the next unfinished one (README "
+                        "'Preemption & resume')")
     power_core.add_config_args(p)
     args = p.parse_args(argv)
     config = power_core.config_from_args(args)
@@ -122,7 +127,7 @@ def main(argv=None) -> None:
         json_summary_folder=args.json_summary_folder,
         output_prefix=args.output_prefix, warmup=args.warmup,
         query_subset=args.query_subset, profile_dir=args.profile_dir,
-        extra_time_log=args.extra_time_log)
+        extra_time_log=args.extra_time_log, resume=args.resume)
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 
 

@@ -26,7 +26,9 @@ import sys
 def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
                 backend: str = "tpu",
                 input_format: str = "parquet",
-                stall_s: float | None = None) -> tuple[float, list[int]]:
+                stall_s: float | None = None,
+                max_restarts: int | None = None
+                ) -> tuple[float, list[int]]:
     """Launch one supervised power-run subprocess per stream; returns
     (throughput_elapse_seconds, per-stream final exit codes)."""
     from nds_tpu.nds.throughput import _stream_specs
@@ -38,10 +40,12 @@ def run_streams(data_dir: str, stream_paths: list[str], out_dir: str,
     specs = _stream_specs(data_dir, stream_paths, out_dir, backend,
                           input_format, False,
                           "nds_tpu.nds_h.power", parse_query_stream)
-    # restart-once only with the heartbeat plumbing stall_s arms (see
+    # restarts only with the heartbeat plumbing stall_s arms (see
     # nds_tpu.nds.throughput.run_streams)
+    if max_restarts is None:
+        max_restarts = 1 if stall_s else 0
     sup = StreamSupervisor(specs, out_dir, stall_s=stall_s,
-                           max_restarts=1 if stall_s else 0)
+                           max_restarts=max_restarts)
     elapse, codes, summary = sup.run()
     print(describe_summary(summary))
     # round up to 0.1 s, the reference's Ttt granularity
@@ -61,10 +65,15 @@ def main(argv=None) -> None:
                    help="supervise streams: kill on heartbeat stall "
                         "past this budget, restart once (README "
                         "Resilience)")
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="restart budget per supervised stream (default "
+                        "1 when --stall_s is set; graceful-drain exits "
+                        "75 resume without charging it)")
     args = p.parse_args(argv)
     elapse, codes = run_streams(args.data_dir, args.streams, args.out_dir,
                                 args.backend, args.input_format,
-                                stall_s=args.stall_s)
+                                stall_s=args.stall_s,
+                                max_restarts=args.max_restarts)
     print(f"Throughput Time: {elapse} s over {len(args.streams)} streams")
     sys.exit(1 if any(codes) else 0)
 

@@ -74,8 +74,14 @@ def span_category(name: str) -> str | None:
 def is_report_basename(name: str) -> bool:
     """Whether a run-dir file name can be a BenchReport summary (the
     single place that decision lives — static_checks' fixture gate and
-    load_summaries both use it)."""
-    return name.endswith(".json") and name not in _IGNORE_BASENAMES
+    load_summaries both use it). ``merged-*`` phase reports
+    (utils/report.merge_incarnations) are DERIVED from the per-query
+    summaries — ingesting them would double-bill every merged query —
+    and ``*_queries.json`` files are resume journals
+    (resilience/journal.QueryJournal), not reports."""
+    return (name.endswith(".json") and name not in _IGNORE_BASENAMES
+            and not name.startswith("merged-")
+            and not name.endswith("_queries.json"))
 
 
 # ---------------------------------------------------------- attribution
@@ -288,6 +294,41 @@ def straggler_stats(events: list[dict]) -> dict:
     return out
 
 
+def merge_resumed(summaries: list[dict]) -> "tuple[list[dict], dict]":
+    """Bill merged incarnations once: a resumed run
+    (utils/power_core ``--resume``) can report the same query from two
+    incarnations — the first process died in the window between
+    writing the summary and appending the journal, and the resumed
+    incarnation re-ran it. Keep the LATEST (incarnation, startTime)
+    report per query, so totals/diffs never double-count; returns
+    (summaries, {query: dropped_count}). Runs that never resumed
+    (every ``incarnation`` is 0 or absent — including multi-stream
+    throughput dirs, whose repeated names are legitimate separate
+    executions) pass through untouched."""
+    if not any((s.get("incarnation") or 0) > 0 for s in summaries
+               if isinstance(s.get("incarnation"), int)):
+        return summaries, {}
+    out: list = []
+    best: dict = {}
+    dropped: dict = {}
+    for s in summaries:
+        if not isinstance(s.get("incarnation"), int):
+            out.append(s)  # not journal-stamped: leave it alone
+            continue
+        q = str(s.get("query"))
+        key = (s["incarnation"], s.get("startTime") or 0)
+        cur = best.get(q)
+        if cur is None:
+            best[q] = (key, s)
+        else:
+            dropped[q] = dropped.get(q, 0) + 1
+            if key > cur[0]:
+                best[q] = (key, s)
+    out.extend(s for _k, s in best.values())
+    out.sort(key=lambda s: (s.get("startTime") or 0))
+    return out, dropped
+
+
 def _dedupe_names(rows: list[dict]) -> None:
     """Throughput dirs repeat query names across streams; suffix
     repeats (#2, #3...) so per-name maps stay lossless. Suffixes are
@@ -316,6 +357,10 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
     summaries = load_summaries(run_dir)
     if not summaries:
         raise ValueError(f"no BenchReport JSONs under {run_dir!r}")
+    # resumed runs: bill each merged-incarnation query exactly once
+    # (the same latest-incarnation-wins rule the merged phase report
+    # applies, utils/report.merge_incarnations)
+    summaries, merged_dropped = merge_resumed(summaries)
     rows = [attribute_query(s) for s in summaries]
     _dedupe_names(rows)
     # fleet runs (obs/fleet.py sidecars): merge the per-rank shards
@@ -385,6 +430,12 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
         "metrics": {"counters": counters, "histograms": hists},
         "trace_events": events,
     }
+    if merged_dropped:
+        out["merged_incarnations"] = merged_dropped
+    incs = [s.get("incarnation") for s in summaries
+            if isinstance(s.get("incarnation"), int)]
+    if incs and max(incs) > 0:
+        out["incarnations"] = max(incs) + 1
     if fleet_info:
         out["fleet"] = fleet_info
     return out
@@ -470,6 +521,14 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     lines.append("-" * len(head))
     lines.append(f"{'TOTAL':<{w}} "
                  + " ".join(f"{v:>9.1f}" for v in tvals) + "  (ms)")
+    if analysis.get("incarnations"):
+        note = f"resumed run: {analysis['incarnations']} incarnations"
+        md = analysis.get("merged_incarnations")
+        if md:
+            note += (", merged (billed once): "
+                     + ", ".join(f"{q} (x{n + 1})"
+                                 for q, n in sorted(md.items())))
+        lines.append(note)
     fl = analysis.get("fleet")
     if fl:
         ranks = ", ".join(

@@ -371,6 +371,29 @@ def disarm_flight_recorder() -> None:
     watchdog.unregister_stall_hook(_flight_stall_hook)
 
 
+def signal_flush(reason: str = "sigterm",
+                 timeout_s: float = 2.0) -> None:
+    """The SIGNAL-PATH post-mortem flush, callable from any handler
+    (the SIGTERM chain below AND the drain manager's handlers,
+    resilience/drain.py): dump the armed recorder and flush any parked
+    trace roots, both BOUNDED — the interrupted frame may hold the
+    very locks the dump and the export need (see FlightRecorder.dump),
+    so neither step may block the handler forever."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.dump(reason, timeout_s=timeout_s)
+
+    def _flush():
+        try:
+            obs_trace.get_tracer().flush_exports(close_roots=True)
+        except Exception:  # noqa: BLE001 - dying anyway
+            pass
+
+    ft = threading.Thread(target=_flush, daemon=True)
+    ft.start()
+    ft.join(timeout=1.0)
+
+
 _sigterm_installed = False
 
 
@@ -379,7 +402,10 @@ def _install_sigterm() -> None:
     thread only): dump the armed recorder + flush any parked trace
     roots, then hand the signal to whatever handler was there before —
     the supervisor's kill escalation still sees a SIGTERM death, with
-    a flight dump on disk next to the stall report."""
+    a flight dump on disk next to the stall report. When a drain
+    manager is installed on top (resilience/drain.py — the power loop
+    installs it AFTER this), ITS handler runs instead and performs the
+    same flush via signal_flush before draining resumably."""
     global _sigterm_installed
     if _sigterm_installed:
         return
@@ -389,23 +415,7 @@ def _install_sigterm() -> None:
         prev = signal.getsignal(signal.SIGTERM)
 
         def _on_term(signum, frame):
-            rec = _RECORDER
-            if rec is not None:
-                # bounded: the interrupted frame may hold the very
-                # locks the dump needs (see FlightRecorder.dump)
-                rec.dump("sigterm", timeout_s=2.0)
-            def _flush():
-                try:
-                    obs_trace.get_tracer().flush_exports(
-                        close_roots=True)
-                except Exception:  # noqa: BLE001 - dying anyway
-                    pass
-
-            # bounded for the same reason as the dump: the export lock
-            # may be held by the interrupted frame
-            ft = threading.Thread(target=_flush, daemon=True)
-            ft.start()
-            ft.join(timeout=1.0)
+            signal_flush("sigterm")
             if callable(prev):
                 prev(signum, frame)
             elif prev != signal.SIG_IGN:

@@ -121,6 +121,18 @@ class MemoryTracker:
 
     # ---------------------------------------------------------- readout
 
+    def live(self) -> int:
+        """CURRENT usage (not the HWM): allocator ``bytes_in_use`` when
+        a jax backend is live, else the accounted live-buffer total —
+        the pre-admission signal the scheduler's memory governor
+        (engine/scheduler.MemoryGovernor) projects forward before
+        dispatching a query."""
+        v = _device_bytes_in_use()
+        if v is not None:
+            return v
+        with _LOCK:
+            return self._live
+
     def high_water(self) -> dict | None:
         """BenchReport ``memory`` block, or None when the query touched
         no tracked memory (the harness-only paths)."""
@@ -148,6 +160,10 @@ def sub_live(nbytes: float) -> None:
 
 def sample_device() -> None:
     TRACKER.sample_device()
+
+
+def live_bytes() -> int:
+    return TRACKER.live()
 
 
 def high_water() -> dict | None:

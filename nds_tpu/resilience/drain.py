@@ -1,0 +1,236 @@
+"""Graceful preemption drain: SIGTERM/SIGINT as a scheduling event.
+
+Multi-hour accelerator occupancy makes preemption a certainty, not a
+risk ("Large Scale Distributed Linear Algebra With TPUs" — PAPERS.md),
+and a preempted run that dies mid-flight throws away everything the
+query-granular journal (resilience/journal.py) exists to preserve.
+This module turns the kill signal into a drain:
+
+- **First SIGTERM/SIGINT** — a chaining handler (it captures the
+  previous handler with ``signal.getsignal`` and restores it on
+  uninstall; ndslint NDS114 flags the discard pattern) performs the
+  bounded flight-dump + trace flush the PR-9 SIGTERM handler used to
+  own (obs/fleet.signal_flush — same lock-safe, timeout-bounded path),
+  marks the drain REQUESTED, and arms a deadline thread. The in-flight
+  query keeps running: the power loop checks :func:`check_boundary`
+  between statements and exits with :data:`EXIT_RESUMABLE` (75, BSD
+  EX_TEMPFAIL) once the query finished — journal, summaries, snapshot
+  and trace all flush through the normal teardown path.
+
+- **Past the deadline** (``engine.drain_s`` / ``NDS_TPU_DRAIN_S``,
+  default 30 s) — the in-flight query is abandoned: registered flush
+  hooks run (the power loop journals the query as explicitly
+  not-done via ``QueryJournal.mark_aborted`` and writes a final
+  metrics snapshot), the flight recorder dumps once more, and the
+  process hard-exits 75. The journal already holds every COMPLETED
+  query (appended per statement, atomically), so the abandonment
+  loses exactly the one in-flight statement.
+
+- **Repeat signal** — the operator (or a supervisor escalating) wants
+  out now: flush hooks run immediately and the process exits 75
+  without waiting out the deadline.
+
+Exit 75 is the RESUMABLE contract: ``StreamSupervisor``
+(resilience/supervise.py) relaunches a 75-exit stream without charging
+its restart budget, and ``nds/bench.py`` re-runs a 75-exit power phase
+with ``--resume`` instead of failing the bench.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+# BSD EX_TEMPFAIL: "try again later" — distinct from query failures
+# (1), watchdog stalls (86) and signal deaths (<0)
+EXIT_RESUMABLE = 75
+
+DRAIN_ENV = "NDS_TPU_DRAIN_S"
+DEFAULT_DRAIN_S = 30.0
+
+
+class DrainRequested(SystemExit):
+    """Raised at a query boundary once a drain was requested: unwinds
+    through every ``finally`` (watchdog stop, snapshot final write,
+    profiler teardown) and exits the process :data:`EXIT_RESUMABLE`."""
+
+    def __init__(self):
+        super().__init__(EXIT_RESUMABLE)
+
+
+class DrainManager:
+    """One drain lifecycle: install, observe, enforce the deadline."""
+
+    def __init__(self, drain_s: float = DEFAULT_DRAIN_S,
+                 run_dir: str = ".", _exit=os._exit):
+        self.drain_s = max(0.1, float(drain_s))
+        self.run_dir = run_dir
+        self._exit = _exit
+        self._requested = threading.Event()
+        # set when the loop reached a boundary (or finished): the
+        # deadline thread stands down instead of force-exiting
+        self._finished = threading.Event()
+        self._flush_hooks: list = []
+        self._prev: dict = {}
+        self._installed = False
+        self._signum: int | None = None
+        self._timer: threading.Thread | None = None
+
+    # ------------------------------------------------------- lifecycle
+
+    def install(self) -> "DrainManager":
+        """Install the chaining handler for SIGTERM + SIGINT (main
+        thread only; elsewhere the manager stays inert and the default
+        signal semantics hold)."""
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev[sig] = signal.getsignal(sig)
+                signal.signal(sig, self._on_signal)
+            self._installed = True
+        except (ValueError, OSError):
+            # exotic platform: journal + supervisor still cover us
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the previous handlers (only where ours is still the
+        installed one — a later installer wins) and stand the deadline
+        thread down."""
+        self._finished.set()
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._installed = False
+
+    def add_flush_hook(self, fn) -> None:
+        """Register ``fn()`` to run (best-effort, in order) on the
+        force-exit path — the state a normal teardown would have
+        flushed but ``os._exit`` will skip."""
+        if fn not in self._flush_hooks:
+            self._flush_hooks.append(fn)
+
+    # --------------------------------------------------------- signals
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._finished.is_set():
+            # drain already over (or never ours): behave like the
+            # handler we replaced
+            self._chain(signum, frame)
+            return
+        first = not self._requested.is_set()
+        self._signum = signum
+        self._requested.set()
+        if not first:
+            # repeat signal: stop waiting, flush and go now
+            self._force_exit("drain-repeat-signal")
+            return
+        name = getattr(signal.Signals(signum), "name", str(signum))
+        print(f"[drain] {name} received — letting the in-flight query "
+              f"finish (deadline {self.drain_s:.0f}s), will exit "
+              f"{EXIT_RESUMABLE} (resumable)")
+        # the PR-9 post-mortem contract, composed: bounded flight dump
+        # + trace flush, safe against locks the interrupted frame holds
+        from nds_tpu.obs import fleet as obs_fleet
+        obs_fleet.signal_flush(f"drain:{name}")
+        t = threading.Thread(target=self._deadline_watch,
+                             name="nds-tpu-drain-deadline", daemon=True)
+        self._timer = t
+        t.start()
+
+    def _chain(self, signum, frame) -> None:
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def _deadline_watch(self) -> None:
+        if self._finished.wait(self.drain_s):
+            return  # boundary reached in time: normal teardown flushes
+        self._force_exit("drain-deadline")
+
+    def _force_exit(self, reason: str) -> None:
+        """Abandon the in-flight query: run the flush hooks (journal
+        abort stamp, final snapshot), dump the flight ring, exit 75.
+        ``os._exit`` skips every ``finally`` — everything that must
+        survive is flushed HERE, explicitly."""
+        self._finished.set()
+        for fn in list(self._flush_hooks):
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 - dying anyway
+                print(f"[drain] flush hook failed: "
+                      f"{type(exc).__name__}: {exc}")
+        from nds_tpu.obs import fleet as obs_fleet
+        obs_fleet.signal_flush(reason)
+        print(f"[drain] {reason}: abandoning the in-flight query, "
+              f"exiting {EXIT_RESUMABLE} (resumable)")
+        self._exit(EXIT_RESUMABLE)
+
+    # -------------------------------------------------------- boundary
+
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def check_boundary(self) -> None:
+        """Query-boundary checkpoint: once a drain was requested, stand
+        the deadline thread down and unwind resumably."""
+        if self._requested.is_set():
+            self._finished.set()
+            raise DrainRequested()
+
+
+_MANAGER: "DrainManager | None" = None
+
+
+def drain_seconds(config=None) -> float:
+    """``engine.drain_s`` > ``NDS_TPU_DRAIN_S`` > 30 s default."""
+    v = config.get("engine.drain_s") if config is not None else None
+    if v is None:
+        v = os.environ.get(DRAIN_ENV)
+    try:
+        return float(v) if v is not None else DEFAULT_DRAIN_S
+    except (TypeError, ValueError):
+        return DEFAULT_DRAIN_S
+
+
+def install(drain_s: float = DEFAULT_DRAIN_S, run_dir: str = ".",
+            _exit=os._exit) -> DrainManager:
+    """Install the process-wide drain manager for this run (replacing
+    and uninstalling any previous run's)."""
+    global _MANAGER
+    if _MANAGER is not None:
+        _MANAGER.uninstall()
+    _MANAGER = DrainManager(drain_s, run_dir, _exit=_exit).install()
+    return _MANAGER
+
+
+def uninstall() -> None:
+    global _MANAGER
+    if _MANAGER is not None:
+        _MANAGER.uninstall()
+        _MANAGER = None
+
+
+def manager() -> "DrainManager | None":
+    return _MANAGER
+
+
+def requested() -> bool:
+    return _MANAGER is not None and _MANAGER.requested()
+
+
+def check_boundary() -> None:
+    if _MANAGER is not None:
+        _MANAGER.check_boundary()

@@ -1,15 +1,27 @@
-"""Phase journal: crash-resumable whole-benchmark orchestration.
+"""Phase + query journals: crash-resumable benchmark orchestration.
 
 Execution Templates (PAPERS.md) makes the case that long-running cloud
 query workloads need cheap recovery from PARTIAL failure — re-running
 a finished three-hour load phase because throughput round 2 crashed is
-the whole-run-restart anti-pattern. The orchestrator
-(``nds/bench.py``) records each completed phase here, with the
-timings the composite metric needs, into ``bench_state.json``;
-``--resume`` replays completed phases from the journal instead of
-re-running them, so a crash costs only the phase it interrupted.
+the whole-run-restart anti-pattern. Two granularities live here:
 
-The journal is guarded by a digest of the bench config: resuming
+- :class:`PhaseJournal` — the orchestrator (``nds/bench.py``) records
+  each completed phase, with the timings the composite metric needs,
+  into ``bench_state.json``; ``--resume`` replays completed phases
+  from the journal instead of re-running them, so a crash costs only
+  the phase it interrupted.
+
+- :class:`QueryJournal` — the power loop (utils/power_core.py) and the
+  in-process throughput streams append EVERY completed statement
+  (name, wall ms, status, result digest, incarnation) to a per-phase
+  query journal, so ``--resume`` on the power drivers restarts
+  MID-PHASE at the next unfinished statement: a preemption at query 87
+  of a 99-query power run costs at most the one in-flight query, not
+  86 finished ones. Each query also records its execution *starts*
+  per incarnation, which is how the soak gate (tools/soak_check.py)
+  proves no query ever executed twice.
+
+Both journals are guarded by a digest of the driving config: resuming
 under a DIFFERENT config would splice timings from two different
 workloads into one metric, so a mismatch refuses loudly. Writes are
 atomic (tmp + rename) — a crash mid-write leaves the previous valid
@@ -17,7 +29,10 @@ journal, never a torn one — and the payload is CRC-stamped
 (io/integrity.py): a journal torn by forces outside the writer (full
 disk, copied mid-write, hand-edited) is DETECTED on ``--resume`` and
 degrades to a clean fresh run with a warning, never a crash and never
-a silent splice of half-recorded phases.
+a silent splice of half-recorded state. Every torn-journal degradation
+counts on ``journal_resets_total`` and surfaces in the BenchReport
+``degradations`` block (utils/report.py) — a silent fresh start cannot
+hide inside a long run.
 """
 
 from __future__ import annotations
@@ -25,9 +40,18 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 
 from nds_tpu.io import integrity
+
+
+def _count_reset() -> None:
+    """A torn/corrupt journal was thrown away: count it so the
+    degradation is visible in metrics snapshots, flight dumps and the
+    BenchReport ``degradations`` block."""
+    from nds_tpu.obs import metrics as obs_metrics
+    obs_metrics.counter("journal_resets_total").inc()
 
 
 class JournalMismatch(RuntimeError):
@@ -73,6 +97,7 @@ class PhaseJournal:
         if torn or not isinstance(state, dict):
             print(f"WARNING: journal {self.path} is torn/corrupt — "
                   f"ignoring it and starting fresh")
+            _count_reset()
             return False
         state.pop("crc", None)
         recorded = state.get("config_digest")
@@ -112,4 +137,166 @@ class PhaseJournal:
         replay)."""
         self.state = {"version": self.VERSION,
                       "config_digest": self.digest, "phases": {}}
+        self.write()
+
+
+class QueryJournal:
+    """Per-phase, query-granular resume journal.
+
+    One file per phase (``<phase>_queries.json`` in the run dir), one
+    entry per statement. ``start(name)`` marks an execution attempt
+    (appending the current incarnation to the query's ``starts`` list
+    BEFORE dispatch — a process killed mid-query leaves a start with no
+    completion, which is exactly the at-most-one-lost-query evidence);
+    ``record(name, ...)`` marks completion with the wall clock, final
+    status and result digest the merged phase report needs. A resumed
+    incarnation (``begin_incarnation``) replays ``done`` queries and
+    re-runs only unfinished ones. Thread-safe: the drain deadline
+    thread (resilience/drain.py) may stamp an abort while the main
+    thread is wedged inside a query."""
+
+    VERSION = 1
+
+    def __init__(self, path: str, phase: str = "",
+                 digest: str | None = None):
+        self.path = path
+        self.phase = phase
+        self.digest = digest
+        # rank-0-writes (the BenchReport rule): non-primary SPMD ranks
+        # track state in memory (their replay decisions must match the
+        # primary's) but never race it onto the shared file
+        self.readonly = False
+        self._lock = threading.Lock()
+        self.state: dict = self._fresh()
+
+    def _fresh(self) -> dict:
+        return {"version": self.VERSION, "phase": self.phase,
+                "config_digest": self.digest, "incarnation": 0,
+                "queries": {}}
+
+    @property
+    def incarnation(self) -> int:
+        return int(self.state.get("incarnation", 0))
+
+    def load(self) -> bool:
+        """Read prior state; same contract as PhaseJournal.load — a
+        torn journal warns, counts ``journal_resets_total`` and returns
+        False (degrade to a fresh run; re-running statements is always
+        correct, splicing half-recorded ones never is); a journal from
+        a DIFFERENT config refuses loudly."""
+        if not os.path.exists(self.path):
+            return False
+        try:
+            with open(self.path) as f:
+                state = json.load(f)
+            torn = not integrity.check_crc(state)
+        except ValueError:
+            torn = True
+            state = None
+        if torn or not isinstance(state, dict) \
+                or not isinstance(state.get("queries"), dict):
+            print(f"WARNING: query journal {self.path} is torn/corrupt "
+                  f"— ignoring it and starting fresh")
+            _count_reset()
+            return False
+        state.pop("crc", None)
+        recorded = state.get("config_digest")
+        if (self.digest is not None and recorded is not None
+                and recorded != self.digest):
+            raise JournalMismatch(
+                f"{self.path} was written for config {recorded}, "
+                f"current config is {self.digest} — refusing to resume "
+                f"a different workload (delete it to start over)")
+        with self._lock:
+            self.state = state
+            self.state.setdefault("queries", {})
+            self.state.setdefault("incarnation", 0)
+        return bool(state["queries"])
+
+    def begin_incarnation(self) -> int:
+        """A resumed process bumps the incarnation counter; every start
+        and completion it records carries the new number, so the merged
+        phase report and the soak gate can attribute each execution."""
+        with self._lock:
+            self.state["incarnation"] = self.incarnation + 1
+        self.write()
+        return self.incarnation
+
+    # ------------------------------------------------------- recording
+
+    def start(self, name: str) -> None:
+        """Mark an execution attempt BEFORE dispatch (atomic write: a
+        kill -9 one instruction later still leaves the start on
+        disk)."""
+        with self._lock:
+            q = self.state["queries"].setdefault(name, {"starts": []})
+            q.setdefault("starts", []).append(self.incarnation)
+        self.write()
+
+    def record(self, name: str, wall_ms: float, status: str,
+               result_digest: str | None = None) -> None:
+        """Journal a finished statement (Completed OR Failed — a failed
+        query is a FINAL state in the power-run contract; resume must
+        not re-run it and change the metric)."""
+        with self._lock:
+            q = self.state["queries"].setdefault(name, {"starts": []})
+            q.pop("aborted", None)
+            q.update({"done": True, "wall_ms": round(float(wall_ms), 3),
+                      "status": str(status),
+                      "incarnation": self.incarnation,
+                      "ts": time.time()})
+            if result_digest:
+                q["result_digest"] = result_digest
+        self.write()
+
+    def mark_aborted(self, name: str | None,
+                     reason: str = "drain-deadline") -> None:
+        """The drain deadline expired with this query in flight: stamp
+        it explicitly not-done so a post-mortem can tell a deliberate
+        abort from a crash. Safe from any thread; no-op without a
+        query."""
+        if not name:
+            return
+        with self._lock:
+            q = self.state["queries"].setdefault(name, {"starts": []})
+            if q.get("done"):
+                return  # finished after all: completion wins
+            q["aborted"] = reason
+        self.write()
+
+    # --------------------------------------------------------- readout
+
+    def done(self, name: str) -> bool:
+        return bool(self.state["queries"].get(name, {}).get("done"))
+
+    def entry(self, name: str) -> dict:
+        return dict(self.state["queries"].get(name, {}))
+
+    def completed(self) -> dict:
+        """{name: entry} of every journaled-done statement."""
+        return {n: dict(e) for n, e in self.state["queries"].items()
+                if e.get("done")}
+
+    def starts(self, name: str) -> list:
+        return list(self.state["queries"].get(name, {}).get("starts",
+                                                            []))
+
+    def write(self) -> None:
+        if self.readonly:
+            return
+        with self._lock:
+            doc = integrity.stamp_crc(
+                json.loads(json.dumps(self.state, default=str)))
+            # the file write stays INSIDE the lock: the drain deadline
+            # thread (mark_aborted) and the main thread (record) would
+            # otherwise race write_json_atomic's pid-only tmp name —
+            # the same same-process hazard FlightRecorder.dump guards
+            # with thread-unique tmps
+            integrity.write_json_atomic(self.path, doc)
+
+    def reset(self) -> None:
+        """Fresh-run entry: drop prior state on disk (same contract as
+        PhaseJournal.reset)."""
+        with self._lock:
+            self.state = self._fresh()
         self.write()

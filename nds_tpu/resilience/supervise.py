@@ -22,21 +22,33 @@ both gaps, using only artifacts the stack already emits:
   ``2 * stall_s`` of heartbeat silence it escalates SIGTERM → grace →
   SIGKILL and writes a supervisor-side ``stall-<stream>.json``.
 
-- **Restart-once** — a stream that died mid-run (stall exit, signal,
-  crash) restarts AT MOST once, resuming from its last completed query
-  (tracked in a per-stream mini-journal, ``<name>_journal.json``, fed
-  by the snapshot progress). The restarted incarnation's
-  ``NDS_TPU_STREAM`` is ``<name>#r1``, so seeded chaos schedules
-  scoped to ``<name>`` hit only the first incarnation — deterministic
-  chaos replay extends across restarts. A stream whose snapshot shows
-  every query completed is never restarted (the reference exits 1 on
-  query failures AFTER finishing the stream; re-running it would
-  double-count).
+- **Restart budget** — a stream that died mid-run (stall exit, signal,
+  crash) restarts at most ``max_restarts`` times (default once;
+  ``--max_restarts`` / bench YAML ``watchdog.max_restarts`` raise it),
+  resuming from its last completed query (tracked in a per-stream
+  mini-journal, ``<name>_journal.json``, fed by the snapshot
+  progress). The restarted incarnation's ``NDS_TPU_STREAM`` is
+  ``<name>#r1``, so seeded chaos schedules scoped to ``<name>`` hit
+  only the first incarnation — deterministic chaos replay extends
+  across restarts. A stream whose snapshot shows every query completed
+  is never restarted (the reference exits 1 on query failures AFTER
+  finishing the stream; re-running it would double-count).
 
-Exit codes, signals, stalls and restarts land in
+- **Resumable exits** — a child that exits
+  :data:`~nds_tpu.resilience.drain.EXIT_RESUMABLE` (75) drained
+  gracefully after a preemption signal (resilience/drain.py): it is
+  relaunched from its last completed query WITHOUT charging the
+  restart budget (counted separately as ``resumes``, capped by
+  ``max_resumes`` so a pathological instant-preempt loop still
+  terminates).
+
+Exit codes, signals, stalls, restarts and resumes land in
 ``throughput_summary.json`` (and the returned summary dict) instead of
-a bare failure count; ``stream_restarts_total`` / ``stream_stalls_total``
-count fleet-wide. Metrics: README "Resilience".
+a bare failure count — including ``skipped_queries``, the exact
+statements a stream that gave up never ran, so a degraded round's gap
+is enumerable instead of a count. ``stream_restarts_total`` /
+``stream_resumes_total`` / ``stream_stalls_total`` count fleet-wide.
+Metrics: README "Resilience".
 """
 
 from __future__ import annotations
@@ -51,6 +63,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from nds_tpu.io.integrity import write_json_atomic
+from nds_tpu.resilience.drain import EXIT_RESUMABLE
 from nds_tpu.resilience.watchdog import (
     EXIT_STALLED, STREAM_ENV, WATCHDOG_ENV,
 )
@@ -99,13 +112,18 @@ class StreamSupervisor:
     def __init__(self, specs: list[StreamSpec], out_dir: str,
                  stall_s: float | None = None, poll_s: float = 0.5,
                  grace_s: float = 5.0, max_restarts: int = 1,
-                 startup_grace_s: float | None = None):
+                 startup_grace_s: float | None = None,
+                 max_resumes: int = 3):
         self.specs = specs
         self.out_dir = out_dir
         self.stall_s = stall_s
         self.poll_s = poll_s
         self.grace_s = grace_s
         self.max_restarts = max_restarts
+        # graceful-drain exits (75) relaunch without charging the
+        # restart budget, but still bounded: an environment that
+        # preempts instantly forever must not spin
+        self.max_resumes = max_resumes
         # before the first heartbeat lands (interpreter + jax import +
         # warehouse load) silence is startup, not a stall
         self.startup_grace_s = (
@@ -227,7 +245,7 @@ class StreamSupervisor:
         for spec in self.specs:
             st = {"spec": spec, "incarnation": 0, "exit_codes": [],
                   "signals": [], "stalls": [], "restarts": 0,
-                  "completed": 0, "base_completed": 0,
+                  "resumes": 0, "completed": 0, "base_completed": 0,
                   "saw_heartbeat": False, "done": False}
             states.append(st)
             self._launch(st, None)
@@ -258,13 +276,21 @@ class StreamSupervisor:
                 if rc == 0 or self._finished_all(st):
                     st["done"] = True
                     continue
-                if st["restarts"] >= self.max_restarts:
+                # a graceful drain (exit 75, resilience/drain.py) is a
+                # RESUME, not a failure: relaunch from the last
+                # completed query without charging the restart budget
+                resumable = (rc == EXIT_RESUMABLE
+                             and st["resumes"] < self.max_resumes)
+                if not resumable and st["restarts"] >= self.max_restarts:
                     st["done"] = True
                     continue
-                # restart-once from the last completed query
                 from nds_tpu.obs import metrics as obs_metrics
-                obs_metrics.counter("stream_restarts_total").inc()
-                st["restarts"] += 1
+                if resumable:
+                    obs_metrics.counter("stream_resumes_total").inc()
+                    st["resumes"] += 1
+                else:
+                    obs_metrics.counter("stream_restarts_total").inc()
+                    st["restarts"] += 1
                 st["incarnation"] += 1
                 if st["spec"].queries:
                     start_q = resume_index(st["spec"].queries,
@@ -288,21 +314,35 @@ class StreamSupervisor:
             "elapse_s": round(elapse, 3),
             "stall_s": self.stall_s,
             "streams": {
-                st["spec"].name: {
-                    "exit_codes": st["exit_codes"],
-                    "signals": st["signals"],
-                    "restarts": st["restarts"],
-                    "stalls": st["stalls"],
-                    "completed": st["completed"],
-                    "queries_total": len(st["spec"].queries) or None,
-                    "degraded": bool(st["restarts"] or st["stalls"]),
-                    "final_code": code,
-                }
+                st["spec"].name: self._stream_summary(st, code)
                 for st, code in zip(states, codes)},
         }
         write_json_atomic(os.path.join(self.out_dir, SUMMARY_NAME),
                           summary)
         return elapse, codes, summary
+
+    def _stream_summary(self, st: dict, code: int) -> dict:
+        out = {
+            "exit_codes": st["exit_codes"],
+            "signals": st["signals"],
+            "restarts": st["restarts"],
+            "resumes": st["resumes"],
+            "stalls": st["stalls"],
+            "completed": st["completed"],
+            "queries_total": len(st["spec"].queries) or None,
+            "degraded": bool(st["restarts"] or st["stalls"]
+                             or st["resumes"]),
+            "final_code": code,
+        }
+        # a degraded stream that gave up names EXACTLY the statements
+        # it never completed — a gap in a throughput round must be
+        # enumerable, not a bare count
+        queries = st["spec"].queries
+        if queries and code != 0 and not self._finished_all(st):
+            out["skipped_queries"] = [
+                str(q) for q in queries[min(st["completed"],
+                                            len(queries)):]]
+        return out
 
     @staticmethod
     def _finished_all(st: dict) -> bool:
@@ -333,6 +373,10 @@ def describe_summary(summary: dict) -> str:
         bits = [f"rc={s['final_code']}"]
         if s["restarts"]:
             bits.append(f"restarts={s['restarts']}")
+        if s.get("resumes"):
+            bits.append(f"resumes={s['resumes']}")
+        if s.get("skipped_queries"):
+            bits.append(f"skipped={len(s['skipped_queries'])}")
         if s["stalls"]:
             bits.append(f"stalls={len(s['stalls'])}")
         if s["signals"]:

@@ -47,7 +47,20 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             promotes back
   engine.placement.device_budget_bytes
                             cost-model working-set budget for the
-                            device placement (default 8 GiB)
+                            device placement (default 8 GiB); also the
+                            memory governor's pre-admission ceiling
+  engine.placement.governor on (default) / off: proactive memory-
+                            pressure governor — project live bytes +
+                            plan estimate before dispatch and demote /
+                            pre-shrink instead of waiting for the OOM
+                            (engine/scheduler.MemoryGovernor)
+  engine.drain_s            graceful preemption drain deadline
+                            (default 30; NDS_TPU_DRAIN_S for fleets):
+                            on SIGTERM/SIGINT the in-flight query gets
+                            this long to finish before being abandoned
+                            and journaled not-done; either way the
+                            process exits 75 = resumable (README
+                            "Preemption & resume")
   engine.fallback           legacy alias: "cpu" forces
                             engine.placement.floor=cpu (the one-shot
                             stream demotion it used to trigger is now

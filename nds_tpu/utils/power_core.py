@@ -32,6 +32,16 @@ the ladder floor to the CPU oracle. Fault injection context
 (``NDS_TPU_STREAM``) when a supervisor launched this process as one
 throughput stream.
 
+Preemption safety (README "Preemption & resume"): every completed
+statement appends to a per-phase QueryJournal (name, wall, status,
+result digest — resilience/journal.py) AFTER its summary lands, a
+chaining SIGTERM/SIGINT drain (resilience/drain.py) lets the in-flight
+query finish under ``engine.drain_s`` before exiting 75 (resumable),
+and ``resume=True`` replays journaled statements and restarts
+mid-phase at the next unfinished one, then writes a merged phase
+report (``merged-<unit>.json``) billing every incarnation's statements
+exactly once.
+
 Hang detection (resilience/watchdog.py): the loop publishes heartbeats
 (query, phase, attempt) around every dispatch and retry; with
 ``engine.watchdog.stall_s`` (or ``NDS_TPU_WATCHDOG=stall_s[:action]``)
@@ -56,7 +66,8 @@ from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs import profile as obs_profile
 from nds_tpu.obs.trace import get_tracer
-from nds_tpu.resilience import faults, watchdog
+from nds_tpu.resilience import drain, faults, watchdog
+from nds_tpu.resilience.journal import QueryJournal, config_digest
 from nds_tpu.resilience.retry import (
     DETERMINISTIC, TRANSIENT, RetryPolicy, RetryStats, classify,
 )
@@ -293,7 +304,8 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                      warmup: int = 0,
                      query_subset: list[str] | None = None,
                      profile_dir: str | None = None,
-                     extra_time_log: str | None = None) -> int:
+                     extra_time_log: str | None = None,
+                     resume: bool = False) -> int:
     """The power loop (`nds/nds_power.py:184-322`): every query runs
     regardless of earlier failures (the reference never aborts
     mid-stream; ``--allow_failure`` only downgrades the exit code,
@@ -303,7 +315,15 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     With ``NDS_TPU_METRICS_SNAP=path[:interval]`` set, a snapshot
     emitter (nds_tpu/obs/snapshot.py) publishes the metrics registry +
     run progress + heartbeat ages periodically while the stream runs,
-    so long runs are observable in flight, not only post-mortem."""
+    so long runs are observable in flight, not only post-mortem.
+
+    Preemption safety (README "Preemption & resume"): every completed
+    statement appends to a per-phase query journal, a SIGTERM/SIGINT
+    drains gracefully (the in-flight query finishes under
+    ``engine.drain_s``, then the process exits 75 = resumable), and
+    ``resume=True`` replays journaled statements and restarts at the
+    next unfinished one — an interruption loses at most the one
+    in-flight query."""
     from contextlib import nullcontext
 
     from nds_tpu.obs.snapshot import MetricsSnapshotter
@@ -320,6 +340,14 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
           or watchdog.Watchdog.from_env(run_dir))
     if wd:
         wd.start()
+    # graceful preemption drain (resilience/drain.py): SIGTERM/SIGINT
+    # lets the in-flight query finish under engine.drain_s, flushes
+    # journal/trace/flight/snapshot state, and exits 75 (resumable)
+    dm = drain.install(drain.drain_seconds(config), run_dir)
+    if snap:
+        # the force-exit path skips every finally: the final snapshot
+        # must be flushed explicitly
+        dm.add_flush_hook(snap.write_once)
     # supervised throughput streams carry their stream name into the
     # fault-injection context, so seeded chaos schedules can target
     # one stream (and one incarnation) of a fleet
@@ -332,8 +360,9 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
                 suite, data_dir, stream_path, time_log_path, config,
                 input_format, json_summary_folder, output_prefix,
                 warmup, query_subset, profile_dir, extra_time_log,
-                progress)
+                progress, resume)
     finally:
+        drain.uninstall()
         if wd:
             wd.stop()
         watchdog.clear_unit(stream_name or f"power-{suite.name}")
@@ -349,7 +378,7 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
 def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                       config, input_format, json_summary_folder,
                       output_prefix, warmup, query_subset, profile_dir,
-                      extra_time_log, progress) -> int:
+                      extra_time_log, progress, resume=False) -> int:
     config = config or EngineConfig()
     if config.get_bool("io.verify_digests"):
         # sticky per process, like the env-var gate it mirrors: every
@@ -358,6 +387,18 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         integrity.set_verify(True)
     unit = (os.environ.get(watchdog.STREAM_ENV)
             or f"power-{suite.name}")
+    run_dir_early = (json_summary_folder
+                     or os.path.dirname(time_log_path) or ".")
+    # query-granular resume journal (resilience/journal.py): one file
+    # per phase, named by the stream unit with any restart-incarnation
+    # suffix stripped (every incarnation of one stream shares a
+    # journal). Fresh runs reset it; --resume replays it. Created here,
+    # activated (reset/load) once the primary rank is known below.
+    jname = unit.split("#")[0]
+    os.makedirs(run_dir_early, exist_ok=True)
+    journal = QueryJournal(
+        os.path.join(run_dir_early, f"{jname}_queries.json"),
+        phase=jname, digest=config_digest(config.as_dict()))
     session = make_session(suite, config)
     backend = config.get("engine.backend", "cpu")
     # multi-controller SPMD: every process computes every query, rank 0
@@ -383,6 +424,30 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # distributed backend: a fleet of rank-local sessions (each
         # rank executing on its own devices) still shares the run dir
         primary = False
+    # activate the journal now that the primary rank is known:
+    # non-primary ranks LOAD it (their replay decisions must match the
+    # primary's) but never write the shared file. A supervisor-
+    # relaunched incarnation (unit '<name>#rN' — restart OR exit-75
+    # resume) implicitly resumes the journal too: its --query_subset
+    # already scopes what re-runs, and a reset here would wipe the
+    # first incarnation's completion records (digests, start marks —
+    # exactly the evidence the journal exists to preserve)
+    journal.readonly = not primary
+    if resume or "#r" in unit:
+        if journal.load():
+            inc = journal.begin_incarnation()
+            done = sorted(journal.completed())
+            print(f"== resuming {jname} (incarnation {inc}): "
+                  f"{len(done)} journaled quer"
+                  f"{'y' if len(done) == 1 else 'ies'} replayed ==")
+    else:
+        journal.reset()
+    dm = drain.manager()
+    if dm is not None:
+        # drain-deadline force exit: the abandoned in-flight query is
+        # journaled explicitly not-done before the process dies
+        dm.add_flush_hook(
+            lambda: journal.mark_aborted(progress.get("current_query")))
     flight = obs_fleet.arm_flight_recorder(
         run_dir, rank=(fleet_meta or {}).get("rank", 0))
     # on-demand XLA profiler (obs/profile.py): trigger policy from
@@ -421,6 +486,7 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     load_report = BenchReport("load_warehouse", config.as_dict())
     load_report.report_on(_load_bracket)
     load_report.attach_retry(lstats)
+    load_report.attach_degradations()
     if "error" in load_hold:
         # post-mortem before the raise: a CorruptArtifact (or any
         # final load failure) dumps the flight ring so the run leaves
@@ -469,9 +535,29 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         profiler = None
     stream_prof = obs_profile.begin_stream_trace(profile_dir)
     failures = 0
+    replayed_ms = 0.0
     power_start = time.perf_counter()
     for qname, sql in queries.items():
         watchdog.beat(unit, query=qname, phase="dispatch")
+        # preemption drain checkpoint: once a SIGTERM/SIGINT was seen,
+        # stop HERE — the finished queries are journaled, the process
+        # exits 75, and --resume picks up at this statement
+        drain.check_boundary()
+        if journal.done(qname):
+            # resumed incarnation: replay the journaled outcome (time
+            # log row + failure accounting) so the merged phase totals
+            # match an uninterrupted run — never re-execute
+            e = journal.entry(qname)
+            wall = float(e.get("wall_ms") or 0)
+            replayed_ms += wall
+            tlog.add(qname, int(wall))
+            if e.get("status") == "Failed":
+                failures += 1
+            progress["queries_completed"] += 1
+            print(f"====== Replay {qname} (journaled "
+                  f"{e.get('status')}, incarnation "
+                  f"{e.get('incarnation', 0)}) ======")
+            continue
         if warmup and not qname.startswith(suite.warmup_skip_prefixes):
             # span recording off during warmup: untimed passes would
             # otherwise append orphan root trees to the Chrome trace,
@@ -491,6 +577,10 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
             finally:
                 wtracer.enabled = was_enabled
         progress["current_query"] = qname
+        # execution-start mark BEFORE dispatch: a kill -9 mid-query
+        # leaves a start with no completion — the journal evidence that
+        # exactly this one query was lost
+        journal.start(qname)
         # fresh per-query memory window (obs/memwatch): the HWM is
         # monotone within the query and resets here, so each summary's
         # ``memory`` block reflects what was resident while IT ran
@@ -527,9 +617,13 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                              backend=backend) as sp:
                 _h["span"] = sp
                 with faults.context(query=_q):
-                    return _front_door_retry(
+                    out = _front_door_retry(
                         front_policy, _ex, unit, _q,
                         lambda: run_one_query(session, sql, _q, _o))
+                    # result stashed for the journal's content digest
+                    # (io/result_io.result_digest); dropped right after
+                    _h["result"] = out
+                    return out
 
         # per-query XLA capture when a trigger fires: a stall-reserved
         # capture (the watchdog hook published the path in its stall
@@ -586,6 +680,14 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                             or RetryStats())
         report.attach_schedule(getattr(pre_ex, "last_schedule", None))
         report.attach_memory(memwatch.high_water())
+        # resume bookkeeping: which incarnation served this query, the
+        # result's content digest (what the soak gate diffs against a
+        # clean run), and any torn-state degradations this process saw
+        report.attach_incarnation(journal.incarnation)
+        from nds_tpu.io.result_io import result_digest
+        rdigest = result_digest(qhold.pop("result", None))
+        report.attach_result_digest(rdigest)
+        report.attach_degradations()
         elapsed_ms = summary["queryTimes"][-1]
         obs_metrics.counter("queries_total").inc()
         obs_metrics.histogram("query_seconds").observe(
@@ -638,8 +740,17 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         if json_summary_folder and primary:
             report.write_summary(prefix=f"power-{app_id}",
                                  out_dir=json_summary_folder)
+        # journal AFTER the summary landed: resume must never skip a
+        # statement whose summary is missing (the one-query loss window
+        # is between this append and the previous instruction)
+        journal.record(qname, elapsed_ms, summary["queryStatus"][-1],
+                       result_digest=rdigest)
     obs_profile.end_stream_trace()
-    power_ms = int((time.perf_counter() - power_start) * 1000)
+    # resumed incarnations bill the replayed queries' journaled walls
+    # into the phase total: the merged Power Test Time approximates the
+    # uninterrupted loop (per-query walls, minus inter-query overhead)
+    power_ms = int((time.perf_counter() - power_start) * 1000
+                   + replayed_ms)
     tlog.add("Power Test Time", power_ms)
     total_ms = int((time.perf_counter() - total_start) * 1000)
     tlog.add("Total Time", total_ms)
@@ -650,6 +761,22 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
             # reference's --extra_time_log writes the same rows via
             # Spark to a cloud path (`nds/nds_power.py:305-308`)
             tlog.write(extra_time_log)
+    if journal.incarnation > 0 and primary and json_summary_folder:
+        # one merged phase report over every incarnation's partial
+        # BenchReports (utils/report.merge_incarnations): each
+        # statement billed once, latest incarnation wins — the doc the
+        # soak gate and downstream metric consumers read instead of
+        # stitching incarnations themselves
+        from nds_tpu.io.integrity import write_json_atomic
+        from nds_tpu.obs import analyze as _analyze
+        from nds_tpu.utils.report import merge_incarnations
+        known = set(queries)
+        merged = merge_incarnations(
+            [s for s in _analyze.load_summaries(json_summary_folder)
+             if s.get("query") in known], phase=jname)
+        write_json_atomic(
+            os.path.join(json_summary_folder, f"merged-{jname}.json"),
+            merged)
     print(f"Power Test Time: {power_ms} millis")
     return failures
 

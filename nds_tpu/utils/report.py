@@ -225,6 +225,10 @@ class BenchReport:
             self.summary["ladder"] = list(sched.get("ladder", []))
         if sched.get("promoted_back"):
             self.summary["promoted_back"] = True
+        if sched.get("governed"):
+            # the memory governor demoted/pre-shrank this query BEFORE
+            # dispatch (engine/scheduler.MemoryGovernor)
+            self.summary["governed"] = True
 
     def attach_cache(self, mdelta: dict | None,
                      timings: dict | None = None) -> None:
@@ -298,6 +302,37 @@ class BenchReport:
                 block["entries"] = int(entries)
             self.summary["flight"] = block
 
+    def attach_incarnation(self, incarnation: int | None) -> None:
+        """Record which resume incarnation produced this summary
+        (resilience/journal.QueryJournal). 0 = the original process;
+        a resumed process stamps 1, 2, ... — ``merge_incarnations``
+        and ndsreport's merged billing key on it."""
+        if incarnation is not None:
+            self.summary["incarnation"] = int(incarnation)
+
+    def attach_result_digest(self, digest: str | None) -> None:
+        """Record the query result's content fingerprint
+        (io/result_io.result_digest) — the value the soak gate compares
+        between an interrupted-then-resumed run and a clean one."""
+        if digest:
+            self.summary["result_digest"] = str(digest)
+
+    def attach_degradations(self) -> None:
+        """Surface torn-state degradations in the summary: nonzero
+        ``journal_resets_total`` / ``snapshot_resets_total`` mean prior
+        on-disk state was thrown away somewhere in this process — a
+        silent fresh start must be visible in every summary it could
+        have affected, not only in a log line that scrolled away."""
+        from nds_tpu.obs import metrics as obs_metrics
+        counters = obs_metrics.snapshot().get("counters", {})
+        block = {}
+        for key, name in (("journal_resets", "journal_resets_total"),
+                          ("snapshot_resets", "snapshot_resets_total")):
+            if counters.get(name):
+                block[key] = int(counters[name])
+        if block:
+            self.summary["degradations"] = block
+
     def attach_memory(self, hwm: dict | None) -> None:
         """Record the per-query device-memory high-water mark
         (obs/memwatch.py) as the ``memory`` block:
@@ -324,3 +359,45 @@ class BenchReport:
 
     def is_success(self) -> bool:
         return self.summary["queryStatus"] == ["Completed"]
+
+
+def merge_incarnations(summaries: list, phase: str = "") -> dict:
+    """Merge the partial per-query BenchReports of EVERY incarnation of
+    a resumed phase into one phase report (README "Preemption &
+    resume"): one entry per statement, where a statement reported by
+    more than one incarnation (the kill-between-summary-and-journal
+    window) is billed ONCE, by its latest (incarnation, startTime)
+    report — the same rule ``ndsreport analyze`` applies, so the merged
+    report and the analysis agree by construction. The merged wall
+    clock is the sum of per-query walls: the only phase total that is
+    invariant under where the interruptions fell."""
+    best: dict = {}
+    for s in summaries:
+        if not isinstance(s, dict) or "query" not in s \
+                or "queryStatus" not in s:
+            continue
+        q = str(s["query"])
+        key = (int(s.get("incarnation") or 0), s.get("startTime") or 0)
+        if q not in best or key > best[q][0]:
+            best[q] = (key, s)
+    ordered = sorted(best.values(), key=lambda kv: kv[1].get(
+        "startTime") or 0)
+    merged: dict = {
+        "phase": phase,
+        "merged": True,
+        "incarnations": max((k[0] for k, _s in ordered),
+                            default=0) + 1,
+        "queries": [s["query"] for _k, s in ordered],
+        "queryStatus": [s["queryStatus"][-1] if s.get("queryStatus")
+                        else "Failed" for _k, s in ordered],
+        "queryTimes": [(s.get("queryTimes") or [0])[-1]
+                       for _k, s in ordered],
+        "startTime": min((s.get("startTime") or 0
+                          for _k, s in ordered), default=0),
+    }
+    merged["wall_ms_total"] = sum(merged["queryTimes"])
+    digests = {s["query"]: s["result_digest"] for _k, s in ordered
+               if s.get("result_digest")}
+    if digests:
+        merged["result_digests"] = digests
+    return merged

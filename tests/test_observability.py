@@ -505,8 +505,11 @@ def _check_power_artifacts(res):
     # staged sub-programs traced (STAGE_WEIGHT forced low)
     assert any(e["name"] == "stage.sub" for e in events)
     assert any(e["name"] == "device.compile" for e in events)
-    # JSON summaries carry the new schema fields
-    files = os.listdir(res["summaries"])
+    # JSON summaries carry the new schema fields (the resume journal,
+    # <unit>_queries.json, lives in the same dir but is not a report)
+    from nds_tpu.obs import analyze
+    files = [f for f in os.listdir(res["summaries"])
+             if analyze.is_report_basename(f)]
     assert len(files) == len(NDS_QUERIES)
     for f in files:
         with open(os.path.join(res["summaries"], f)) as fh:

@@ -421,7 +421,10 @@ class TestCrossProcessWarmStart:
         for f in os.listdir(jsons):
             with open(os.path.join(jsons, f)) as fh:
                 s = json.load(fh)
-            summaries[s["query"]] = s
+            # the run dir also holds the resume journal
+            # (<unit>_queries.json) — only BenchReports count here
+            if isinstance(s, dict) and "query" in s:
+                summaries[s["query"]] = s
         for q in WARM_SUBSET:
             s = summaries[q]
             assert s["queryStatus"] == ["Completed"], s["queryStatus"]

@@ -782,7 +782,13 @@ class TestBenchResume:
             calls.append("throughput")
             return 3.0, [0]
 
+        def fake_run_rc(cmd, backend=None, extra_env=None):
+            # the power phase goes through the resumable-exit wrapper
+            fake_run(cmd, backend, extra_env)
+            return 0
+
         monkeypatch.setattr(bench_mod, "_run", fake_run)
+        monkeypatch.setattr(bench_mod, "_run_rc", fake_run_rc)
         import nds_tpu.nds.streams as streams_mod
         import nds_tpu.nds.throughput as tp_mod
         monkeypatch.setattr(streams_mod, "generate_query_streams",
@@ -1415,3 +1421,557 @@ class TestNonAtomicJsonWriteRule:
 
     def test_in_default_rules(self):
         assert "NDS109" in {r.id for r in lint_rules.default_rules()}
+
+
+# ------------------------------------------------- query journal
+
+class TestQueryJournal:
+    def _j(self, tmp_path, digest="d"):
+        from nds_tpu.resilience.journal import QueryJournal
+        return QueryJournal(str(tmp_path / "q.json"), phase="power-nds",
+                            digest=digest)
+
+    def test_round_trip_starts_and_completions(self, tmp_path):
+        j = self._j(tmp_path)
+        j.reset()
+        j.start("query96")
+        j.record("query96", 120.5, "Completed", "cafe")
+        j.start("query7")   # started, never finished (the kill window)
+        j2 = self._j(tmp_path)
+        assert j2.load()
+        assert j2.done("query96") and not j2.done("query7")
+        e = j2.entry("query96")
+        assert e["wall_ms"] == 120.5 and e["status"] == "Completed"
+        assert e["result_digest"] == "cafe" and e["incarnation"] == 0
+        assert j2.starts("query7") == [0]
+        assert sorted(j2.completed()) == ["query96"]
+
+    def test_incarnation_stamps_later_executions(self, tmp_path):
+        j = self._j(tmp_path)
+        j.reset()
+        j.start("q1")
+        j.record("q1", 1.0, "Completed")
+        j2 = self._j(tmp_path)
+        assert j2.load()
+        assert j2.begin_incarnation() == 1
+        j2.start("q2")
+        j2.record("q2", 2.0, "Completed")
+        assert j2.entry("q2")["incarnation"] == 1
+        assert j2.starts("q2") == [1]
+        assert j2.entry("q1")["incarnation"] == 0  # untouched
+
+    def test_torn_journal_counts_reset_and_degrades(self, tmp_path):
+        j = self._j(tmp_path)
+        j.reset()
+        j.record("q1", 1.0, "Completed")
+        path = tmp_path / "q.json"
+        path.write_text(path.read_text()[:-10])  # torn write
+        before = obs_metrics.snapshot()
+        j2 = self._j(tmp_path)
+        assert not j2.load()                     # fresh, not a crash
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["journal_resets_total"] == 1
+
+    def test_config_drift_refuses(self, tmp_path):
+        from nds_tpu.resilience.journal import JournalMismatch
+        j = self._j(tmp_path, digest="aaaa")
+        j.reset()
+        j.record("q1", 1.0, "Completed")
+        with pytest.raises(JournalMismatch):
+            self._j(tmp_path, digest="bbbb").load()
+
+    def test_mark_aborted_never_clobbers_a_completion(self, tmp_path):
+        j = self._j(tmp_path)
+        j.reset()
+        j.start("q1")
+        j.mark_aborted("q1")
+        assert j.entry("q1")["aborted"] == "drain-deadline"
+        # a completion wins over (and clears) the abort stamp
+        j.record("q1", 5.0, "Completed")
+        assert "aborted" not in j.entry("q1")
+        j.mark_aborted("q1")
+        assert "aborted" not in j.entry("q1")
+        j.mark_aborted(None)  # no-op without a query
+
+
+# ------------------------------------------------- preemption drain
+
+class TestDrain:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from nds_tpu.resilience import drain
+        yield
+        drain.uninstall()
+
+    def _install(self, tmp_path, drain_s=30.0):
+        from nds_tpu.resilience import drain
+        exits = []
+        dm = drain.install(drain_s, str(tmp_path),
+                           _exit=lambda code: exits.append(code))
+        return drain, dm, exits
+
+    def test_boundary_exit_is_resumable(self, tmp_path):
+        import signal as _sig
+        drain, dm, exits = self._install(tmp_path)
+        assert not drain.requested()
+        drain.check_boundary()      # no-op before any signal
+        os.kill(os.getpid(), _sig.SIGTERM)
+        time.sleep(0.05)            # handler runs between bytecodes
+        assert drain.requested()
+        with pytest.raises(SystemExit) as ei:
+            drain.check_boundary()
+        assert ei.value.code == drain.EXIT_RESUMABLE == 75
+        assert exits == []          # graceful path: no force exit
+
+    def test_deadline_force_exits_after_flush_hooks(self, tmp_path):
+        import signal as _sig
+        drain, dm, exits = self._install(tmp_path, drain_s=0.15)
+        flushed = []
+        dm.add_flush_hook(lambda: flushed.append("journal"))
+        os.kill(os.getpid(), _sig.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.02)        # in-flight "query" never finishes
+        assert exits == [75]
+        assert flushed == ["journal"]
+
+    def test_repeat_signal_forces_immediately(self, tmp_path):
+        import signal as _sig
+        drain, dm, exits = self._install(tmp_path, drain_s=300.0)
+        os.kill(os.getpid(), _sig.SIGTERM)
+        time.sleep(0.05)
+        assert exits == []          # still draining
+        os.kill(os.getpid(), _sig.SIGTERM)
+        time.sleep(0.05)
+        assert exits == [75]        # operator said NOW
+
+    def test_install_uninstall_restores_handlers(self, tmp_path):
+        import signal as _sig
+        from nds_tpu.resilience import drain
+        prev_term = _sig.getsignal(_sig.SIGTERM)
+        prev_int = _sig.getsignal(_sig.SIGINT)
+        dm = drain.install(1.0, str(tmp_path), _exit=lambda c: None)
+        assert _sig.getsignal(_sig.SIGTERM) == dm._on_signal
+        drain.uninstall()
+        assert _sig.getsignal(_sig.SIGTERM) == prev_term
+        assert _sig.getsignal(_sig.SIGINT) == prev_int
+
+    def test_finished_manager_chains_to_previous(self, tmp_path):
+        """A signal landing after the drain stood down behaves like
+        the handler we replaced (the chain contract NDS114 guards)."""
+        drain, dm, exits = self._install(tmp_path)
+        import signal as _sig
+        seen = []
+        dm._prev[_sig.SIGTERM] = lambda s, f: seen.append(s)
+        dm._finished.set()
+        dm._on_signal(_sig.SIGTERM, None)
+        assert seen == [int(_sig.SIGTERM)] and exits == []
+
+    def test_drain_seconds_resolution(self, monkeypatch):
+        from nds_tpu.resilience import drain
+        monkeypatch.delenv(drain.DRAIN_ENV, raising=False)
+        assert drain.drain_seconds(None) == 30.0
+        monkeypatch.setenv(drain.DRAIN_ENV, "7.5")
+        assert drain.drain_seconds(None) == 7.5
+        cfg = EngineConfig(overrides={"engine.drain_s": "12"})
+        assert drain.drain_seconds(cfg) == 12.0
+        monkeypatch.setenv(drain.DRAIN_ENV, "junk")
+        assert drain.drain_seconds(None) == 30.0
+
+
+# --------------------------------------- query-granular power resume
+
+class TestPowerResume:
+    def _journal(self, jsons):
+        from nds_tpu.resilience.journal import QueryJournal
+        return QueryJournal(os.path.join(jsons,
+                                         "power-nds_queries.json"))
+
+    def test_fresh_run_journals_every_statement(self, mini_wh,
+                                                tmp_path):
+        _failures, sums = _run_stream(mini_wh, tmp_path)
+        j = self._journal(str(tmp_path / "json"))
+        assert j.load()
+        done = j.completed()
+        assert sorted(done) == ["query7", "query93", "query96"]
+        for q, e in done.items():
+            assert e["incarnation"] == 0 and e["starts"] == [0]
+            assert e["result_digest"]
+            # the digest in the journal matches the summary's
+            assert sums[q]["result_digest"] == e["result_digest"]
+            assert sums[q]["incarnation"] == 0
+
+    def test_resume_replays_done_and_runs_only_the_rest(self, mini_wh,
+                                                        tmp_path):
+        from nds_tpu.nds.power import SUITE
+        from nds_tpu.utils.timelog import TimeLog
+        _failures, sums0 = _run_stream(mini_wh, tmp_path)
+        jsons = str(tmp_path / "json")
+        j = self._journal(jsons)
+        assert j.load()
+        walls = {q: e["wall_ms"] for q, e in j.completed().items()}
+        digests = {q: e["result_digest"]
+                   for q, e in j.completed().items()}
+        # simulate an interruption after query96: drop the later
+        # completions (their starts stay — they DID start once)
+        for q in ("query7", "query93"):
+            j.state["queries"][q].pop("done")
+        j.write()
+        cfg = EngineConfig(overrides={
+            "engine.backend": "cpu",
+            "engine.retry.base_delay_s": "0.01"})
+        failures = power_core.run_query_stream(
+            SUITE, mini_wh["raw"], mini_wh["stream"],
+            str(tmp_path / "time2.csv"), config=cfg,
+            input_format="raw", json_summary_folder=jsons,
+            resume=True)
+        assert failures == 0
+        j2 = self._journal(jsons)
+        assert j2.load()
+        done = j2.completed()
+        assert sorted(done) == ["query7", "query93", "query96"]
+        # query96 was REPLAYED: wall preserved, never re-executed
+        assert done["query96"]["starts"] == [0]
+        assert done["query96"]["wall_ms"] == walls["query96"]
+        # the others re-ran in incarnation 1 with identical results
+        for q in ("query7", "query93"):
+            assert done[q]["incarnation"] == 1
+            assert done[q]["starts"] == [0, 1]
+            assert done[q]["result_digest"] == digests[q]
+        # the resumed time log covers the WHOLE phase
+        rows = {q: ms for _a, q, ms in TimeLog.read(
+            str(tmp_path / "time2.csv"))}
+        for q in ("query96", "query7", "query93"):
+            assert q in rows
+        assert rows["query96"] == int(walls["query96"])
+        assert rows["Power Test Time"] > 0
+        # one merged phase report, every statement billed once
+        with open(os.path.join(jsons, "merged-power-nds.json")) as f:
+            merged = json.load(f)
+        assert merged["incarnations"] == 2
+        assert sorted(merged["queries"]) == ["query7", "query93",
+                                            "query96"]
+        assert set(merged["queryStatus"]) == {"Completed"}
+
+    def test_resume_refuses_config_drift(self, mini_wh, tmp_path):
+        from nds_tpu.nds.power import SUITE
+        from nds_tpu.resilience.journal import JournalMismatch
+        _run_stream(mini_wh, tmp_path)
+        cfg = EngineConfig(overrides={
+            "engine.backend": "cpu",
+            "engine.retry.base_delay_s": "0.5"})  # different config
+        with pytest.raises(JournalMismatch):
+            power_core.run_query_stream(
+                SUITE, mini_wh["raw"], mini_wh["stream"],
+                str(tmp_path / "t2.csv"), config=cfg,
+                input_format="raw",
+                json_summary_folder=str(tmp_path / "json"),
+                resume=True)
+
+    def test_fresh_run_resets_stale_query_journal(self, mini_wh,
+                                                  tmp_path):
+        _run_stream(mini_wh, tmp_path, subset=["query96"])
+        j = self._journal(str(tmp_path / "json"))
+        assert j.load()
+        assert sorted(j.completed()) == ["query96"]
+        # a later NON-resume run must not splice the stale journal
+        _run_stream(mini_wh, tmp_path, subset=["query93"])
+        j2 = self._journal(str(tmp_path / "json"))
+        j2.load()
+        assert sorted(j2.completed()) == ["query93"]
+
+    def test_torn_query_journal_degrades_to_fresh(self, mini_wh,
+                                                  tmp_path):
+        from nds_tpu.nds.power import SUITE
+        _run_stream(mini_wh, tmp_path, subset=["query96"])
+        jpath = os.path.join(str(tmp_path / "json"),
+                             "power-nds_queries.json")
+        with open(jpath, "r+b") as f:
+            f.seek(8)
+            b = f.read(1)
+            f.seek(8)
+            f.write(bytes([b[0] ^ 0xFF]))
+        before = obs_metrics.snapshot()
+        cfg = EngineConfig(overrides={
+            "engine.backend": "cpu",
+            "engine.retry.base_delay_s": "0.01"})
+        failures = power_core.run_query_stream(
+            SUITE, mini_wh["raw"], mini_wh["stream"],
+            str(tmp_path / "t3.csv"), config=cfg, input_format="raw",
+            json_summary_folder=str(tmp_path / "json"),
+            query_subset=["query96"], resume=True)
+        assert failures == 0
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"]["journal_resets_total"] == 1
+        # the degradation surfaces in the run's summaries
+        _f, sums = 0, {}
+        for f in os.listdir(str(tmp_path / "json")):
+            with open(os.path.join(str(tmp_path / "json"), f)) as fh:
+                s = json.load(fh)
+            if isinstance(s, dict) and s.get("query") == "query96":
+                sums[s["startTime"]] = s
+        latest = sums[max(sums)]
+        assert latest["degradations"]["journal_resets"] >= 1
+
+
+# ----------------------------------- supervisor resumable exits
+
+class TestSupervisorResume:
+    def test_exit_75_resumes_without_charging_restarts(self, tmp_path):
+        out = str(tmp_path)
+        before = obs_metrics.snapshot()
+        spec = _script_spec("s1", out, ["raise SystemExit(75)", "pass"])
+        # ZERO restart budget: only the resumable contract relaunches
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05,
+                                         max_restarts=0)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert codes == [0]
+        assert s["exit_codes"] == [75, 0]
+        assert s["restarts"] == 0 and s["resumes"] == 1
+        assert s["degraded"]
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"].get("stream_resumes_total") == 1
+        assert not d["counters"].get("stream_restarts_total")
+
+    def test_resume_budget_is_bounded(self, tmp_path):
+        out = str(tmp_path)
+        spec = _script_spec("s1", out, ["raise SystemExit(75)"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05,
+                                         max_restarts=0, max_resumes=2)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert s["exit_codes"] == [75, 75, 75]  # initial + 2 resumes
+        assert s["resumes"] == 2 and codes == [75]
+
+    def test_skipped_queries_enumerated(self, tmp_path):
+        out = str(tmp_path)
+        spec = _script_spec("s1", out, ["raise SystemExit(3)"],
+                            queries=["q1", "q2", "q3"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05,
+                                         max_restarts=1)
+        _elapse, codes, summary = sup.run()
+        s = summary["streams"]["s1"]
+        assert codes == [3]
+        # nothing ever completed: the whole stream is the gap, named
+        assert s["skipped_queries"] == ["q1", "q2", "q3"]
+        ondisk = json.load(open(os.path.join(out,
+                                             supervise.SUMMARY_NAME)))
+        assert ondisk["streams"]["s1"]["skipped_queries"] == \
+            ["q1", "q2", "q3"]
+
+    def test_successful_stream_lists_no_skips(self, tmp_path):
+        out = str(tmp_path)
+        spec = _script_spec("s1", out, ["pass"], queries=["q1"])
+        sup = supervise.StreamSupervisor([spec], out, poll_s=0.05)
+        _elapse, _codes, summary = sup.run()
+        assert "skipped_queries" not in summary["streams"]["s1"]
+        assert summary["streams"]["s1"]["resumes"] == 0
+
+
+# ----------------------------------- transcode table-granular resume
+
+class TestTranscodeResume:
+    TABLES = ["warehouse", "income_band"]
+
+    def _transcode(self, mini_wh, out, resume=False):
+        from nds_tpu.nds.transcode import transcode
+        return transcode(mini_wh["raw"], out,
+                         os.path.join(out, "report.txt"),
+                         tables=self.TABLES, resume=resume)
+
+    def test_resume_skips_verified_tables(self, mini_wh, tmp_path):
+        out = str(tmp_path / "wh")
+        first = self._transcode(mini_wh, out)
+        assert all(first[t] > 0 for t in self.TABLES)
+        mtimes = {}
+        for t in self.TABLES:
+            tdir = os.path.join(out, t)
+            mtimes[t] = {f: os.stat(os.path.join(tdir, f)).st_mtime_ns
+                         for f in os.listdir(tdir)}
+        # resume: every manifest verifies -> nothing re-transcodes
+        second = self._transcode(mini_wh, out, resume=True)
+        assert all(second[t] == 0.0 for t in self.TABLES)
+        for t in self.TABLES:
+            tdir = os.path.join(out, t)
+            now = {f: os.stat(os.path.join(tdir, f)).st_mtime_ns
+                   for f in os.listdir(tdir)}
+            assert now == mtimes[t]  # bytes untouched
+
+    def test_resume_rebuilds_missing_and_corrupt_tables(self, mini_wh,
+                                                        tmp_path):
+        import shutil
+        out = str(tmp_path / "wh")
+        self._transcode(mini_wh, out)
+        # SIGTERM-mid-load analog: one table's output never finished
+        shutil.rmtree(os.path.join(out, "income_band"))
+        # ...and another's bytes were corrupted on disk
+        wdir = os.path.join(out, "warehouse")
+        data = [f for f in os.listdir(wdir)
+                if not f.startswith("_")][0]
+        p = os.path.join(wdir, data)
+        with open(p, "r+b") as f:
+            f.seek(20)
+            b = f.read(1)
+            f.seek(20)
+            f.write(bytes([b[0] ^ 0xFF]))
+        integrity.clear_cache()
+        redo = self._transcode(mini_wh, out, resume=True)
+        assert redo["income_band"] > 0   # missing: rebuilt
+        assert redo["warehouse"] > 0     # corrupt: rebuilt
+        # and now everything verifies again
+        assert integrity.verify_manifest(wdir)
+
+    def test_non_resume_always_retranscodes(self, mini_wh, tmp_path):
+        out = str(tmp_path / "wh")
+        self._transcode(mini_wh, out)
+        again = self._transcode(mini_wh, out)   # no resume flag
+        assert all(again[t] > 0 for t in self.TABLES)
+
+    def test_verify_manifest_contract(self, tmp_path):
+        d = str(tmp_path / "t")
+        os.makedirs(d)
+        assert not integrity.verify_manifest(d)  # no manifest
+        with open(os.path.join(d, "part-0.bin"), "wb") as f:
+            f.write(b"payload")
+        integrity.write_manifest(d)
+        assert integrity.verify_manifest(d)
+        with open(os.path.join(d, "part-0.bin"), "wb") as f:
+            f.write(b"tampered")
+        assert not integrity.verify_manifest(d)
+        os.unlink(os.path.join(d, "part-0.bin"))
+        assert not integrity.verify_manifest(d)  # missing file
+
+
+class TestBenchResumableExit:
+    def test_power_exit_75_retries_with_resume(self, tmp_path,
+                                               monkeypatch):
+        """A power subprocess that drains (exit 75) is re-run with
+        --resume instead of failing the bench, and never counts as a
+        failed phase."""
+        from nds_tpu.nds import bench as bench_mod
+        from nds_tpu.utils.timelog import TimeLog
+        calls = []
+        rcs = [75, 75, 0]
+
+        def fake_run(cmd, backend=None, extra_env=None):
+            if cmd[2] == "nds_tpu.nds.transcode":
+                with open(cmd[5], "w") as f:
+                    f.write("Total conversion time for 24 tables was "
+                            "5.0s\nRNGSEED used: 123\n")
+            elif cmd[2] == "nds_tpu.nds.maintenance":
+                t = TimeLog("fake")
+                t.add("Data Maintenance Time", 1500)
+                t.write(cmd[5])
+
+        def fake_run_rc(cmd, backend=None, extra_env=None):
+            calls.append(list(cmd))
+            rc = rcs.pop(0)
+            if rc == 0:
+                t = TimeLog("fake")
+                t.add("Power Test Time", 2000)
+                t.write(cmd[5])
+            return rc
+
+        monkeypatch.setattr(bench_mod, "_run", fake_run)
+        monkeypatch.setattr(bench_mod, "_run_rc", fake_run_rc)
+        import nds_tpu.nds.streams as streams_mod
+        import nds_tpu.nds.throughput as tp_mod
+        monkeypatch.setattr(streams_mod, "generate_query_streams",
+                            lambda *a, **kw: None)
+        monkeypatch.setattr(tp_mod, "run_streams",
+                            lambda *a, **kw: (3.0, [0]))
+        monkeypatch.setattr(tp_mod, "run_streams_inprocess",
+                            lambda *a, **kw: (3.0, [0]))
+        work = tmp_path / "w"
+        cfg = {"scale_factor": 0.01, "parallel": 2, "num_streams": 1,
+               "backend": "cpu",
+               "paths": {"raw_data": str(work / "raw"),
+                         "warehouse": str(work / "wh"),
+                         "streams": str(work / "streams"),
+                         "reports": str(work / "reports")},
+               "skip": {"data_gen": True}}
+        metrics = bench_mod.run_full_bench(cfg)
+        assert metrics["metric"] is not None
+        assert len(calls) == 3
+        assert "--resume" not in calls[0]       # fresh first launch
+        assert "--resume" in calls[1]           # both retries resume
+        assert "--resume" in calls[2]
+
+    def test_power_non_resumable_failure_still_raises(self, tmp_path,
+                                                      monkeypatch):
+        import subprocess as sp
+
+        from nds_tpu.nds import bench as bench_mod
+        monkeypatch.setattr(bench_mod, "_run",
+                            lambda *a, **kw: None)
+        monkeypatch.setattr(bench_mod, "_run_rc",
+                            lambda *a, **kw: 1)
+        monkeypatch.setattr(bench_mod, "get_load_time",
+                            lambda p: 5.0)
+        monkeypatch.setattr(bench_mod, "get_rngseed", lambda p: 123)
+        import nds_tpu.nds.streams as streams_mod
+        monkeypatch.setattr(streams_mod, "generate_query_streams",
+                            lambda *a, **kw: None)
+        work = tmp_path / "w"
+        cfg = {"scale_factor": 0.01, "parallel": 2, "num_streams": 1,
+               "backend": "cpu",
+               "paths": {"raw_data": str(work / "raw"),
+                         "warehouse": str(work / "wh"),
+                         "streams": str(work / "streams"),
+                         "reports": str(work / "reports")},
+               "skip": {"data_gen": True}}
+        with pytest.raises(sp.CalledProcessError):
+            bench_mod.run_full_bench(cfg)
+
+
+class TestReviewFixes:
+    def test_transcode_resume_refuses_option_drift(self, mini_wh,
+                                                   tmp_path):
+        from nds_tpu.nds.transcode import transcode
+        out = str(tmp_path / "wh")
+        transcode(mini_wh["raw"], out,
+                  os.path.join(out, "r.txt"), tables=["warehouse"])
+        # same options resume: fine
+        transcode(mini_wh["raw"], out, os.path.join(out, "r2.txt"),
+                  tables=["warehouse"], resume=True)
+        # different schema mode: the finished tables' manifests still
+        # verify, so a silent skip would yield a mixed warehouse —
+        # refuse loudly instead
+        with pytest.raises(ValueError, match="different transcode"):
+            transcode(mini_wh["raw"], out, os.path.join(out, "r3.txt"),
+                      tables=["warehouse"], resume=True,
+                      use_decimal=False)
+
+    def test_restarted_incarnation_keeps_journal(self, mini_wh,
+                                                 tmp_path,
+                                                 monkeypatch):
+        """A supervisor-relaunched incarnation (unit '<name>#rN') must
+        LOAD the shared journal, not reset it: the first incarnation's
+        completion records are the no-double-execution evidence."""
+        from nds_tpu.nds.power import SUITE
+        jsons = str(tmp_path / "json")
+        cfg = {"engine.backend": "cpu",
+               "engine.retry.base_delay_s": "0.01"}
+        monkeypatch.setenv(watchdog.STREAM_ENV, "s9")
+        power_core.run_query_stream(
+            SUITE, mini_wh["raw"], mini_wh["stream"],
+            str(tmp_path / "t1.csv"),
+            config=EngineConfig(overrides=cfg), input_format="raw",
+            json_summary_folder=jsons, query_subset=["query96"])
+        # the relaunched incarnation runs the REMAINING subset
+        monkeypatch.setenv(watchdog.STREAM_ENV, "s9#r1")
+        power_core.run_query_stream(
+            SUITE, mini_wh["raw"], mini_wh["stream"],
+            str(tmp_path / "t2.csv"),
+            config=EngineConfig(overrides=cfg), input_format="raw",
+            json_summary_folder=jsons,
+            query_subset=["query7", "query93"])
+        from nds_tpu.resilience.journal import QueryJournal
+        j = QueryJournal(os.path.join(jsons, "s9_queries.json"))
+        assert j.load()
+        done = j.completed()
+        # incarnation 0's record SURVIVED the relaunch
+        assert done["query96"]["incarnation"] == 0
+        assert done["query7"]["incarnation"] == 1
+        assert done["query93"]["incarnation"] == 1

@@ -340,8 +340,12 @@ class TestRealRun:
 
     def test_summaries_validate_against_schema(self, cpu_power_run):
         from tools.check_trace_schema import validate_summary_file
+        # journals (<unit>_queries.json) and merged phase reports are
+        # run-dir artifacts but not BenchReports (analyze skips them
+        # via the same predicate)
         files = [f for f in os.listdir(cpu_power_run)
-                 if f.endswith(".json") and f != "analysis.json"]
+                 if analyze.is_report_basename(f)
+                 and f != "analysis.json"]
         assert files
         for f in files:
             assert validate_summary_file(
@@ -551,3 +555,55 @@ class TestTraceFlush:
         assert os.path.exists(trace_path)
         tracer.flush_exports()  # idempotent
         assert len(open(trace_path).readlines()) == 1
+
+
+# --------------------------------------- merged-incarnation billing
+
+RUN_RESUMED = os.path.join(FIXTURES, "run_resumed")
+
+
+class TestMergedIncarnations:
+    """Resumed runs (README "Preemption & resume") bill each query
+    once: the committed run_resumed fixture holds a query reported by
+    two incarnations (the kill-between-summary-and-journal window)."""
+
+    def test_merge_resumed_keeps_latest_incarnation(self):
+        sums = analyze.load_summaries(RUN_RESUMED)
+        assert len(sums) == 4  # the raw dir really holds a duplicate
+        merged, dropped = analyze.merge_resumed(sums)
+        assert dropped == {"query7": 1}
+        by_q = {s["query"]: s for s in merged}
+        assert sorted(by_q) == ["query7", "query93", "query96"]
+        # the RE-RUN (incarnation 1, Completed) wins over the
+        # interrupted incarnation-0 report
+        assert by_q["query7"]["incarnation"] == 1
+        assert by_q["query7"]["queryStatus"] == ["Completed"]
+
+    def test_analyze_run_bills_merged_queries_once(self):
+        a = analyze.analyze_run(RUN_RESUMED, with_trace=False)
+        names = [r["query"] for r in a["queries"]]
+        assert sorted(names) == ["query7", "query93", "query96"]
+        assert a["merged_incarnations"] == {"query7": 1}
+        assert a["incarnations"] == 2
+        # totals reflect the kept reports only (no double billing)
+        assert a["totals"]["wall_ms"] == 120 + 280 + 90
+        # the derived merged-*.json phase report is never ingested as
+        # a BenchReport (it would double-bill every query)
+        assert not analyze.is_report_basename("merged-power-nds.json")
+
+    def test_unresumed_runs_pass_through_untouched(self):
+        sums = analyze.load_summaries(RUN_A)
+        merged, dropped = analyze.merge_resumed(sums)
+        assert merged == sums and dropped == {}
+
+    def test_merge_incarnations_phase_report(self):
+        from nds_tpu.utils.report import merge_incarnations
+        sums = analyze.load_summaries(RUN_RESUMED)
+        doc = merge_incarnations(sums, phase="power-nds")
+        assert doc["merged"] is True
+        assert doc["incarnations"] == 2
+        assert sorted(doc["queries"]) == ["query7", "query93",
+                                         "query96"]
+        assert doc["queryStatus"] == ["Completed"] * 3
+        assert doc["wall_ms_total"] == 120 + 280 + 90
+        assert doc["result_digests"]["query7"] == "bbbb333344445555"

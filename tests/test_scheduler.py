@@ -490,3 +490,153 @@ class TestPipelineSurface:
             pipe.execute(planned)
         assert pipe.last_schedule["reschedules"] == 1
         assert pipe.last_schedule["ladder"] == [DEVICE, CHUNKED]
+
+
+# ------------------------------------------------------ memory governor
+
+class TestMemoryGovernor:
+    """Proactive pre-admission checks (scheduler.MemoryGovernor): the
+    projection (live bytes + estimate x expansion) demotes BEFORE
+    dispatch, with hysteresis and metrics."""
+
+    class _Est:
+        def __init__(self, nbytes):
+            self.bytes = nbytes
+
+    def test_projection_over_budget_demotes_and_counts(self):
+        from nds_tpu.obs import metrics as obs_metrics
+        gov = scheduler.MemoryGovernor(budget=1000, expansion=2.0)
+        before = obs_metrics.snapshot()
+        # 600 est x 2.0 expansion = 1200 projected > 1000 budget
+        reason = gov.decide(self._Est(600))
+        assert reason and reason.startswith("governor:")
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert d["counters"][
+            "governor_preemptive_demotions_total"] == 1
+
+    def test_under_budget_admits(self):
+        gov = scheduler.MemoryGovernor(budget=10_000, expansion=2.0)
+        assert gov.decide(self._Est(100)) is None
+        assert gov.governing is False
+
+    def test_hysteresis_keeps_governing_until_low_watermark(self):
+        gov = scheduler.MemoryGovernor(budget=1000, expansion=1.0,
+                                       low_frac=0.8)
+        assert gov.decide(self._Est(1100))  # over budget: governs
+        assert gov.governing
+        # 900 < 1000 budget but > 800 low watermark: STILL governed
+        assert gov.decide(self._Est(900))
+        # 700 < 800: stands down
+        assert gov.decide(self._Est(700)) is None
+        assert gov.governing is False
+        # and 900 admits again now that it stood down
+        assert gov.decide(self._Est(900)) is None
+
+    def test_zero_estimate_never_governs(self):
+        gov = scheduler.MemoryGovernor(budget=1)
+        assert gov.decide(self._Est(0)) is None
+
+    def test_pipeline_demotes_device_query_preemptively(self):
+        """Live accounted bytes + the plan estimate exceed the budget:
+        the query starts CHUNKED (governed: true in the schedule)
+        without ever dispatching to the device executor."""
+        from nds_tpu.obs import memwatch
+        dev, chk = FakeExec(), FakeExec()
+        pipe = _pipe(execs={DEVICE: dev, CHUNKED: chk})
+        # a registered table gives the plan estimate real row counts
+        pipe._tables["reason"] = type("T", (), {"nrows": 100_000})()
+        planned, _ = _plan("select count(*) c from reason")
+        # force the projection over budget via the accounted tracker
+        memwatch.add_live(1 << 20)
+        try:
+            pipe.governor = scheduler.MemoryGovernor(budget=1)
+            assert pipe.execute(planned) == "ok"
+        finally:
+            memwatch.sub_live(1 << 20)
+        assert dev.calls == 0 and chk.calls == 1
+        assert pipe.last_schedule["governed"] is True
+        assert pipe.last_schedule["reason"].startswith("governor:")
+        assert pipe.last_schedule["reschedules"] == 0
+
+    def test_pipeline_preshrinks_chunked_query(self):
+        """A query already bound for the chunked placement pre-shrinks
+        chunk_rows for THAT query and restores afterwards."""
+        from nds_tpu.obs import memwatch
+
+        class Recording(FakeExec):
+            seen = None
+
+            def execute(self, planned, key=None):
+                Recording.seen = self.chunk_rows
+                return super().execute(planned, key)
+
+        chk = Recording()
+        chk.chunk_rows = 1 << 14
+        # cost model already picks chunked (tiny stream threshold)
+        pipe = _pipe(overrides={"engine.stream_bytes": "1"},
+                     execs={CHUNKED: chk})
+        pipe.stream_bytes = 1
+        pipe.cost_model.stream_bytes = 1
+        pipe._tables["store_sales"] = type("T", (),
+                                           {"nrows": 1_000_000})()
+        planned, _ = _plan()
+        memwatch.add_live(1 << 20)
+        try:
+            pipe.governor = scheduler.MemoryGovernor(budget=1)
+            pipe.execute(planned)
+        finally:
+            memwatch.sub_live(1 << 20)
+        assert Recording.seen == 1 << 13       # ran at half
+        assert chk.chunk_rows == 1 << 14       # restored after
+        assert pipe.last_schedule["governed"] is True
+
+    def test_governor_off_config_disables(self):
+        pipe = _pipe(overrides={"engine.placement.governor": "off"})
+        assert pipe.governor is None
+
+    def test_multi_rank_world_skips_governor(self):
+        """Live memory is rank-local: a multi-rank pipeline must not
+        consult it (divergent placements deadlock collectives)."""
+        from nds_tpu.obs import memwatch
+
+        class TwoRanks(NullChannel):
+            world = 2
+
+            def gather(self, vote):
+                return [vote, vote]
+
+        dev = FakeExec()
+        pipe = _pipe(execs={DEVICE: dev, CHUNKED: FakeExec()})
+        pipe._tables["reason"] = type("T", (), {"nrows": 100_000})()
+        pipe.consensus = Consensus(TwoRanks())
+        pipe.governor = scheduler.MemoryGovernor(budget=1)
+        planned, _ = _plan("select count(*) c from reason")
+        memwatch.add_live(1 << 20)
+        try:
+            pipe.execute(planned)
+        finally:
+            memwatch.sub_live(1 << 20)
+        assert dev.calls == 1                  # stayed on device
+        assert "governed" not in pipe.last_schedule
+
+    def test_cpu_universe_never_counts_phantom_demotions(self):
+        """No relief rung -> the governor is not consulted: the
+        counter must not report demotions that never happened."""
+        from nds_tpu.obs import memwatch
+        from nds_tpu.obs import metrics as obs_metrics
+        cpu = FakeExec()
+        pipe = _pipe("cpu", execs={CPU: cpu})
+        pipe._tables["reason"] = type("T", (), {"nrows": 100_000})()
+        pipe.governor = scheduler.MemoryGovernor(budget=1)
+        planned, _ = _plan("select count(*) c from reason")
+        memwatch.add_live(1 << 20)
+        before = obs_metrics.snapshot()
+        try:
+            pipe.execute(planned)
+        finally:
+            memwatch.sub_live(1 << 20)
+        d = obs_metrics.delta(before, obs_metrics.snapshot())
+        assert not d.get("counters", {}).get(
+            "governor_preemptive_demotions_total")
+        assert cpu.calls == 1
+        assert pipe.governor.governing is False

@@ -496,3 +496,64 @@ def test_static_checks_end_to_end():
         capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "STATIC CHECKS OK" in r.stdout
+
+
+def test_rule_unchained_signal_handler():
+    # installing a real handler with no getsignal in scope flags
+    src = ("import signal\n"
+           "def install(h):\n"
+           "    signal.signal(signal.SIGTERM, h)\n")
+    assert _rules(_lint(src, path="nds_tpu/obs/fixture.py",
+                        enabled={"NDS114"}).violations) == {"NDS114"}
+    # capturing the previous handler first (the chain pattern) is clean
+    chained = ("import signal\n"
+               "def install(h):\n"
+               "    prev = signal.getsignal(signal.SIGTERM)\n"
+               "    signal.signal(signal.SIGTERM, h)\n"
+               "    return prev\n")
+    assert _lint(chained, path="nds_tpu/obs/fixture.py",
+                 enabled={"NDS114"}).violations == []
+    # an ancestor closure that captured prev covers nested installs
+    nested = ("import signal\n"
+              "def install(h):\n"
+              "    prev = signal.getsignal(signal.SIGTERM)\n"
+              "    def _on(s, f):\n"
+              "        signal.signal(signal.SIGTERM, h)\n"
+              "    signal.signal(signal.SIGTERM, _on)\n")
+    assert _lint(nested, path="nds_tpu/obs/fixture.py",
+                 enabled={"NDS114"}).violations == []
+    # restoring the default/ignore disposition is not a chain hazard
+    restore = ("import signal\n"
+               "def reraise():\n"
+               "    signal.signal(signal.SIGTERM, signal.SIG_DFL)\n"
+               "    signal.signal(signal.SIGINT, signal.SIG_IGN)\n")
+    assert _lint(restore, path="nds_tpu/obs/fixture.py",
+                 enabled={"NDS114"}).violations == []
+    # outside nds_tpu/ the rule does not apply
+    assert _lint(src, path="tools/fixture.py",
+                 enabled={"NDS114"}).violations == []
+    # waivable with justification
+    waived = ("import signal\n"
+              "def install(h):\n"
+              "    # ndslint: waive[NDS114] -- test fixture owns it\n"
+              "    signal.signal(signal.SIGTERM, h)\n")
+    res = _lint(waived, path="nds_tpu/obs/fixture.py",
+                enabled={"NDS114"})
+    assert res.violations == [] and len(res.waived) == 1
+    # the production tree holds the invariant: every signal.signal
+    # site under nds_tpu/ chains (obs/fleet.py, resilience/drain.py)
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    offenders = []
+    for p in (repo / "nds_tpu").rglob("*.py"):
+        if "signal.signal(" in p.read_text():
+            res = lint_rules.lint_sources(
+                {str(p.relative_to(repo)): p.read_text()},
+                enabled={"NDS114"})
+            offenders += res.violations
+    assert offenders == [], offenders
+
+
+def test_nds114_in_default_rules():
+    assert any(r.id == "NDS114"
+               for r in lint_rules.default_rules())

@@ -17,9 +17,11 @@ BenchReport summary schema (``--summary``, README "Observability"):
   — spans (name/dur_ms/attrs/children tree), metrics (counters/gauges/
   histograms with count+sum and optional p50/p95/p99), memory
   (device_hwm_bytes + source), retries / retry_backoff_s /
-  gave_up_reason / deadline_exceeded, and the scheduling fields
-  placement / reschedules / ladder / promoted_back
-  (engine/scheduler.py; README "Placement & degradation"), and the
+  gave_up_reason / deadline_exceeded, the scheduling fields
+  placement / reschedules / ladder / promoted_back / governed
+  (engine/scheduler.py; README "Placement & degradation"), the resume
+  fields incarnation / result_digest and the torn-state degradations
+  block (resilience/journal.py; README "Preemption & resume"), and the
   plan-cache block cache (hits + misses required ints; optional
   errors / bytes_read / bytes_written / load_ms — nds_tpu/cache/;
   README "Plan cache"), the kernel-use block kernels (kernel
@@ -201,6 +203,33 @@ def validate_summary(obj: object) -> list[str]:
         errs.append(f"bad ladder {obj['ladder']!r}")
     if "promoted_back" in obj and obj["promoted_back"] is not True:
         errs.append(f"bad promoted_back {obj['promoted_back']!r}")
+    if "governed" in obj and obj["governed"] is not True:
+        # memory-governor pre-admission demotion
+        # (engine/scheduler.MemoryGovernor)
+        errs.append(f"bad governed {obj['governed']!r}")
+    # resume fields (resilience/journal.QueryJournal; README
+    # "Preemption & resume"): which incarnation served the query and
+    # the result's content digest
+    if "incarnation" in obj and (
+            not isinstance(obj["incarnation"], int)
+            or isinstance(obj["incarnation"], bool)
+            or obj["incarnation"] < 0):
+        errs.append(f"bad incarnation {obj['incarnation']!r}")
+    if "result_digest" in obj and (
+            not isinstance(obj["result_digest"], str)
+            or not obj["result_digest"]):
+        errs.append(f"bad result_digest {obj['result_digest']!r}")
+    # torn-state degradations surfaced per summary
+    # (journal_resets_total / snapshot_resets_total)
+    deg = obj.get("degradations")
+    if deg is not None:
+        if (not isinstance(deg, dict) or not deg
+                or not set(deg) <= {"journal_resets",
+                                    "snapshot_resets"}
+                or any(not isinstance(v, int)
+                       or isinstance(v, bool) or v <= 0
+                       for v in deg.values())):
+            errs.append(f"bad degradations block {deg!r}")
     # plan-cache block (nds_tpu/cache/; README "Plan cache"): hits +
     # misses always travel together; byte counts / errors / load_ms
     # are optional and non-negative

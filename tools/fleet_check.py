@@ -160,9 +160,12 @@ def check_fleet_run(workdir: str) -> int:
     # 3. the profile-triggered query's BenchReport carries a nonzero
     # profile block (and every summary validates)
     prof_block = None
+    from nds_tpu.obs import analyze
     for name in sorted(os.listdir(run_dir)):
         if not name.endswith(".json") or "power-" not in name:
             continue
+        if not analyze.is_report_basename(name):
+            continue  # the resume journal (<unit>_queries.json)
         path = os.path.join(run_dir, name)
         errs = check_trace_schema.validate_summary_file(path)
         if errs:

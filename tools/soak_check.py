@@ -1,0 +1,496 @@
+"""Chaos soak gate: interrupt a real benchmark run, resume it, prove
+nothing was lost and nothing ran twice.
+
+The resilience stack now claims a strong invariant: an interrupted run
+loses AT MOST the one in-flight query (README "Preemption & resume").
+This gate makes that claim mechanically testable against real power-run
+subprocesses on a tiny in-memory warehouse (SF0.01):
+
+- **smoke** (default; tier-1 section 9 via tools/static_checks.py) —
+  two interruption scenarios against a 3-query NDS power stream:
+
+  1. *SIGTERM drain*: the victim query is wedged by an injected
+     ``stream.query:hang``; SIGTERM arrives mid-query, the drain
+     deadline (``NDS_TPU_DRAIN_S``) expires, the process journals the
+     in-flight query as explicitly not-done and exits 75 (resumable).
+  2. *kill -9 mid-query*: no drain, no handler, no flush — the hard
+     case. The journal's pre-dispatch start mark is the only evidence.
+
+  After each interruption the run resumes with ``--resume`` and the
+  gate asserts: the resumed run completes every statement, the final
+  per-query result digests are byte-identical to an uninterrupted
+  clean run's, every statement completed exactly ONCE (journal start/
+  done accounting — the killed query restarted, nothing else did), the
+  merged phase report (``merged-*.json``) bills each query once, and
+  ``ndsreport``-side analysis sees no double-billed rows. The
+  stale-state path never fires: ``journal_resets_total`` stays zero
+  and the final metric row (Power Test Time) is regenerated from THIS
+  run's journal, never replayed from a stale artifact.
+
+- **--full N** — N additional seeded randomized rounds (kind x victim
+  drawn from a seeded RNG: SIGTERM drains and hard kills), plus an
+  injected-OOM round (a transient device OOM recovered by the retry
+  machinery composes with a mid-run kill: the resume replays the
+  recovered completion instead of re-paying it), a torn-journal round
+  (the journal is byte-flipped between incarnations: the resume must
+  degrade to a warned fresh start, count ``journal_resets_total``,
+  surface it in the summaries' ``degradations`` block, and STILL
+  converge to the clean digests) and an NDS-H drain round — both
+  suites survive, not just NDS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCALE = 0.01
+TEMPLATES = [96, 7, 93]
+DRAIN_S = "2"          # short deadline: the gate must not idle 30 s
+HANG_S = 90            # far past every timeout the gate uses
+WAIT_S = 240           # per-subprocess safety timeout
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+# ------------------------------------------------------------ plumbing
+
+def _power_cmd(suite: str, raw: str, stream: str, out_dir: str,
+               resume: bool = False, subset=None) -> list:
+    mod = "nds_tpu.nds.power" if suite == "nds" else "nds_tpu.nds_h.power"
+    cmd = [sys.executable, "-m", mod, raw, stream,
+           os.path.join(out_dir, "time.csv"), "--backend", "cpu",
+           "--input_format", "raw", "--json_summary_folder", out_dir]
+    if subset:
+        cmd += ["--query_subset", *subset]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def _env(faults: str | None = None) -> dict:
+    from nds_tpu.utils.power_core import subprocess_env
+    env = subprocess_env("cpu")
+    env["NDS_TPU_DRAIN_S"] = DRAIN_S
+    env.pop("NDS_TPU_FAULTS", None)
+    if faults:
+        env["NDS_TPU_FAULTS"] = faults
+    return env
+
+
+def _journal_path(suite: str, out_dir: str) -> str:
+    return os.path.join(out_dir, f"power-{suite}_queries.json")
+
+
+def _read_journal(suite: str, out_dir: str) -> dict | None:
+    try:
+        with open(_journal_path(suite, out_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _wait_for_start(suite: str, out_dir: str, qname: str,
+                    timeout_s: float = 120.0) -> bool:
+    """Poll the (atomic) query journal until ``qname`` has a start
+    mark and no completion — the deterministic "the child is inside
+    the hung victim query" signal the interruption scenarios key on
+    (the start is journaled immediately before dispatch, and the
+    injected hang wedges the dispatch)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        doc = _read_journal(suite, out_dir)
+        q = (doc or {}).get("queries", {}).get(qname, {})
+        if q.get("starts") and not q.get("done"):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _digests(suite: str, out_dir: str) -> dict:
+    doc = _read_journal(suite, out_dir) or {}
+    return {q: e.get("result_digest")
+            for q, e in doc.get("queries", {}).items() if e.get("done")}
+
+
+def _summaries(out_dir: str) -> list:
+    out = []
+    for f in sorted(os.listdir(out_dir)):
+        if not f.endswith(".json") or f.startswith("merged-"):
+            continue
+        try:
+            with open(os.path.join(out_dir, f)) as fh:
+                s = json.load(fh)
+        except ValueError:
+            continue
+        if isinstance(s, dict) and "query" in s and "queryStatus" in s:
+            out.append(s)
+    return out
+
+
+def _interrupt_run(suite: str, raw: str, stream: str, out_dir: str,
+                   victim: str, kind: str,
+                   subset=None) -> "int | None":
+    """Launch a power run with ``victim`` wedged by an injected hang,
+    wait (via the journal) until the child is inside it, interrupt
+    (``kind``: "term" = SIGTERM drain, "kill" = SIGKILL), and return
+    the exit code (None = scenario plumbing failed)."""
+    os.makedirs(out_dir, exist_ok=True)
+    proc = subprocess.Popen(
+        _power_cmd(suite, raw, stream, out_dir, subset=subset),
+        env=_env(f"stream.query:hang={HANG_S}@{victim}"))
+    try:
+        if not _wait_for_start(suite, out_dir, victim):
+            proc.kill()
+            proc.wait()
+            print(f"FAIL: {victim} never journaled a start before the "
+                  f"interrupt window")
+            return None
+        # the start mark lands immediately before the dispatch the
+        # hang wedges; a short beat puts the child deterministically
+        # INSIDE the victim, then interrupt
+        time.sleep(0.5)
+        proc.send_signal(signal.SIGTERM if kind == "term"
+                         else signal.SIGKILL)
+        return proc.wait(timeout=WAIT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        print(f"FAIL: interrupted ({kind}) run never exited")
+        return None
+
+
+def _check_converged(suite: str, out_dir: str, clean: dict,
+                     victims: "list[str]", scenario: str) -> int:
+    """Post-resume invariants: every statement done exactly once, only
+    the victims restarted, digests byte-identical to the clean run."""
+    doc = _read_journal(suite, out_dir)
+    if not doc:
+        return _fail(f"{scenario}: no journal after resume")
+    queries = doc.get("queries", {})
+    for q in clean:
+        e = queries.get(q)
+        if not e or not e.get("done"):
+            return _fail(f"{scenario}: {q} not journaled done after "
+                         f"resume: {e}")
+        starts = e.get("starts", [])
+        want = 2 if q in victims else 1
+        if len(starts) != want:
+            return _fail(
+                f"{scenario}: {q} executed {len(starts)}x (starts="
+                f"{starts}), expected {want} — "
+                + ("the lost query must re-run exactly once"
+                   if q in victims else
+                   "a journaled-done query must NEVER re-execute"))
+    got = _digests(suite, out_dir)
+    if got != clean:
+        return _fail(f"{scenario}: result digests diverged from the "
+                     f"clean run:\n  clean={clean}\n  got={got}")
+    return 0
+
+
+# ------------------------------------------------------------ scenarios
+
+def run_smoke(workdir: str) -> int:
+    from nds_tpu.nds import gen_data, streams
+    raw = os.path.join(workdir, "raw")
+    sdir = os.path.join(workdir, "streams")
+    gen_data.generate_data_local(SCALE, 2, raw, workers=2)
+    streams.generate_query_streams(sdir, 1, templates=TEMPLATES)
+    stream = os.path.join(sdir, "query_0.sql")
+    order = list(streams.parse_query_stream(stream))
+    if len(order) < 3:
+        return _fail(f"stream too short: {order}")
+
+    # -- clean reference run: the digests every scenario must converge
+    # to, and the proof the journal records one done per statement
+    clean_dir = os.path.join(workdir, "clean")
+    os.makedirs(clean_dir, exist_ok=True)
+    rc = subprocess.run(
+        _power_cmd("nds", raw, stream, clean_dir), env=_env()
+    ).returncode
+    if rc != 0:
+        return _fail(f"clean run exited {rc}")
+    clean = _digests("nds", clean_dir)
+    if sorted(clean) != sorted(order) or not all(clean.values()):
+        return _fail(f"clean run journaled {clean}, expected digests "
+                     f"for {order}")
+
+    # -- scenario 1: SIGTERM drain mid-query -> exit 75 -> --resume
+    tdir = os.path.join(workdir, "term")
+    victim = order[1]
+    rc = _interrupt_run("nds", raw, stream, tdir, victim=victim,
+                        kind="term")
+    if rc is None:
+        return 1
+    from nds_tpu.resilience.drain import EXIT_RESUMABLE
+    if rc != EXIT_RESUMABLE:
+        return _fail(f"drained run should exit {EXIT_RESUMABLE} "
+                     f"(resumable), got {rc}")
+    doc = _read_journal("nds", tdir) or {}
+    ventry = doc.get("queries", {}).get(victim, {})
+    if ventry.get("done") or not ventry.get("aborted"):
+        return _fail(f"drain deadline should journal {victim} as "
+                     f"explicitly not-done: {ventry}")
+    if not doc.get("queries", {}).get(order[0], {}).get("done"):
+        return _fail(f"{order[0]} lost by the drain: {doc}")
+    rc = subprocess.run(
+        _power_cmd("nds", raw, stream, tdir, resume=True), env=_env()
+    ).returncode
+    if rc != 0:
+        return _fail(f"resume after drain exited {rc}")
+    if _check_converged("nds", tdir, clean, [victim], "sigterm-drain"):
+        return 1
+    # merged phase report: every statement billed once, all Completed
+    mpath = os.path.join(tdir, "merged-power-nds.json")
+    if not os.path.exists(mpath):
+        return _fail("resumed run left no merged-power-nds.json")
+    with open(mpath) as f:
+        merged = json.load(f)
+    if sorted(merged.get("queries", [])) != sorted(order) \
+            or set(merged.get("queryStatus", [])) != {"Completed"} \
+            or merged.get("incarnations") != 2:
+        return _fail(f"merged phase report wrong: {merged}")
+    # analysis-side billing: exactly one row per statement (plus the
+    # per-incarnation load_warehouse reports, which are not statements)
+    from nds_tpu.obs import analyze
+    rows = [r["query"] for r in analyze.analyze_run(
+        tdir, with_trace=False)["queries"]
+        if r["query"] in set(order)]
+    if sorted(rows) != sorted(order):
+        return _fail(f"ndsreport would double-bill the merged run: "
+                     f"{rows}")
+    # the stale-state path never fired, and the metric was regenerated
+    for s in _summaries(tdir):
+        if s.get("degradations"):
+            return _fail(f"no degradation should fire in a clean "
+                         f"drain+resume: {s['query']}: "
+                         f"{s['degradations']}")
+    from nds_tpu.utils.timelog import TimeLog
+    rows_t = {q: ms for _a, q, ms in TimeLog.read(
+        os.path.join(tdir, "time.csv"))}
+    if rows_t.get("Power Test Time", 0) <= 0:
+        return _fail(f"resumed run must regenerate the phase metric: "
+                     f"{rows_t}")
+    print("OK: soak sigterm-drain (exit 75, in-flight query journaled "
+          "not-done, resume converged byte-identical, billed once)")
+
+    # -- scenario 2: kill -9 mid-query -> --resume loses only that one
+    kdir = os.path.join(workdir, "kill")
+    victim = order[2]
+    rc = _interrupt_run("nds", raw, stream, kdir, victim=victim,
+                        kind="kill")
+    if rc is None:
+        return 1
+    if rc != -signal.SIGKILL:
+        return _fail(f"SIGKILL run should die by signal 9, got {rc}")
+    doc = _read_journal("nds", kdir) or {}
+    if doc.get("queries", {}).get(victim, {}).get("done"):
+        return _fail(f"{victim} cannot be journaled done after "
+                     f"kill -9 mid-query")
+    rc = subprocess.run(
+        _power_cmd("nds", raw, stream, kdir, resume=True), env=_env()
+    ).returncode
+    if rc != 0:
+        return _fail(f"resume after kill -9 exited {rc}")
+    if _check_converged("nds", kdir, clean, [victim], "kill9"):
+        return 1
+    print("OK: soak kill-9 (mid-query hard kill lost ONLY the "
+          "in-flight query, resume converged byte-identical)")
+    return 0
+
+
+def run_oom_round(workdir: str) -> int:
+    """--full round: injected OOM *and* an interruption in one run —
+    the retry/ladder machinery and the resume journal must compose.
+    query7 eats a transient device OOM (retried to completion), the
+    run is then hard-killed inside a hung query93, and the resume must
+    converge with the OOM recovery journaled, not repeated."""
+    from nds_tpu.nds import streams
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "query_0.sql")
+    order = list(streams.parse_query_stream(stream))
+    clean = _digests("nds", os.path.join(workdir, "clean"))
+    odir = os.path.join(workdir, "oom")
+    os.makedirs(odir, exist_ok=True)
+    proc = subprocess.Popen(
+        _power_cmd("nds", raw, stream, odir),
+        env=_env(f"device.execute:oom@query7,"
+                 f"stream.query:hang={HANG_S}@{order[-1]}"))
+    try:
+        if not _wait_for_start("nds", odir, order[-1]):
+            proc.kill()
+            proc.wait()
+            return _fail("oom round: interrupt window never opened")
+        time.sleep(0.5)
+        proc.kill()
+        rc = proc.wait(timeout=WAIT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return _fail("oom round: interrupted run never exited")
+    if rc != -signal.SIGKILL:
+        return _fail(f"oom round: expected SIGKILL death, got {rc}")
+    rc = subprocess.run(
+        _power_cmd("nds", raw, stream, odir, resume=True),
+        env=_env()).returncode
+    if rc != 0:
+        return _fail(f"oom round: resume exited {rc}")
+    if _check_converged("nds", odir, clean, [order[-1]], "oom-round"):
+        return 1
+    # the OOM recovery happened ONCE, in the first incarnation, and
+    # the resume replayed it instead of re-paying the retry
+    q7 = (_read_journal("nds", odir) or {}).get("queries", {}).get(
+        "query7", {})
+    if q7.get("incarnation") != 0 or q7.get("status") != "Completed":
+        return _fail(f"oom round: query7's recovered completion should "
+                     f"be journaled from incarnation 0: {q7}")
+    print("OK: soak oom round (injected OOM retried once, kill -9 "
+          "survived, resume replayed the recovery)")
+    return 0
+
+
+def run_torn_journal(workdir: str) -> int:
+    """--full round: byte-flip the journal between incarnations. The
+    resume must degrade to a warned fresh start (journal_resets_total,
+    ``degradations`` in the summaries) and still converge."""
+    from nds_tpu.nds import streams
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "query_0.sql")
+    order = list(streams.parse_query_stream(stream))
+    clean = _digests("nds", os.path.join(workdir, "clean"))
+    tdir = os.path.join(workdir, "torn")
+    rc = _interrupt_run("nds", raw, stream, tdir, victim=order[1],
+                        kind="kill")
+    if rc is None:
+        return 1
+    jpath = _journal_path("nds", tdir)
+    with open(jpath, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    rc = subprocess.run(
+        _power_cmd("nds", raw, stream, tdir, resume=True), env=_env()
+    ).returncode
+    if rc != 0:
+        return _fail(f"resume over a torn journal exited {rc}")
+    got = _digests("nds", tdir)
+    if got != clean:
+        return _fail(f"torn-journal resume diverged: {got} != {clean}")
+    degraded = [s for s in _summaries(tdir)
+                if (s.get("degradations") or {}).get("journal_resets")]
+    if not degraded:
+        return _fail("torn-journal fresh start must surface in the "
+                     "summaries' degradations block")
+    print("OK: soak torn-journal (resume degraded to a counted, "
+          "surfaced fresh start and still converged)")
+    return 0
+
+
+def run_ndsh_drain(workdir: str) -> int:
+    """--full round: the NDS-H suite drains + resumes too."""
+    from nds_tpu.nds_h import gen_data as h_gen
+    from nds_tpu.nds_h import streams as h_streams
+    raw = os.path.join(workdir, "raw_h")
+    sdir = os.path.join(workdir, "streams_h")
+    h_gen.generate_data_local(SCALE, 2, raw)
+    h_streams.generate_query_streams(sdir, 1, qualification=False)
+    stream = os.path.join(sdir, "stream_0.sql")
+    subset = ["query6", "query1", "query12"]
+    order = [q for q in h_streams.parse_query_stream(stream)
+             if q in subset]
+    cdir = os.path.join(workdir, "h_clean")
+    os.makedirs(cdir, exist_ok=True)
+    rc = subprocess.run(
+        _power_cmd("nds_h", raw, stream, cdir, subset=subset),
+        env=_env()).returncode
+    if rc != 0:
+        return _fail(f"NDS-H clean run exited {rc}")
+    clean = _digests("nds_h", cdir)
+    tdir = os.path.join(workdir, "h_term")
+    rc = _interrupt_run("nds_h", raw, stream, tdir, victim=order[1],
+                        kind="term", subset=subset)
+    if rc is None:
+        return 1
+    from nds_tpu.resilience.drain import EXIT_RESUMABLE
+    if rc != EXIT_RESUMABLE:
+        return _fail(f"NDS-H drain should exit {EXIT_RESUMABLE}, "
+                     f"got {rc}")
+    rc = subprocess.run(
+        _power_cmd("nds_h", raw, stream, tdir, resume=True,
+                   subset=subset), env=_env()).returncode
+    if rc != 0:
+        return _fail(f"NDS-H resume exited {rc}")
+    if _check_converged("nds_h", tdir, clean, [order[1]],
+                        "nds_h-drain"):
+        return 1
+    print("OK: soak nds_h-drain (both suites drain + resume)")
+    return 0
+
+
+def run_full(workdir: str, rounds: int, seed: int) -> int:
+    import random
+    from nds_tpu.nds import streams
+    rng = random.Random(seed)
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "query_0.sql")
+    order = list(streams.parse_query_stream(stream))
+    clean = _digests("nds", os.path.join(workdir, "clean"))
+    rc = 0
+    for i in range(rounds):
+        kind = rng.choice(["term", "kill"])
+        vi = rng.randrange(1, len(order))
+        victim = order[vi]
+        rdir = os.path.join(workdir, f"round{i}")
+        code = _interrupt_run("nds", raw, stream, rdir, victim=victim,
+                              kind=kind)
+        if code is None:
+            return 1
+        code = subprocess.run(
+            _power_cmd("nds", raw, stream, rdir, resume=True),
+            env=_env()).returncode
+        if code != 0:
+            return _fail(f"round {i} ({kind}@{victim}) resume exited "
+                         f"{code}")
+        rc |= _check_converged("nds", rdir, clean, [victim],
+                               f"round{i}:{kind}@{victim}")
+        if not rc:
+            print(f"OK: soak round {i} ({kind}@{victim}) converged")
+    rc |= run_oom_round(workdir)
+    rc |= run_torn_journal(workdir)
+    rc |= run_ndsh_drain(workdir)
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="chaos soak gate: interrupt, resume, prove "
+                    "nothing lost and nothing ran twice")
+    p.add_argument("--full", type=int, default=0, metavar="N",
+                   help="N extra seeded randomized interruption rounds "
+                        "plus torn-journal and NDS-H scenarios "
+                        "(tier-1 runs only the 2-interruption smoke)")
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args(argv)
+    with tempfile.TemporaryDirectory(prefix="nds_soak_") as workdir:
+        rc = run_smoke(workdir)
+        if not rc and args.full:
+            rc = run_full(workdir, args.full, args.seed)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

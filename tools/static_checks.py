@@ -57,6 +57,16 @@ the one to run locally before pushing:
                         BenchReport carries a nonzero profile block
                         (tools/fleet_check.py; obs/fleet.py +
                         obs/profile.py)
+  9. soak               chaos soak smoke (tools/soak_check.py): a
+                        real NDS power-run subprocess is SIGTERM'd
+                        mid-query (drain deadline -> journaled
+                        not-done -> exit 75) and kill -9'd mid-query,
+                        each then resumed with --resume; the gate
+                        asserts every statement completed exactly
+                        once, result digests are byte-identical to an
+                        uninterrupted run, the merged phase report +
+                        ndsreport bill merged incarnations once, and
+                        the torn-state path never fired
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -79,6 +89,7 @@ import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
+import soak_check  # noqa: E402
 
 
 def run_trace_schema_check() -> int:
@@ -145,6 +156,7 @@ def main() -> int:
         ("ndsreport", run_ndsreport_check),
         ("ndsperf", lambda: ndsperf.main(["--smoke"])),
         ("fleet", fleet_check.main),
+        ("soak", lambda: soak_check.main([])),
     ]
     failed = []
     for name, fn in sections:
