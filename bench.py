@@ -540,7 +540,14 @@ def _run_leg(leg: str) -> None:
         print(_combined_line(), flush=True)
 
 
-def main() -> None:
+# exit codes for non-fresh metrics (ROADMAP item 2: a banked number
+# must be a LOUD failure, not a silently emitted line — BENCH_r04/r05
+# shipped stale metrics with exit 0 and nobody noticed for two rounds)
+EXIT_STALE_METRIC = 4        # emitted, but from banked device times
+EXIT_NO_METRIC = 5           # device unreachable and no bank either
+
+
+def main() -> int:
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
@@ -565,13 +572,20 @@ def main() -> None:
               "banked metric from the last completed real-chip run",
               file=sys.stderr, flush=True)
         if _emit_stale_from_banks():
-            return
+            # the stale line still prints (a labeled partial beats
+            # silence for a human reader) but the RUN FAILS: CI and
+            # the round record must never book a banked number as a
+            # fresh measurement
+            print(f"[bench] exit {EXIT_STALE_METRIC}: stale/banked "
+                  f"device times are not a fresh metric",
+                  file=sys.stderr, flush=True)
+            return EXIT_STALE_METRIC
         print("[bench] no banked real-chip run available either — "
               "no honest metric to emit", file=sys.stderr, flush=True)
         line = _combined_dict()
         line["device_unreachable"] = True
         print(json.dumps(line), flush=True)
-        return
+        return EXIT_NO_METRIC
 
     from nds_tpu.utils.xla_cache import enable as enable_xla_cache
     cache_dir = enable_xla_cache()
@@ -585,6 +599,7 @@ def main() -> None:
         _run_leg(leg)
 
     _emit_final()
+    return 0
 
 
 if __name__ == "__main__":

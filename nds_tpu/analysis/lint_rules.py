@@ -828,13 +828,80 @@ class UnchainedSignalHandlerRule(Rule):
         return out
 
 
+class BlockingInAsyncRule(Rule):
+    """NDS115: blocking calls inside a coroutine of the serving layer
+    (``nds_tpu/serve/``). The asyncio front shares ONE event loop
+    across every connection: a ``time.sleep``, a synchronous ``open``,
+    a ``subprocess``/``socket``/``requests`` call, or a concurrent
+    ``Future.result()`` inside an ``async def`` stalls every in-flight
+    request at once. Engine work belongs on the engine thread; a
+    coroutine may only enqueue and ``await`` (``asyncio.wrap_future``
+    is the blessed bridge)."""
+
+    id = "NDS115"
+    name = "blocking-in-async"
+    paths = ("nds_tpu/serve/",)
+    _MODULE_CALLS = {"subprocess": {"run", "call", "check_output",
+                                    "check_call", "Popen"},
+                     "socket": {"socket", "create_connection"},
+                     "requests": {"get", "post", "put", "delete",
+                                  "request"},
+                     "time": {"sleep"}}
+
+    def _violation_for(self, n: ast.Call) -> "str | None":
+        f = n.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            return "synchronous open() blocks the event loop"
+        if isinstance(f, ast.Attribute):
+            if (isinstance(f.value, ast.Name)
+                    and f.attr in self._MODULE_CALLS.get(
+                        f.value.id.lstrip("_"), ())):
+                return (f"{f.value.id}.{f.attr}() blocks the event "
+                        f"loop")
+            if f.attr == "result":
+                return ("Future.result() blocks the event loop — "
+                        "await asyncio.wrap_future(fut) instead")
+        return None
+
+    @staticmethod
+    def _body_nodes(fn: ast.AST):
+        """The coroutine's own statements: nested defs run wherever
+        they're CALLED, not on the loop, so their bodies are pruned
+        (nested ASYNC defs get their own check via _walk_funcs)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _walk_funcs(tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for n in self._body_nodes(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                why = self._violation_for(n)
+                if why:
+                    out.append(LintViolation(
+                        self.id, path, n.lineno,
+                        f"{why} (in coroutine {fn.name!r}): hand the "
+                        f"work to the engine thread, or waive with "
+                        f"why blocking here is safe"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
             MutableDefaultRule(), BareExceptRule(), NakedRetryRule(),
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
             UncachedCompileRule(), Int64EmulationHazardRule(),
-            DirectProfilerRule(), UnchainedSignalHandlerRule()]
+            DirectProfilerRule(), UnchainedSignalHandlerRule(),
+            BlockingInAsyncRule()]
 
 
 # -------------------------------------------------------------- driver

@@ -215,6 +215,32 @@ class _Verifier:
                     self.fail("scalarref-range", node,
                               f"scalar#{x.plan_id} out of range "
                               f"({nsub} subplan(s))")
+            elif isinstance(x, (ir.ParamRef, ir.DictParamIR,
+                                ir.InListParamIR)):
+                # hoisted literals (sql/params.py): the slot must bind
+                # against the plan's value list, and dict/inlist
+                # predicates must stay boolean
+                vals = getattr(self.planned, "param_values", None)
+                idx = getattr(x, "index", 0)
+                if vals is None or not (0 <= idx < len(vals)):
+                    self.fail("paramref-range", node,
+                              f"{x!r} has no value slot "
+                              f"({0 if vals is None else len(vals)} "
+                              f"value(s) attached)")
+                if isinstance(x, ir.ParamRef):
+                    if x.dtype is None:
+                        self.fail("expr-untyped", node,
+                                  f"{x!r} has no dtype")
+                elif not isinstance(x.dtype, BoolType):
+                    self.fail("predicate-dtype", node,
+                              f"{type(x).__name__} typed {x.dtype}, "
+                              f"not bool")
+                if (isinstance(x, ir.InListParamIR) and vals is not None
+                        and 0 <= idx < len(vals)
+                        and len(vals[idx]) != x.width):
+                    self.fail("paramref-width", node,
+                              f"{x!r} declares width {x.width} but the "
+                              f"slot holds {len(vals[idx])} value(s)")
             elif isinstance(x, ir.Arith):
                 lt, rt = x.left.dtype, x.right.dtype
                 if lt is None or rt is None:

@@ -240,10 +240,18 @@ class ChunkedExecutor(dx.DeviceExecutor):
     MIN_CHUNK_ROWS = 1 << 12
 
     def execute_async(self, planned: P.PlannedQuery, key: object = None):
-        key = key if key is not None else id(planned)
+        from nds_tpu.sql import params as sqlparams
+        if sqlparams.has_params(planned) and self._streamed_scans(planned):
+            # the out-of-core phase machinery evaluates literals as
+            # trace constants (keep masks, chunk-scan fingerprints):
+            # streamed parameterized plans run their inlined form
+            planned = sqlparams.inline(planned)
         scans = self._streamed_scans(planned)
         if not scans:
+            # unstreamed: the base device path runs (natively
+            # parameterized when the plan carries params)
             return super().execute_async(planned, key)
+        key = key if key is not None else id(planned)
         # a failed streamed query must never inherit the previous
         # query's span OR timings (same reset contract as the base
         # executor; last_timings rebinds only after phase A succeeds)

@@ -132,6 +132,11 @@ class CpuExecutor:
 
     def execute(self, planned: P.PlannedQuery):
         from nds_tpu.resilience import faults, watchdog
+        # parameterized plans (sql/params.py) substitute their literals
+        # back: the oracle evaluates constants, and stays byte-exact
+        # with the pre-parameterization plan by construction
+        from nds_tpu.sql import params as sqlparams
+        planned = sqlparams.inline(planned)
         # chaos site shared with the device executors: CPU-backend runs
         # exercise the retry/fallback machinery without a chip
         faults.fault_point("device.execute", executor="CpuExecutor")

@@ -440,6 +440,17 @@ class DeviceExecutor:
             return planned
         from nds_tpu.engine import staging
         plans = self._stage_plans.get(key)
+        if (plans is not None and plans[0] is not planned
+                and not plans[1] and isinstance(key, tuple)
+                and len(key) == 2 and key[0] == "param"):
+            # literal-variant re-dispatch of a shared parameterized
+            # program: the digest IS the key (identical canonical
+            # plan), the split produced no temps — rebind the split to
+            # THIS variant's plan object, keeping the compiled entry
+            # (eviction below is for id()-recycling, which a digest key
+            # cannot suffer)
+            plans = (planned, [], planned)
+            self._stage_plans[key] = plans
         if plans is not None and plans[2] is planned:
             # overflow-retry re-dispatch of the staged MAIN plan
             # (_finish retries with `planned`, which for a staged query
@@ -591,7 +602,8 @@ class DeviceExecutor:
         # shows liveness to the hang watchdog at every dispatch
         watchdog.beat("engine", phase="device.execute",
                       executor=type(self).__name__)
-        key = key if key is not None else id(planned)
+        planned = self._plan_for_dispatch(planned)
+        key = key if key is not None else self._plan_key(planned)
         orig = planned
         tracer = get_tracer()
         # a failed query must never inherit the previous query's span
@@ -618,6 +630,62 @@ class DeviceExecutor:
                 qspan.set(error=f"{type(exc).__name__}: {exc}").end()
             raise
 
+    def _plan_for_dispatch(self, planned):
+        """Pre-dispatch plan normalization hook. Base rule:
+        parameterized plans heavy enough to SPLIT (engine/staging.py)
+        fall back to their inlined-literal form — staged temps
+        re-encode dictionaries and carry value-dependent content, so a
+        shared parameterized program cannot span a staging cut (and
+        the temp tables' content digests would defeat the shared
+        fingerprint anyway). The sharded executor overrides to always
+        inline."""
+        from nds_tpu.sql import params as sqlparams
+        if not sqlparams.has_params(planned) or not self.STAGE_WEIGHT:
+            return planned
+        from nds_tpu.engine import staging
+        if staging.plan_weight(planned) > self.STAGE_WEIGHT:
+            return sqlparams.inline(planned)
+        return planned
+
+    # entry bound for the per-query compile cache: power runs hold at
+    # most 125 statements, but a serving workload cycles an unbounded
+    # population of plan objects through id-keyed entries — without a
+    # bound the pinned plans + compiled programs grow for the process
+    # lifetime (compactor entries and in-flight staged sub-keys are
+    # exempt from eviction)
+    MAX_COMPILED = 256
+
+    def _plan_key(self, planned):
+        """Compile-cache key: plan identity for ordinary plans; for
+        PARAMETERIZED plans the shared canonical-digest key
+        (sql/params.plan_key — the same key the server batches on), so
+        every literal variant of one template lands on ONE in-process
+        compiled entry."""
+        from nds_tpu.sql import params as sqlparams
+        return sqlparams.plan_key(planned) or id(planned)
+
+    def _bound_compiled(self, active_key) -> None:
+        """FIFO-evict query-level entries past MAX_COMPILED (and their
+        staged state, via _evict_query_state). Never evicts the entry
+        being dispatched, compactor programs, or staged sub-entries
+        (those die with their base key)."""
+        def evictable(k) -> bool:
+            if k == active_key:
+                return False
+            if isinstance(k, tuple) and k and k[0] == "__compact__":
+                return False
+            if isinstance(k, tuple) and len(k) == 3 \
+                    and k[1] == "__stage__":
+                return False
+            return True
+
+        while len(self._compiled) > self.MAX_COMPILED:
+            victim = next((k for k in self._compiled if evictable(k)),
+                          None)
+            if victim is None:
+                return
+            self._evict_query_state(victim)
+
     def _dispatch_traced(self, planned, orig, key, tracer, qspan):
         import time as _time
         with tracer.attach(qspan):
@@ -639,9 +707,11 @@ class DeviceExecutor:
             # compiled
             entry = self._compiled.setdefault(
                 key, {"slack": self.DEFAULT_SLACK, "ref": (orig, planned)})
+            self._bound_compiled(key)
             if "compiled" not in entry:
                 self._compile_or_load(planned, entry, timings, tracer)
             bufs = self._collect_buffers(planned)
+            pvals = self._collect_params(planned)
             # bytes the query reads from HBM-resident scan buffers: the
             # roofline denominator (achieved GB/s lands in scan_gbps at
             # _finish) so wins/losses are judged against memory
@@ -662,7 +732,9 @@ class DeviceExecutor:
             memwatch.sample_device()
             # ndslint: waive[NDS102] -- execute bracket opens here; _finish_traced closes it after device_get
             t1 = _time.perf_counter()
-            row, outs, overflow = entry["compiled"](bufs)
+            row, outs, overflow = (entry["compiled"](bufs, pvals)
+                                   if pvals is not None
+                                   else entry["compiled"](bufs))
         return _AsyncResult(self, planned, key, entry, timings, t1,
                             (row, outs, overflow), qspan)
 
@@ -709,9 +781,11 @@ class DeviceExecutor:
         if fp:
             with tracer.span("cache.load", fp=fp[:12]):
                 bufs = self._collect_buffers(planned)
-                hit = cache_aot.load_cached(pc, fp,
-                                            type(self).__name__,
-                                            timings, args=(bufs,))
+                pvals = self._collect_params(planned)
+                hit = cache_aot.load_cached(
+                    pc, fp, type(self).__name__, timings,
+                    args=((bufs, pvals) if pvals is not None
+                          else (bufs,)))
             if hit is not None:
                 entry["compiled"], extra = hit
                 entry["side"] = {"dicts": extra.get("dicts"),
@@ -726,11 +800,14 @@ class DeviceExecutor:
         with tracer.span("device.compile", slack=entry["slack"]):
             jitted, side = self._compile(planned, entry["slack"])
             bufs = self._collect_buffers(planned)
+            pvals = self._collect_params(planned)
             # AOT-compile now so compile cost is attributed
             # separately from steady-state execution (fresh when the
             # blob will persist: see lower_and_compile)
+            lower_args = ((bufs, pvals) if pvals is not None
+                          else (bufs,))
             entry["compiled"] = cache_aot.lower_and_compile(
-                jitted, bufs, fresh=cache_aot.fresh_for(pc, fp))
+                jitted, *lower_args, fresh=cache_aot.fresh_for(pc, fp))
         entry["side"] = side
         timings["compile_ms"] += (
             # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; the execute bracket closes via device_get in _finish_traced
@@ -941,18 +1018,37 @@ class DeviceExecutor:
 
     def _compile(self, planned: P.PlannedQuery,
                  slack: float = DEFAULT_SLACK):
+        from nds_tpu.sql import params as sqlparams
         side = {}
 
-        def fn(bufs):
-            tr = _Trace(self, bufs, slack)
+        def _run(bufs, params):
+            tr = _Trace(self, bufs, slack, params=params)
             row, outs, dicts = tr.run_query(planned)
             side["dicts"] = dicts
             side["kernels"] = dict(tr.kernels)
             side["ops_est"] = int(tr.ops_est)
             return row, outs, tr.total_overflow()
 
+        if sqlparams.has_params(planned):
+            # hoisted literals ride as a second runtime-input pytree:
+            # one compiled program serves every literal variant
+            def fn(bufs, params):
+                return _run(bufs, params)
+        else:
+            def fn(bufs):
+                return _run(bufs, None)
+
         # ndslint: waive[NDS111] -- builds the traced callable only; AOT lower+compile routes through cache.aot (_compile_or_load)
         return jax.jit(fn), side
+
+    def _collect_params(self, planned: P.PlannedQuery):
+        """Device inputs for a parameterized plan's hoisted literals
+        (sql/params.bind_params), or None for ordinary plans."""
+        from nds_tpu.sql import params as sqlparams
+        if not sqlparams.has_params(planned):
+            return None
+        return {k: jnp.asarray(v) for k, v in
+                sqlparams.bind_params(planned, self.tables).items()}
 
     # -------------------------------------------------------------- buffers
 
@@ -1194,10 +1290,13 @@ class _Trace:
     predicate tables, key bounds) becomes XLA constants."""
 
     def __init__(self, ex: DeviceExecutor, bufs: dict,
-                 slack: float = 2.0):
+                 slack: float = 2.0, params: "dict | None" = None):
         self.ex = ex
         self.bufs = bufs
         self.slack = slack
+        # hoisted-literal runtime inputs (sql/params.py): slot -> traced
+        # array; empty for ordinary plans
+        self.params = params or {}
         # float compute dtype (engine.precision); distributed executors
         # without the attribute inherit the exact-f64 default
         self.fdt = getattr(ex, "float_dtype", None) or jnp.float64
@@ -2467,6 +2566,12 @@ class _Trace:
             v, ok, sdict, _dt = self.scalars[e.plan_id]
             return DVal(jnp.broadcast_to(v, (ctx.n,)),
                         jnp.broadcast_to(ok, (ctx.n,)), sdict)
+        if isinstance(e, ir.ParamRef):
+            return self._eval_param(e, ctx)
+        if isinstance(e, ir.DictParamIR):
+            return self._eval_dict_param(e, ctx)
+        if isinstance(e, ir.InListParamIR):
+            return self._eval_inlist_param(e, ctx)
         if isinstance(e, ir.Arith):
             return self._eval_arith(e, ctx)
         if isinstance(e, ir.Cmp):
@@ -2528,6 +2633,45 @@ class _Trace:
         if isinstance(e, ir.CastIR):
             return self._eval_cast(e, ctx)
         raise DeviceExecError(f"cannot eval {e!r}")
+
+    def _eval_param(self, e: ir.ParamRef, ctx: DCtx) -> DVal:
+        """A hoisted scalar literal: broadcast of the runtime input. No
+        value bounds (unlike an inlined Lit) — consumers needing bounds
+        fall back to their general paths, identically for every
+        variant."""
+        v = self.params[f"p{e.index}"]
+        if isinstance(e.dtype, FloatType):
+            v = v.astype(self.fdt)
+        return DVal(jnp.broadcast_to(v, (ctx.n,)), None)
+
+    def _eval_dict_param(self, e: ir.DictParamIR, ctx: DCtx) -> DVal:
+        """A hoisted string predicate: boolean membership table over
+        the operand's dictionary, bound per request on the host
+        (sql/params.bind_params replicates the dictionary transform
+        chain, so table length must match the traced dictionary)."""
+        dv = self.eval(e.operand, ctx)
+        if dv.sdict is None:
+            raise DeviceExecError("dict-param predicate over "
+                                  "non-string operand")
+        tab = self.params[f"d{e.index}"]
+        if tab.shape[0] != len(dv.sdict):
+            raise DeviceExecError(
+                f"dict-param table length {tab.shape[0]} != traced "
+                f"dictionary length {len(dv.sdict)} for "
+                f"{e.table}.{e.column}")
+        if e.negated:
+            tab = ~tab
+        return DVal(jnp.take(tab, dv.arr), dv.valid)
+
+    def _eval_inlist_param(self, e: ir.InListParamIR, ctx: DCtx) -> DVal:
+        """A hoisted numeric IN-list: fixed-width vector input, any-of
+        equality (the same compare chain the inlined path unrolls)."""
+        dv = self.eval(e.operand, ctx)
+        vals = self.params[f"v{e.index}"]
+        m = jnp.zeros(ctx.n, dtype=bool)
+        for i in range(e.width):
+            m = m | (dv.arr == vals[i])
+        return DVal(~m if e.negated else m, dv.valid)
 
     def _eval_lit(self, e: ir.Lit, ctx: DCtx) -> DVal:
         if isinstance(e.dtype, StringType):

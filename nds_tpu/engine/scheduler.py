@@ -623,6 +623,20 @@ class ExecutionPipeline:
                                 ChunkedExecutor.MIN_CHUNK_ROWS)
         self._gov_shrink = False
 
+    def admission_projection(self, planned) -> tuple:
+        """(projected_bytes, budget_bytes) from the MemoryGovernor's
+        pre-dispatch model — what the serving layer's admission control
+        reads (nds_tpu/serve/server.py): live bytes now + the plan
+        verifier's size estimate x expansion, against
+        ``engine.placement.device_budget_bytes``. (0, 0) when no
+        governor is armed (CPU universe, multi-rank worlds,
+        ``engine.placement.governor=off``)."""
+        if self.governor is None or self._multi:
+            return 0, 0
+        from nds_tpu.analysis import plan_verify
+        est = plan_verify.estimate_plan(planned, tables=self._tables)
+        return self.governor.project(est), self.governor.budget
+
     def choose_placement(self, planned, qname: "str | None" = None,
                          catalog=None) -> tuple:
         """Cost-model choice WITHOUT executing (tools/ndsverify.py and

@@ -23,10 +23,21 @@ TPCH_SIZES = {
 
 
 class Session:
-    def __init__(self, catalog: CatalogInfo, executor_factory=None):
+    # bound on the (SQL text, views) -> plan cache: a serving workload
+    # submits an unbounded population of literal-variant texts, and an
+    # unbounded dict would leak plans for the process lifetime
+    PLAN_CACHE_MAX = 512
+
+    def __init__(self, catalog: CatalogInfo, executor_factory=None,
+                 parameterize: "bool | None" = None):
         self.catalog = catalog
         self.tables: dict[str, HostTable] = {}
         self.views: dict[str, P.Node] = {}
+        # literal hoisting (sql/params.py): default from
+        # NDS_TPU_PARAM_PLANS; the serving layer turns it on explicitly
+        from nds_tpu.sql import params as sqlparams
+        self.parameterize = (sqlparams.enabled_by_env()
+                             if parameterize is None else parameterize)
         self._executor_factory = executor_factory or (
             # ndslint: waive[NDS110] -- bare sessions default to the CPU oracle directly; the pipeline only schedules engine-backed placements (make_session routes every backend through it)
             lambda tables: CpuExecutor(tables))
@@ -44,15 +55,17 @@ class Session:
         self._view_sql: dict[str, str] = {}
 
     @classmethod
-    def for_nds_h(cls, executor_factory=None) -> "Session":
+    def for_nds_h(cls, executor_factory=None,
+                  parameterize: "bool | None" = None) -> "Session":
         from nds_tpu.nds_h.schema import PRIMARY_KEYS, get_schemas
         cat = CatalogInfo(get_schemas(), PRIMARY_KEYS, dict(TPCH_SIZES))
-        return cls(cat, executor_factory)
+        return cls(cat, executor_factory, parameterize=parameterize)
 
     @classmethod
     def for_nds(cls, executor_factory=None,
                 use_decimal: bool = True,
-                include_maintenance: bool = False) -> "Session":
+                include_maintenance: bool = False,
+                parameterize: "bool | None" = None) -> "Session":
         from nds_tpu.nds.schema import (
             PRIMARY_KEYS, SIZES, get_maintenance_schemas, get_schemas,
         )
@@ -70,7 +83,7 @@ class Session:
             sizes.update({t: 100.0 for t in
                           get_maintenance_schemas(use_decimal)})
         cat = CatalogInfo(schemas, keys, sizes)
-        return cls(cat, executor_factory)
+        return cls(cat, executor_factory, parameterize=parameterize)
 
     def register_table(self, table: HostTable) -> None:
         self.tables[table.name] = table
@@ -86,7 +99,8 @@ class Session:
         return self.plan_ast(stmt)
 
     def plan_ast(self, stmt):
-        planner = Planner(self.catalog, self.views)
+        planner = Planner(self.catalog, self.views,
+                          parameterize=self.parameterize)
         planned = planner.plan_statement(stmt)
         from nds_tpu.analysis import plan_verify
         if plan_verify.verify_enabled():
@@ -135,6 +149,12 @@ class Session:
         if planned is None:
             planned = self.plan(sql_text)
             self._plan_cache[key] = planned
+            while len(self._plan_cache) > self.PLAN_CACHE_MAX:
+                # FIFO bound: a serving workload's literal-variant texts
+                # must not grow the plan cache for the process lifetime
+                # (the shared COMPILED program lives in the executor's
+                # digest-keyed cache, not here)
+                self._plan_cache.pop(next(iter(self._plan_cache)))
         else:
             from nds_tpu.resilience import faults
             faults.fault_point("plan")

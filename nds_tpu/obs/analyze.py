@@ -165,6 +165,18 @@ def attribute_query(summary: dict) -> dict:
     return row
 
 
+def _quantiles(samples: list) -> dict:
+    """Nearest-rank p50/p95/p99 over a sample list ({} when empty) —
+    the serving layer's per-tenant latency summary."""
+    s = sorted(samples)
+    if not s:
+        return {}
+    n = len(s)
+    return {f"p{q}": round(
+        s[min(n - 1, max(0, (q * n + 99) // 100 - 1))], 3)
+        for q in (50, 95, 99)}
+
+
 def steady_ms(row: dict) -> float:
     """Steady-state time: wall minus compile minus retry backoff — the
     quantity the regression gate compares (compile-count changes are
@@ -430,6 +442,27 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
         "metrics": {"counters": counters, "histograms": hists},
         "trace_events": events,
     }
+    # serving runs (nds_tpu/serve/): per-tenant request latency
+    # quantiles over the per-request summaries' wall clocks
+    tenant_walls: dict = {}
+    for s in summaries:
+        t = s.get("tenant")
+        if t and s.get("queryTimes"):
+            tenant_walls.setdefault(t, []).append(
+                float(s["queryTimes"][-1]))
+    if tenant_walls:
+        out["tenants"] = {
+            t: {"requests": len(walls),
+                **{f"{q}_ms": v
+                   for q, v in _quantiles(walls).items()}}
+            for t, walls in sorted(tenant_walls.items())}
+    # banked/stale metrics must never flow silently into analysis
+    # consumers (ROADMAP item 2): surface the marker loudly; ndsreport
+    # diff refuses to gate on it
+    stale = [s.get("query") or s.get("filename", "?")
+             for s in summaries if s.get("stale_device_times")]
+    if stale:
+        out["stale_device_times"] = stale
     if merged_dropped:
         out["merged_incarnations"] = merged_dropped
     incs = [s.get("incarnation") for s in summaries
@@ -715,6 +748,15 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
     chr_base, chr_cur = cache_hit_rate(base), cache_hit_rate(cur)
     if chr_base is not None or chr_cur is not None:
         d["cache_hit_rate"] = {"base": chr_base, "cur": chr_cur}
+    # banked/stale device times are not comparable evidence: a diff
+    # over them must FAIL loudly (ROADMAP item 2 — the BENCH_r04/r05
+    # rot class), never gate-pass on numbers nobody measured this run
+    stale = {side: a["stale_device_times"]
+             for side, a in (("base", base), ("cur", cur))
+             if a.get("stale_device_times")}
+    if stale:
+        d["stale_device_times"] = stale
+        d["passed"] = False
     return d
 
 
@@ -763,6 +805,11 @@ def format_diff(d: dict) -> str:
                      f"{_rate(chr_.get('base'))} -> "
                      f"{_rate(chr_.get('cur'))}")
     lines.append(f"  {len(d['noise'])} querie(s) within noise threshold")
+    for side, names in d.get("stale_device_times", {}).items():
+        lines.append(f"  STALE       {side}: banked device times "
+                     f"({len(names)} summar"
+                     f"{'y' if len(names) == 1 else 'ies'}) — not "
+                     f"comparable evidence")
     lines.append("DIFF " + ("OK" if d["passed"] else "FAILED"))
     return "\n".join(lines)
 

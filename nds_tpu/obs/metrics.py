@@ -201,6 +201,33 @@ def delta(before: dict, after: dict) -> dict:
     return out
 
 
+def labeled(name: str, **labels) -> str:
+    """Instrument name carrying OpenMetrics-style labels:
+    ``labeled("server_requests_total", tenant="a")`` ->
+    ``server_requests_total{tenant="a"}``. The registry treats the
+    whole string as the instrument key (one instrument per label set);
+    the snapshot emitter (obs/snapshot.py) splits it back into family +
+    labels when rendering the exposition."""
+    if not labels:
+        return name
+    def esc(v) -> str:
+        # OpenMetrics escaping (\\ then \"): distinct values must stay
+        # distinct — deleting the metachars would collapse tenants
+        # like 'acme' and 'acme"' onto one instrument
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    inner = ",".join(f'{k}="{esc(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def split_labels(name: str) -> "tuple[str, str]":
+    """(base_name, label_block) — label_block is '' or '{k="v",...}'."""
+    i = name.find("{")
+    if i < 0:
+        return name, ""
+    return name[:i], name[i:]
+
+
 REGISTRY = MetricsRegistry()
 
 

@@ -59,6 +59,23 @@ def _metric_name(name: str) -> str:
     return "nds_tpu_" + _NAME_RE.sub("_", name)
 
 
+def _split(name: str) -> tuple:
+    """(sanitized family base, label block) for a possibly-labeled
+    instrument name (obs/metrics.labeled): only the BASE sanitizes —
+    the label block is emitted verbatim (values were escaped at
+    labeling time)."""
+    from nds_tpu.obs.metrics import split_labels
+    base, labels = split_labels(name)
+    return _metric_name(base), labels
+
+
+def _merge_labels(labels: str, extra: str) -> str:
+    """Join a label block with one extra ``k="v"`` pair."""
+    if not labels:
+        return "{" + extra + "}"
+    return labels[:-1] + "," + extra + "}"
+
+
 def _fmt(v) -> str:
     if isinstance(v, bool):
         return "1" if v else "0"
@@ -71,33 +88,46 @@ def to_openmetrics(snap: dict) -> str:
     """Render one registry snapshot as OpenMetrics text: counters (the
     ``_total`` suffix moves from family name to sample name), gauges,
     and histograms as summary families (count/sum + quantile samples
-    from the p50/p95/p99 window)."""
+    from the p50/p95/p99 window). Labeled instruments
+    (obs/metrics.labeled — the serving layer's per-tenant counters and
+    latency summaries) group under ONE ``# TYPE`` line per family with
+    one sample per label set."""
     lines: list[str] = []
+    typed: set = set()
+
+    def declare(fam: str, kind: str) -> None:
+        if fam not in typed:
+            typed.add(fam)
+            lines.append(f"# TYPE {fam} {kind}")
+
     for name, v in sorted(snap.get("counters", {}).items()):
-        fam = _metric_name(name)
+        fam, labels = _split(name)
         fam = fam[:-len("_total")] if fam.endswith("_total") else fam
-        lines.append(f"# TYPE {fam} counter")
-        lines.append(f"{fam}_total {_fmt(v)}")
+        declare(fam, "counter")
+        lines.append(f"{fam}_total{labels} {_fmt(v)}")
     for name, v in sorted(snap.get("gauges", {}).items()):
-        fam = _metric_name(name)
-        lines.append(f"# TYPE {fam} gauge")
-        lines.append(f"{fam} {_fmt(v)}")
+        fam, labels = _split(name)
+        declare(fam, "gauge")
+        lines.append(f"{fam}{labels} {_fmt(v)}")
     for name, h in sorted(snap.get("histograms", {}).items()):
-        fam = _metric_name(name)
-        lines.append(f"# TYPE {fam} summary")
+        fam, labels = _split(name)
+        declare(fam, "summary")
         for q in ("p50", "p95", "p99"):
             if h.get(q) is not None:
-                lines.append(
-                    f'{fam}{{quantile="0.{q[1:]}"}} {_fmt(h[q])}')
-        lines.append(f"{fam}_count {_fmt(h.get('count', 0))}")
-        lines.append(f"{fam}_sum {_fmt(h.get('sum', 0.0))}")
+                ql = _merge_labels(labels,
+                                   f'quantile="0.{q[1:]}"')
+                lines.append(f"{fam}{ql} {_fmt(h[q])}")
+        lines.append(f"{fam}_count{labels} {_fmt(h.get('count', 0))}")
+        lines.append(f"{fam}_sum{labels} {_fmt(h.get('sum', 0.0))}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
+_LABEL_VAL = r"\"(?:[^\"\\]|\\.)*\""        # escaped per OpenMetrics
 _SAMPLE_RE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"            # metric name
-    r"(\{[a-zA-Z0-9_]+=\"[^\"\\]*\"(,[a-zA-Z0-9_]+=\"[^\"\\]*\")*\})?"
+    r"(\{[a-zA-Z0-9_]+=" + _LABEL_VAL
+    + r"(,[a-zA-Z0-9_]+=" + _LABEL_VAL + r")*\})?"
     r" -?[0-9][0-9eE.+-]*$")                # value
 
 

@@ -351,6 +351,15 @@ class DistributedExecutor(dx.DeviceExecutor):
     # RAM before splitting — VERDICT r4 weak #2)
     STAGE_WEIGHT = int(os.environ.get("NDS_TPU_STAGE_DIST", "24"))
 
+    def _plan_for_dispatch(self, planned):
+        """Parameterized plans run INLINED on the sharded path (both
+        execute() and the inherited execute_async): sharded programs
+        bake literals into their traced collectives, and the
+        multi-rank story (rank-local binding would have to agree
+        across ranks) is not built yet."""
+        from nds_tpu.sql import params as sqlparams
+        return sqlparams.inline(planned)
+
     def execute(self, planned: P.PlannedQuery, key: object = None):
         """Multichip execute with the SAME timing contract as the
         single-chip executor: compile/execute/materialize wall-clock,
@@ -363,6 +372,7 @@ class DistributedExecutor(dx.DeviceExecutor):
         from nds_tpu.resilience import watchdog
         watchdog.beat("engine", phase="device.execute",
                       executor=type(self).__name__)
+        planned = self._plan_for_dispatch(planned)
         key = key if key is not None else id(planned)
         orig = planned
         tracer = get_tracer()

@@ -1,0 +1,12 @@
+"""Async query serving layer (README "Serving").
+
+Wraps the unified ExecutionPipeline + parameterized-plan machinery in a
+persistent session server: concurrent NDS + NDS-H requests against one
+shared warehouse, admission control fed by the MemoryGovernor's
+pre-dispatch projections, queue-depth/deadline brownout (shed, never
+collapse), per-tenant metrics on the snapshot/OpenMetrics emitter, and
+per-request BenchReport-compatible summaries `ndsreport analyze` can
+read. ``server.QueryServer`` is the in-process core; ``net`` adds the
+newline-delimited-JSON asyncio TCP front."""
+
+from nds_tpu.serve.server import QueryServer, Request, Response  # noqa: F401

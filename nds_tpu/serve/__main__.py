@@ -1,0 +1,121 @@
+"""Standalone server launcher: warehouse in, TCP JSON-lines out.
+
+    python -m nds_tpu.serve --port 9321 \
+        --nds_h_data /path/to/tpch_wh [--nds_data /path/to/tpcds_wh] \
+        --backend tpu --cache_dir /path/to/plancache \
+        --summary_dir /path/to/serve_json
+
+Loads each suite's warehouse into its namespace (TPC-H and TPC-DS both
+define ``customer`` — they never share a registry), starts the engine
+thread + asyncio TCP front, and serves until SIGINT/SIGTERM. Drive it
+with ``tools/ndsload.py --port ...`` (README "Serving"). ``--port 0``
+picks a free port and prints it — the form the smoke drives use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def _load_suite(server, suite: str, data_dir: str, fmt: str) -> int:
+    from nds_tpu.io import csv_io
+    if suite == "nds_h":
+        from nds_tpu.nds_h.schema import get_schemas
+    else:
+        from nds_tpu.nds.schema import get_schemas
+    schemas = get_schemas()
+    n = 0
+    for name, schema in schemas.items():
+        tdir = os.path.join(data_dir, name)
+        ext = csv_io.FORMAT_EXT.get(fmt, ".parquet")
+        if os.path.isdir(tdir):
+            paths = sorted(
+                os.path.join(root, f)
+                for root, _dirs, files in os.walk(tdir)
+                for f in files if f.endswith(ext))
+        else:
+            single = os.path.join(data_dir, f"{name}{ext}")
+            if not os.path.exists(single):
+                continue
+            paths = [single]
+        if not paths:
+            continue
+        server.register_table(
+            csv_io.read_table_fmt(paths, name, schema, fmt), suite)
+        n += 1
+    return n
+
+
+async def _serve(server, host: str, port: int) -> None:
+    import signal
+
+    from nds_tpu.serve.net import start_tcp
+    tcp = await start_tcp(server, host, port)
+    bound = tcp.sockets[0].getsockname()[1]
+    print(f"[serve] listening on {host}:{bound}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        # loop-native handlers: the default KeyboardInterrupt path can
+        # land mid-callback and skip the close/drain below
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("[serve] draining", flush=True)
+    tcp.close()
+    await tcp.wait_closed()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9321,
+                    help="0 picks a free port (printed at startup)")
+    ap.add_argument("--nds_h_data", help="NDS-H (TPC-H) warehouse dir")
+    ap.add_argument("--nds_data", help="NDS (TPC-DS) warehouse dir")
+    ap.add_argument("--input_format", default="parquet")
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--cache_dir",
+                    help="persistent AOT plan cache (cache.dir)")
+    ap.add_argument("--summary_dir",
+                    help="per-request BenchReport summaries "
+                         "(serve.summary_dir)")
+    ap.add_argument("--max_queue", type=int, default=None)
+    ap.add_argument("--deadline_ms", type=int, default=None)
+    ap.add_argument("--template", help="engine template file")
+    ap.add_argument("--property_file", help="k=v property overrides")
+    args = ap.parse_args(argv)
+    if not args.nds_h_data and not args.nds_data:
+        ap.error("at least one of --nds_h_data/--nds_data is required")
+
+    from nds_tpu.serve import QueryServer
+    from nds_tpu.utils.config import EngineConfig
+    overrides = {"engine.backend": args.backend}
+    if args.cache_dir:
+        overrides["cache.dir"] = args.cache_dir
+    if args.summary_dir:
+        overrides["serve.summary_dir"] = args.summary_dir
+    if args.max_queue is not None:
+        overrides["serve.max_queue"] = str(args.max_queue)
+    if args.deadline_ms is not None:
+        overrides["serve.deadline_ms"] = str(args.deadline_ms)
+    cfg = EngineConfig(args.template, args.property_file, overrides)
+    server = QueryServer(cfg)
+    for suite, d in (("nds_h", args.nds_h_data),
+                     ("nds", args.nds_data)):
+        if d:
+            n = _load_suite(server, suite, d, args.input_format)
+            print(f"[serve] {suite}: {n} tables from {d}", flush=True)
+    server.start()
+    try:
+        asyncio.run(_serve(server, args.host, args.port))
+    finally:
+        server.stop()
+        print(f"[serve] stopped: {server.stats}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

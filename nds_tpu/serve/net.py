@@ -1,0 +1,111 @@
+"""Newline-delimited-JSON asyncio front for the query server.
+
+Protocol (one JSON object per line, UTF-8):
+
+  request:  {"tenant": "t0", "suite": "nds_h", "sql": "select ...",
+             "qname": "query5#3"}
+  response: {"status": "ok"|"shed"|"error", "qname", "tenant",
+             "elapsed_ms", "rows", "digest", "error"?, "shed_reason"?}
+
+The coroutines here never touch the engine: ``QueryServer.submit``
+enqueues onto the engine thread and returns a concurrent Future the
+handler awaits via ``asyncio.wrap_future`` — no blocking calls inside
+the event loop (ndslint NDS115 enforces that for this package).  One
+malformed line answers with a status "error" object instead of killing
+the connection; EOF closes it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+
+from nds_tpu.serve.server import QueryServer, Response
+
+
+def _encode(resp: Response) -> bytes:
+    doc = {k: v for k, v in dataclasses.asdict(resp).items()
+           if v is not None}
+    return (json.dumps(doc) + "\n").encode()
+
+
+async def handle_connection(server: QueryServer,
+                            reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+    try:
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            try:
+                doc = json.loads(line)
+                fut = server.submit(str(doc.get("tenant", "anon")),
+                                    str(doc.get("suite", "nds_h")),
+                                    str(doc["sql"]),
+                                    str(doc.get("qname", "")))
+            except Exception as exc:  # noqa: BLE001 - bad line answers
+                writer.write(_encode(Response(
+                    "error", error=f"bad request: {exc}")))
+                await writer.drain()
+                continue
+            resp = await asyncio.wrap_future(fut)
+            writer.write(_encode(resp))
+            await writer.drain()
+    finally:
+        writer.close()
+
+
+async def start_tcp(server: QueryServer, host: str = "127.0.0.1",
+                    port: int = 0) -> asyncio.AbstractServer:
+    """Bind and return the asyncio server (``port=0`` picks a free
+    port; read it from ``srv.sockets[0].getsockname()``)."""
+
+    async def _handler(reader, writer):
+        await handle_connection(server, reader, writer)
+
+    return await asyncio.start_server(_handler, host, port)
+
+
+async def request_many(host: str, port: int, docs: list,
+                       concurrency: int = 8) -> list:
+    """Client helper (tools/ndsload.py): fire ``docs`` with up to
+    ``concurrency`` connections, one in-flight request per connection,
+    preserving per-doc response pairing. Returns response dicts in
+    ``docs`` order."""
+    out: list = [None] * len(docs)
+    idx = iter(range(len(docs)))
+
+    async def worker():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            for i in idx:
+                try:
+                    writer.write((json.dumps(docs[i]) + "\n").encode())
+                    await writer.drain()
+                    line = await reader.readline()
+                except Exception as exc:  # noqa: BLE001 - per-doc
+                    out[i] = {"status": "error",
+                              "error": f"{type(exc).__name__}: {exc}"}
+                    break
+                if not line:
+                    out[i] = {"status": "error",
+                              "error": "connection closed"}
+                    break
+                out[i] = json.loads(line)
+        finally:
+            writer.close()
+
+    # a worker dying early (connect refused, mid-stream close) must
+    # not discard its siblings' responses (return_exceptions swallows
+    # the raise; the per-doc errors were recorded where known) or
+    # leave None holes the callers' summarizers would crash on
+    await asyncio.gather(
+        *[worker() for _ in range(max(1, min(concurrency,
+                                             len(docs))))],
+        return_exceptions=True)
+    for i, r in enumerate(out):
+        if r is None:
+            out[i] = {"status": "error", "error": "no response "
+                      "(connection lost before dispatch)"}
+    return out

@@ -1,0 +1,447 @@
+"""The persistent query server core: one warehouse, many tenants.
+
+Architecture ("Scalable, Fast Cloud Computing with Execution
+Templates"): the expensive control-plane work — parse, plan,
+parameterize, compile — is cached; the per-request path is admission,
+parameter binding, and a pure tensor-program dispatch.  One ENGINE
+thread owns every session/executor touch (the engine's executors are
+single-threaded by design; jax's async dispatch already overlaps device
+work), so concurrency lives in the queue: a request is in flight from
+admission to completion, and the engine thread drains same-template
+groups back-to-back against one shared compiled program.
+
+Admission / brownout (``serve.*`` config keys, utils/config.py):
+
+- queue depth  >= ``serve.max_queue``      -> shed at submit
+- queued age   >  ``serve.deadline_ms``    -> shed at dequeue
+- governor projection > budget x ``serve.shed_factor`` -> shed at
+  dispatch (the MemoryGovernor's pre-dispatch projection, via
+  ``ExecutionPipeline.admission_projection``; inside the factor the
+  governor's own demote-don't-die machinery handles pressure)
+
+Every shed increments ``server_shed_total`` (plus the tenant-labeled
+variant) and completes the request with status "shed" — load PAST
+saturation degrades the answer rate, never the process.  Per-request
+summaries are BenchReport-compatible JSONs (``tenant`` field attached)
+written to ``serve.summary_dir``, so ``ndsreport analyze`` reports
+serving latency like any run dir; per-tenant request counters and
+latency histograms publish through the live snapshot/OpenMetrics
+emitter (obs/snapshot.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from nds_tpu.obs import metrics as obs_metrics
+
+DEFAULT_MAX_QUEUE = 64
+DEFAULT_MAX_BATCH = 8
+DEFAULT_DEADLINE_MS = 0        # 0 = no queue-age deadline
+DEFAULT_SHED_FACTOR = 1.5
+
+SHED = "shed"
+OK = "ok"
+ERROR = "error"
+
+
+@dataclass
+class Request:
+    tenant: str
+    suite: str                  # "nds" | "nds_h"
+    sql: str
+    qname: str = ""
+    enqueued: float = field(default_factory=time.monotonic)
+    future: Future = field(default_factory=Future)
+
+
+@dataclass
+class Response:
+    status: str                 # ok | shed | error
+    qname: str = ""
+    tenant: str = ""
+    elapsed_ms: float = 0.0
+    rows: int = 0
+    digest: "str | None" = None
+    error: "str | None" = None
+    shed_reason: "str | None" = None
+
+
+def _tenant_counter(name: str, tenant: str):
+    return obs_metrics.counter(
+        obs_metrics.labeled(name, tenant=tenant))
+
+
+class QueryServer:
+    """In-process server core. ``start()`` spins the engine thread;
+    ``submit()`` is thread-safe and returns a concurrent Future of
+    Response; ``stop()`` drains (queued requests shed) and joins."""
+
+    def __init__(self, config=None, summary_dir: "str | None" = None):
+        from nds_tpu.utils.config import EngineConfig
+        self.config = config or EngineConfig()
+        self.summary_dir = summary_dir or self.config.get(
+            "serve.summary_dir")
+        self.max_queue = self._cfg_int("serve.max_queue",
+                                       DEFAULT_MAX_QUEUE)
+        self.max_batch = max(1, self._cfg_int("serve.max_batch",
+                                              DEFAULT_MAX_BATCH))
+        self.deadline_ms = self._cfg_int("serve.deadline_ms",
+                                         DEFAULT_DEADLINE_MS)
+        try:
+            self.shed_factor = float(self.config.get(
+                "serve.shed_factor", DEFAULT_SHED_FACTOR))
+        except (TypeError, ValueError):
+            self.shed_factor = DEFAULT_SHED_FACTOR
+        # deque + condition (not queue.Queue): template batching must
+        # EXTRACT matching members in place so non-matching requests
+        # keep their arrival position — a tail re-enqueue would let
+        # sustained same-template traffic starve an early stranger
+        self._queue: "deque[Request]" = deque()
+        self._cv = threading.Condition()
+        self._running = False
+        self._stopped = False
+        self._thread: "threading.Thread | None" = None
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.stats = {"submitted": 0, "completed": 0, "shed": 0,
+                      "errors": 0, "batched": 0,
+                      "max_inflight": 0}
+        self._build_engine()
+
+    # ------------------------------------------------------- plumbing
+
+    def _cfg_int(self, key: str, default: int) -> int:
+        try:
+            return self.config.get_int(key, default)
+        except (TypeError, ValueError):
+            return default
+
+    def _build_engine(self) -> None:
+        """One session + ExecutionPipeline per suite. The warehouse is
+        shared storage, but the NAMESPACES are per-suite (TPC-H and
+        TPC-DS both define ``customer``, with different schemas), so
+        each suite keeps its own table registry and its pipeline keeps
+        its own executor/buffer/compile state — stable across
+        interleaved suite traffic, which one shared pipeline's
+        registry-identity check would thrash on."""
+        from nds_tpu.engine.scheduler import make_pipeline
+        from nds_tpu.engine.session import Session
+        from nds_tpu.utils.power_core import prepare_engine
+        backend = self.config.get("engine.backend", "cpu")
+        prepare_engine(self.config)
+        self.pipelines = {
+            "nds": make_pipeline(self.config, backend),
+            "nds_h": make_pipeline(self.config, backend),
+        }
+        self.sessions = {
+            "nds": Session.for_nds(self.pipelines["nds"],
+                                   parameterize=True),
+            "nds_h": Session.for_nds_h(self.pipelines["nds_h"],
+                                       parameterize=True),
+        }
+
+    def register_table(self, table, suite: "str | None" = None) -> None:
+        """Load-phase API (NOT thread-safe vs a running server): add
+        one warehouse table to ``suite``'s namespace (both namespaces
+        when None — for genuinely shared tables)."""
+        targets = ([self.sessions[suite]] if suite
+                   else list(self.sessions.values()))
+        for s in targets:
+            s.register_table(table)
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "QueryServer":
+        if self._thread is None:
+            with self._cv:
+                # restartable: a stopped server that start()s again
+                # must serve, not zombie-shed behind a stale flag
+                self._stopped = False
+                self._running = True
+            self._thread = threading.Thread(
+                target=self._engine_loop, name="nds-tpu-serve-engine",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            # under the same condition submit() enqueues with: after
+            # this, no request can slip onto the queue past the drain
+            # below
+            self._running = False
+            self._stopped = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        # anything still queued sheds: stop() must never strand a
+        # caller on an unfulfilled future
+        while True:
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            self._finish_shed(req, "server-stopping")
+
+    # ------------------------------------------------------ admission
+
+    def submit(self, tenant: str, suite: str, sql: str,
+               qname: str = "") -> "Future[Response]":
+        """Thread-safe request intake with queue-depth brownout."""
+        with self._lock:
+            # default qname minted under the lock: concurrent submits
+            # must never share one (summary filenames key on it)
+            req = Request(tenant=tenant, suite=suite, sql=sql,
+                          qname=qname
+                          or f"q{self.stats['submitted']}")
+            self.stats["submitted"] += 1
+            self._inflight += 1
+            self.stats["max_inflight"] = max(self.stats["max_inflight"],
+                                             self._inflight)
+        obs_metrics.counter("server_requests_total").inc()
+        _tenant_counter("server_requests_total", tenant).inc()
+        if suite not in self.sessions:
+            self._finish_error(req, f"unknown suite {suite!r}")
+            return req.future
+        with self._cv:
+            # the stopped check and the append share stop()'s
+            # condition: a stop() racing this submit either sees the
+            # request on the queue (and sheds it in its drain) or we
+            # see _stopped here — the future resolves either way,
+            # never strands
+            if self._stopped:
+                # a not-yet-started server still queues; start() will
+                # serve the backlog
+                shed = "server-stopping"
+            elif len(self._queue) >= self.max_queue:
+                shed = f"queue-depth:{self.max_queue}"
+            else:
+                shed = None
+                self._queue.append(req)
+                self._cv.notify()
+        if shed:
+            self._finish_shed(req, shed)
+            return req.future
+        obs_metrics.gauge("server_queue_depth").set(len(self._queue))
+        return req.future
+
+    # ------------------------------------------------- engine thread
+
+    def _engine_loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait(timeout=0.1)
+                if not self._running:
+                    return
+                req = self._queue.popleft()
+            try:
+                self._serve_group(req)
+            except Exception as exc:  # noqa: BLE001 - request-scoped
+                # an unexpected engine-loop failure bills THIS request
+                # and keeps serving (shed-not-crash applies to bugs too)
+                self._finish_error(req,
+                                   f"{type(exc).__name__}: {exc}")
+            obs_metrics.gauge("server_queue_depth").set(
+                len(self._queue))
+
+    def _too_old(self, req: Request) -> bool:
+        return (self.deadline_ms > 0
+                and (time.monotonic() - req.enqueued) * 1000
+                > self.deadline_ms)
+
+    def _plan_for(self, req: Request):
+        """(planned, plan_digest | None) through the session's bounded
+        plan cache; the digest groups same-template in-flight requests
+        onto one compiled program."""
+        from nds_tpu.sql import params as sqlparams
+        s = self.sessions[req.suite]
+        key = (req.sql, s._views_signature())
+        planned = s._planned_for(key, req.sql)
+        if isinstance(planned, tuple):
+            return planned, None
+        key = sqlparams.plan_key(planned)
+        # params.plan_key IS the device executor's compile-cache key:
+        # batching on it guarantees the group really shares a program
+        return planned, (key[1] if key else None)
+
+    def _serve_group(self, req: Request) -> None:
+        """Serve one dequeued request, plus every queued request with
+        the SAME parameterized plan digest (template batching: the
+        group shares one compiled program and drains back-to-back
+        without re-entering the scheduler between strangers)."""
+        if self._too_old(req):
+            self._finish_shed(req, "deadline")
+            return
+        try:
+            planned, digest = self._plan_for(req)
+        except Exception as exc:  # noqa: BLE001 - plan errors answer
+            self._finish_error(req, f"{type(exc).__name__}: {exc}")
+            return
+        group = [req]
+        if digest is not None:
+            # EXTRACT same-digest peers (bounded) from the queue in
+            # place: non-matching requests keep their arrival position
+            # (the single engine thread is the only remover, so the
+            # snapshot below stays valid while planning outside the
+            # condition)
+            with self._cv:
+                candidates = list(self._queue)
+            from nds_tpu.resilience import faults
+            taken: list = []
+            for peer in candidates:
+                if len(group) + len(taken) >= self.max_batch:
+                    break
+                try:
+                    # fault injection suppressed (the warmup
+                    # precedent): the scan must not consume a plan
+                    # fault scheduled for the peer's own dispatch —
+                    # and an unplannable peer stays QUEUED, to be
+                    # answered (with retry semantics intact) when it
+                    # is dequeued in its own right
+                    with faults.suppress():
+                        _p, pdig = self._plan_for(peer)
+                except Exception:  # noqa: BLE001 - answered at dequeue
+                    continue
+                if pdig == digest and peer.suite == req.suite \
+                        and not self._too_old(peer):
+                    taken.append(peer)
+            if taken:
+                drop = {id(p) for p in taken}
+                with self._cv:
+                    self._queue = deque(
+                        r for r in self._queue if id(r) not in drop)
+                group.extend(taken)
+            if len(group) > 1:
+                self.stats["batched"] += len(group) - 1
+                obs_metrics.counter("server_batched_total").inc(
+                    len(group) - 1)
+        for member in group:
+            try:
+                self._serve_one(member)
+            except Exception as exc:  # noqa: BLE001 - member-scoped
+                # one member's failure must not strand the rest of the
+                # group (or double-resolve the leader from the engine
+                # loop's catch-all)
+                self._finish_error(member,
+                                   f"{type(exc).__name__}: {exc}")
+
+    def _admission_shed_reason(self, suite: str,
+                               planned) -> "str | None":
+        """Memory-pressure brownout: past ``serve.shed_factor`` x the
+        governor budget, rejecting is safer than queueing demoted
+        work (inside the factor the governor demotes placements
+        instead)."""
+        proj = getattr(self.pipelines.get(suite),
+                       "admission_projection", None)
+        if proj is None:
+            return None
+        projected, budget = proj(planned)
+        if budget > 0 and projected > budget * self.shed_factor:
+            return (f"governor:projected:{projected}"
+                    f">{self.shed_factor}x budget:{budget}")
+        return None
+
+    def _serve_one(self, req: Request) -> None:
+        from nds_tpu.io.result_io import result_digest
+        from nds_tpu.utils.report import BenchReport
+        if self._too_old(req):
+            self._finish_shed(req, "deadline")
+            return
+        s = self.sessions[req.suite]
+        try:
+            planned, _digest = self._plan_for(req)
+        except Exception as exc:  # noqa: BLE001
+            self._finish_error(req, f"{type(exc).__name__}: {exc}")
+            return
+        if not isinstance(planned, tuple):
+            reason = self._admission_shed_reason(req.suite, planned)
+            if reason:
+                self._finish_shed(req, reason)
+                return
+        report = BenchReport(req.qname, {"tenant": req.tenant,
+                                         "suite": req.suite})
+        hold: dict = {}
+
+        def _body():
+            hold["result"] = s.sql(req.sql)
+
+        t0 = time.monotonic()
+        summary = report.report_on(_body)
+        elapsed_ms = (time.monotonic() - t0) * 1000
+        report.attach_tenant(req.tenant)
+        from nds_tpu.resilience.retry import RetryStats
+        ex = s._executor_factory(s.tables)
+        report.attach_retry(getattr(ex, "last_stats", None)
+                            or RetryStats())
+        report.attach_schedule(getattr(ex, "last_schedule", None))
+        digest = result_digest(hold.get("result"))
+        report.attach_result_digest(digest)
+        failed = not report.is_success()
+        obs_metrics.histogram("server_request_seconds").observe(
+            elapsed_ms / 1000.0)
+        obs_metrics.histogram(obs_metrics.labeled(
+            "server_request_seconds", tenant=req.tenant)).observe(
+            elapsed_ms / 1000.0)
+        if self.summary_dir:
+            os.makedirs(self.summary_dir, exist_ok=True)
+            report.write_summary(prefix="serve",
+                                 out_dir=self.summary_dir)
+        if failed:
+            exc = (summary.get("exceptions") or ["unknown"])[-1]
+            self._finish_error(req, str(exc))
+            return
+        res = hold.get("result")
+        if not self._resolve(req, Response(
+                OK, qname=req.qname, tenant=req.tenant,
+                elapsed_ms=round(elapsed_ms, 3),
+                rows=getattr(res, "nrows", 0), digest=digest)):
+            return
+        with self._lock:
+            self.stats["completed"] += 1
+            self._inflight -= 1
+        obs_metrics.counter("server_completed_total").inc()
+        _tenant_counter("server_completed_total", req.tenant).inc()
+
+    # ------------------------------------------------------- outcomes
+
+    @staticmethod
+    def _resolve(req: Request, resp: Response) -> bool:
+        """Resolve a request's future exactly once; a second
+        resolution attempt (engine-loop catch-all racing a member
+        outcome) is a counted no-op, never an InvalidStateError that
+        would kill the engine thread."""
+        try:
+            req.future.set_result(resp)
+            return True
+        except Exception:  # noqa: BLE001 - already resolved/cancelled
+            obs_metrics.counter("server_double_resolve_total").inc()
+            return False
+
+    def _finish_shed(self, req: Request, reason: str) -> None:
+        if not self._resolve(req, Response(
+                SHED, qname=req.qname, tenant=req.tenant,
+                shed_reason=reason)):
+            return
+        with self._lock:
+            self.stats["shed"] += 1
+            self._inflight -= 1
+        obs_metrics.counter("server_shed_total").inc()
+        _tenant_counter("server_shed_total", req.tenant).inc()
+
+    def _finish_error(self, req: Request, error: str) -> None:
+        if not self._resolve(req, Response(
+                ERROR, qname=req.qname, tenant=req.tenant,
+                error=error)):
+            return
+        with self._lock:
+            self.stats["errors"] += 1
+            self._inflight -= 1
+        obs_metrics.counter("server_errors_total").inc()

@@ -177,6 +177,67 @@ class ScalarRef(IR):
 
 
 @dataclass
+class ParamRef(IR):
+    """A hoisted query literal (sql/params.py): the VALUE lives in
+    ``PlannedQuery.param_values[index]`` (a plain attribute, invisible
+    to the plan fingerprint), so same-template queries with different
+    literals share one canonical plan — and one compiled program, with
+    the literal supplied as a runtime scalar input."""
+    index: int
+    dtype: DType = None
+
+    def __repr__(self):
+        return f"param#{self.index}:{self.dtype}"
+
+
+@dataclass
+class DictParamIR(IR):
+    """A hoisted STRING predicate over a dictionary-encoded scan
+    column: LIKE pattern, comparison literal, or IN-list, with the
+    literal(s) in ``param_values[index]``. The device program takes a
+    boolean membership table over the operand's dictionary as a runtime
+    input; sql/params.bind_params computes that table on the host per
+    request (like_mask / lexicographic compare / isin over the derived
+    dictionary). ``table``/``column`` name the base scan column whose
+    dictionary the operand's transform chain starts from."""
+    operand: IR = None       # ColRef chain (Substr/StrMap/Concat ok)
+    table: str = ""
+    column: str = ""
+    kind: str = "cmp"        # like | cmp | inlist
+    op: str = "="            # comparison op (kind == "cmp")
+    index: int = 0
+    negated: bool = False
+    # binder-side transform spec: the operand's string-transform chain
+    # RESOLVED through derived-table aliases down to the base scan
+    # column, innermost-first, as opaque tuples (("substr", start,
+    # length) | ("map", op) | ("concat", prefix, suffix)) —
+    # sql/params.derive_dictionary replays it on the host dictionary.
+    # A spec, not IR: nothing evaluates it in any row namespace.
+    chain: tuple = ()
+    dtype: DType = BOOL
+
+    def __repr__(self):
+        return (f"dictparam#{self.index}:{self.kind}"
+                f"[{self.table}.{self.column}]")
+
+
+@dataclass
+class InListParamIR(IR):
+    """A hoisted NUMERIC/date IN-list: ``param_values[index]`` holds the
+    value tuple; the device program takes a fixed-width vector input
+    (``width`` is part of the plan, so variants with equal list lengths
+    share a program)."""
+    operand: IR = None
+    index: int = 0
+    width: int = 0
+    negated: bool = False
+    dtype: DType = BOOL
+
+    def __repr__(self):
+        return f"inparam#{self.index}x{self.width}"
+
+
+@dataclass
 class WindowRef(IR):
     """Reference to window column #index of the enclosing Window node."""
     index: int

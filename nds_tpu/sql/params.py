@@ -1,0 +1,604 @@
+"""Parameterized plans: hoist query literals into runtime arguments.
+
+The 121 NDS + NDS-H templates generate an unbounded query population
+that differs only in substitution literals (dsqgen/qgen ``-rngseed``).
+Before this module every literal baked into the traced XLA program as a
+constant, so each literal variant was a distinct plan, a distinct AOT
+fingerprint, and a distinct compile.  ``parameterize()`` rewrites a
+freshly planned statement so the literals become indexed parameter
+slots (the Execution Templates idea: cache the expensive control-plane
+decision once, validate/bind cheaply per request):
+
+- plain numeric/date/decimal literals -> ``ir.ParamRef`` (a runtime
+  scalar input);
+- string predicates bound against a column dictionary (LIKE,
+  comparisons, IN-lists) -> ``ir.DictParamIR`` (the device program
+  takes a boolean table over the dictionary as input; ``bind_params``
+  computes it on the host per request);
+- numeric IN-lists -> ``ir.InListParamIR`` (a fixed-width vector
+  input).
+
+The literal VALUES ride on ``planned.param_values`` — a plain
+attribute, not a dataclass field — so the fingerprint's ``canonical()``
+walk never sees them: two literal variants of one template hash to ONE
+cache entry and share one compiled program, with zero per-request
+compiles.
+
+Only the device executor evaluates parameter nodes natively
+(``_Trace``); every other executor (CPU oracle, chunked, sharded)
+calls ``inline()`` at entry, which substitutes the literals back and
+runs the exact pre-parameterization plan — correctness never depends
+on a placement understanding parameters.  What is NOT hoisted (the
+value would shape host-side trace constants or plan structure):
+NULL literals, string literals outside dictionary-resolvable
+predicates, SUBSTRING bounds, LIMIT counts, and anything inside join
+keys / group keys / sort keys (key packing and kernel feasibility read
+value bounds there).  A scan filter that becomes parameterized also
+opts out of the host keep-mask reduction — deterministically for every
+variant, so the program shape still matches across the template.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from nds_tpu.engine.types import (
+    DateType, DecimalType, FloatType, IntType, StringType,
+)
+from nds_tpu.sql import ir
+from nds_tpu.sql import plan as P
+
+ENV_FLAG = "NDS_TPU_PARAM_PLANS"
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "0") not in ("0", "", "false")
+
+
+def has_params(planned) -> bool:
+    return bool(getattr(planned, "param_values", None))
+
+
+# ------------------------------------------------------------- cloning
+
+def _clone_ir(e, memo: dict):
+    if e is None or not isinstance(e, ir.IR):
+        return e
+    hit = memo.get(id(e))
+    if hit is not None:
+        return hit
+    clone = e.__class__(**vars(e))
+    # ndslint: waive[NDS101] -- memo is call-local; the source tree is pinned by the caller for the whole clone
+    memo[id(e)] = clone
+    for fname, v in vars(clone).items():
+        setattr(clone, fname, _clone_val(v, memo))
+    return clone
+
+
+def _clone_val(v, memo: dict):
+    if isinstance(v, ir.IR):
+        return _clone_ir(v, memo)
+    if isinstance(v, P.Node):
+        return _clone_node(v, memo)
+    if isinstance(v, P.AggSpec):
+        return P.AggSpec(v.func, _clone_ir(v.arg, memo), v.distinct,
+                         v.dtype)
+    if isinstance(v, P.WindowSpec):
+        return P.WindowSpec(
+            v.func, _clone_ir(v.arg, memo),
+            [_clone_ir(p, memo) for p in v.partition],
+            [(_clone_ir(e, memo), a, nf) for e, a, nf in v.order],
+            v.frame, v.dtype)
+    if isinstance(v, tuple):
+        return tuple(_clone_val(x, memo) for x in v)
+    if isinstance(v, list):
+        return [_clone_val(x, memo) for x in v]
+    return v
+
+
+def _clone_node(node, memo: dict):
+    """Deep-copy a plan tree PRESERVING shared subtrees (CTE bodies and
+    session views are referenced from multiple parents; executors dedup
+    work by node identity, so the clone must keep one copy per source
+    node — and must never mutate the session-owned originals)."""
+    if node is None:
+        return None
+    hit = memo.get(id(node))
+    if hit is not None:
+        return hit
+    # __new__ (not __init__): nodes with required positional fields
+    # clone the same way; the memo entry must exist BEFORE children
+    # clone so shared subtrees resolve to one copy
+    clone = object.__new__(node.__class__)
+    # ndslint: waive[NDS101] -- memo is call-local; the source tree is pinned by the caller for the whole clone
+    memo[id(node)] = clone
+    for fname, v in vars(node).items():
+        setattr(clone, fname, _clone_val(v, memo))
+    return clone
+
+
+def clone_planned(planned: P.PlannedQuery) -> P.PlannedQuery:
+    memo: dict = {}
+    return P.PlannedQuery(
+        _clone_node(planned.root, memo),
+        [_clone_node(s, memo) for s in planned.scalar_subplans],
+        list(planned.column_names))
+
+
+# ------------------------------------------------------ parameterizing
+
+_HOISTABLE_SCALAR = (IntType, FloatType, DecimalType, DateType)
+
+# string-operand transform chain the host binder can replicate (each is
+# a deterministic per-dictionary-entry rewrite; see derive_dictionary)
+_DICT_CHAIN = (ir.SubstrIR, ir.StrMapIR, ir.ConcatIR)
+
+
+def _scan_binding_map(planned: P.PlannedQuery) -> dict:
+    """binding -> table for every base-table Scan; a binding reused for
+    DIFFERENT tables (alias collision across scopes) maps to None and
+    opts its predicates out of dictionary hoisting."""
+    out: dict = {}
+    for root in [planned.root, *planned.scalar_subplans]:
+        for node in P.walk_plan(root):
+            if isinstance(node, P.Scan):
+                prev = out.get(node.binding)
+                if prev is not None and prev != node.table:
+                    out[node.binding] = None
+                else:
+                    out.setdefault(node.binding, node.table)
+    return out
+
+
+def _derived_col_map(planned: P.PlannedQuery) -> dict:
+    """(binding, name) -> defining expression, for columns exposed by
+    namespace-mapping nodes: a DerivedScan re-exposes its child's
+    columns, a Project names expressions, an Aggregate names its group
+    keys.  Lets ``_dict_source`` trace a predicate on a derived-table
+    alias (q8's ``nation = '...'``) back to the base scan column whose
+    dictionary the value rides — codes carry their source dictionary
+    unchanged through joins and derived scans. Ambiguous (binding,
+    name) pairs map to None (no hoist)."""
+    out: dict = {}
+
+    def put(key, expr):
+        if key in out and repr(out[key]) != repr(expr):
+            out[key] = None
+        else:
+            out.setdefault(key, expr)
+
+    for root in [planned.root, *planned.scalar_subplans]:
+        for node in P.walk_plan(root):
+            if isinstance(node, P.DerivedScan):
+                cb = node.child.binding
+                for name, dt in node.child.output:
+                    put((node.binding, name),
+                        ir.ColRef(cb, name, dt))
+            elif isinstance(node, P.Project):
+                for name, e in node.exprs:
+                    put((node.binding, name), e)
+            elif isinstance(node, P.Aggregate):
+                for name, e in node.group_keys:
+                    put((node.binding, name), e)
+    return out
+
+
+def _chain_step(e) -> tuple:
+    if isinstance(e, ir.StrMapIR):
+        return ("map", e.op)
+    if isinstance(e, ir.ConcatIR):
+        return ("concat", e.prefix, e.suffix)
+    return ("substr", e.start, e.length)
+
+
+def _dict_source(e, scan_map: dict, catalog,
+                 deriv_map: "dict | None" = None) -> "tuple | None":
+    """(table, column, chain_spec) for the base-table dictionary the
+    operand's value rides, or None when the chain is not
+    host-replicable. ``chain_spec`` lists the string transforms —
+    accumulated across derived-table alias hops, innermost-first — the
+    binder must replay on the base dictionary, so the host table
+    matches what the trace applies even when a Project along the way
+    did the transforming."""
+    steps: list = []  # outermost-first
+    for _hop in range(16):  # alias-chain depth guard
+        while isinstance(e, _DICT_CHAIN):
+            steps.append(_chain_step(e))
+            e = e.operand
+        if not isinstance(e, ir.ColRef):
+            return None
+        if not isinstance(e.dtype, StringType):
+            return None
+        table = scan_map.get(e.binding)
+        if table is not None:
+            break
+        nxt = (deriv_map or {}).get((e.binding, e.name))
+        if nxt is None:
+            return None
+        e = nxt
+    else:
+        return None
+    if catalog is not None:
+        schema = catalog.schemas.get(table)
+        if schema is None or not any(
+                f.name == e.name and isinstance(f.dtype, StringType)
+                for f in schema.fields):
+            return None
+    return table, e.name, tuple(reversed(steps))
+
+
+class _Parameterizer:
+    def __init__(self, planned: P.PlannedQuery, catalog=None):
+        self.values: list = []
+        self.scan_map = _scan_binding_map(planned)
+        self.deriv_map = _derived_col_map(planned)
+        self.catalog = catalog
+
+    def _slot(self, value) -> int:
+        self.values.append(value)
+        return len(self.values) - 1
+
+    def _source(self, operand):
+        return _dict_source(operand, self.scan_map, self.catalog,
+                            self.deriv_map)
+
+    # ------------------------------------------------- expression pass
+
+    def rewrite(self, e):
+        """Hoist literals inside one expression tree (returns the
+        rewritten expression; mutates cloned nodes only)."""
+        if e is None or not isinstance(e, ir.IR):
+            return e
+        if isinstance(e, ir.Lit):
+            return self._hoist_lit(e)
+        if isinstance(e, ir.Cmp):
+            return self._rewrite_cmp(e)
+        if isinstance(e, ir.LikeIR):
+            return self._rewrite_like(e)
+        if isinstance(e, ir.InListIR):
+            return self._rewrite_inlist(e)
+        self._rewrite_fields(e)
+        return e
+
+    def _rewrite_fields(self, e) -> None:
+        for fname, v in vars(e).items():
+            if isinstance(v, ir.IR):
+                setattr(e, fname, self.rewrite(v))
+            elif isinstance(v, list):
+                setattr(e, fname, [
+                    tuple(self.rewrite(y) if isinstance(y, ir.IR) else y
+                          for y in it) if isinstance(it, tuple)
+                    else (self.rewrite(it) if isinstance(it, ir.IR)
+                          else it)
+                    for it in v])
+
+    def _hoist_lit(self, e: ir.Lit):
+        if e.value is None:
+            return e
+        if isinstance(e.dtype, _HOISTABLE_SCALAR):
+            return ir.ParamRef(self._slot(e.value), e.dtype)
+        return e  # strings/bools only hoist via dictionary predicates
+
+    def _rewrite_cmp(self, e: ir.Cmp):
+        lt, rt = e.left.dtype, e.right.dtype
+        if isinstance(lt, StringType) or isinstance(rt, StringType):
+            lit, operand, op = None, None, e.op
+            if isinstance(e.right, ir.Lit) and isinstance(
+                    e.right.value, str):
+                lit, operand = e.right.value, e.left
+            elif isinstance(e.left, ir.Lit) and isinstance(
+                    e.left.value, str):
+                lit, operand = e.left.value, e.right
+                op = {"<": ">", "<=": ">=", ">": "<",
+                      ">=": "<="}.get(op, op)
+            if lit is not None:
+                src = self._source(operand)
+                if src is not None:
+                    return ir.DictParamIR(
+                        operand, src[0], src[1], "cmp", op,
+                        self._slot(lit), chain=src[2])
+            return e  # string compare the binder can't replicate
+        self._rewrite_fields(e)
+        return e
+
+    def _rewrite_like(self, e: ir.LikeIR):
+        src = self._source(e.operand)
+        if src is None:
+            return e
+        return ir.DictParamIR(e.operand, src[0], src[1], "like", "",
+                              self._slot(e.pattern), e.negated,
+                              chain=src[2])
+
+    def _rewrite_inlist(self, e: ir.InListIR):
+        if not e.values or any(v is None for v in e.values):
+            return e
+        if isinstance(e.operand.dtype, StringType):
+            src = self._source(e.operand)
+            if src is None:
+                return e
+            return ir.DictParamIR(
+                e.operand, src[0], src[1], "inlist", "",
+                self._slot(tuple(str(v) for v in e.values)), e.negated,
+                chain=src[2])
+        if isinstance(e.operand.dtype, _HOISTABLE_SCALAR):
+            return ir.InListParamIR(
+                e.operand, self._slot(tuple(e.values)), len(e.values),
+                e.negated)
+        return e
+
+    # -------------------------------------------------------- node pass
+
+    def visit(self, root: P.Node) -> None:
+        seen: set = set()
+        for node in P.walk_plan(root):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, P.Scan):
+                node.filters = [self.rewrite(f) for f in node.filters]
+            elif isinstance(node, P.Filter):
+                node.predicate = self.rewrite(node.predicate)
+            elif isinstance(node, P.Project):
+                node.exprs = [(n, self.rewrite(e))
+                              for n, e in node.exprs]
+            elif isinstance(node, (P.Join, P.SemiJoin)):
+                # keys stay inlined: packing/kernel feasibility reads
+                # their value bounds — only the residual hoists
+                if node.residual is not None:
+                    node.residual = self.rewrite(node.residual)
+            elif isinstance(node, P.Aggregate):
+                # group keys stay inlined (grouping packs by bounds);
+                # aggregate ARguments hoist (sum(price * lit))
+                node.aggs = [
+                    (n, P.AggSpec(a.func, self.rewrite(a.arg),
+                                  a.distinct, a.dtype))
+                    for n, a in node.aggs]
+
+
+def parameterize(planned: P.PlannedQuery,
+                 catalog=None) -> P.PlannedQuery:
+    """Clone + hoist. Returns the clone with ``param_values`` attached
+    (an empty hoist returns the clone with no attribute, so downstream
+    fast paths stay no-ops). The session-owned original — including any
+    shared view bodies — is never mutated."""
+    clone = clone_planned(planned)
+    pz = _Parameterizer(clone, catalog)
+    for root in [clone.root, *clone.scalar_subplans]:
+        pz.visit(root)
+    if pz.values:
+        clone.param_values = pz.values
+    return clone
+
+
+# ---------------------------------------------------------- inlining
+
+def plan_key(planned) -> "tuple | None":
+    """The shared-program cache key for a parameterized plan:
+    ``("param", <canonical plan digest>)``, memoized on the plan
+    object. One helper, used by BOTH the device executor's compile
+    cache (device_exec._plan_key) and the server's template batching
+    (serve/server.py), so the two can never drift apart. None for
+    unparameterized plans."""
+    if not has_params(planned):
+        return None
+    memo = getattr(planned, "_param_key_memo", None)
+    if memo is None:
+        from nds_tpu.cache.fingerprint import plan_digest
+        memo = ("param", plan_digest(planned))
+        try:
+            planned._param_key_memo = memo
+        except Exception:  # noqa: BLE001 - slotted plan: recompute
+            pass
+    return memo
+
+
+def inline(planned: P.PlannedQuery) -> P.PlannedQuery:
+    """Substitute the literal values back: the exact plan the
+    pre-parameterization planner produced, for executors that evaluate
+    literals as constants (CPU oracle, chunked, sharded). No-op (same
+    object) for unparameterized plans. The clone is memoized on the
+    parameterized plan: repeated dispatches of one cached plan (a
+    serving workload's sharded/streamed placements) keep ONE stable
+    inlined object, so id-keyed executor caches keep hitting instead
+    of recompiling per request."""
+    values = getattr(planned, "param_values", None)
+    if not values:
+        return planned
+    memo = getattr(planned, "_inline_memo", None)
+    if memo is not None:
+        return memo
+    clone = clone_planned(planned)
+
+    def sub(e):
+        if isinstance(e, ir.ParamRef):
+            return ir.Lit(values[e.index], e.dtype)
+        if isinstance(e, ir.InListParamIR):
+            return ir.InListIR(rec(e.operand), list(values[e.index]),
+                               e.negated)
+        if isinstance(e, ir.DictParamIR):
+            v = values[e.index]
+            if e.kind == "like":
+                return ir.LikeIR(rec(e.operand), v, e.negated)
+            if e.kind == "inlist":
+                return ir.InListIR(rec(e.operand), list(v), e.negated)
+            return ir.Cmp(e.op, rec(e.operand),
+                          ir.Lit(v, StringType()))
+        return None
+
+    def rec(e):
+        if e is None or not isinstance(e, ir.IR):
+            return e
+        r = sub(e)
+        if r is not None:
+            return r
+        for fname, v in vars(e).items():
+            if isinstance(v, ir.IR):
+                setattr(e, fname, rec(v))
+            elif isinstance(v, list):
+                setattr(e, fname, [
+                    tuple(rec(y) if isinstance(y, ir.IR) else y
+                          for y in it) if isinstance(it, tuple)
+                    else (rec(it) if isinstance(it, ir.IR) else it)
+                    for it in v])
+        return e
+
+    seen: set = set()
+    for root in [clone.root, *clone.scalar_subplans]:
+        for node in P.walk_plan(root):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, P.Scan):
+                node.filters = [rec(f) for f in node.filters]
+            elif isinstance(node, P.Filter):
+                node.predicate = rec(node.predicate)
+            elif isinstance(node, P.Project):
+                node.exprs = [(n, rec(e)) for n, e in node.exprs]
+            elif isinstance(node, (P.Join, P.SemiJoin)):
+                if node.residual is not None:
+                    node.residual = rec(node.residual)
+            elif isinstance(node, P.Aggregate):
+                node.aggs = [(n, P.AggSpec(a.func, rec(a.arg),
+                                           a.distinct, a.dtype))
+                             for n, a in node.aggs]
+    try:
+        planned._inline_memo = clone
+    except Exception:  # noqa: BLE001 - slotted plan: re-clone next time
+        pass
+    return clone
+
+
+# ----------------------------------------------------------- binding
+
+def scalar_np_dtype(dt) -> "np.dtype":
+    """The FIXED numpy dtype a hoisted scalar binds at — independent of
+    the value, so every literal variant lowers to the same program
+    signature."""
+    if isinstance(dt, FloatType):
+        return np.dtype(np.float64)
+    if isinstance(dt, DecimalType):
+        return np.dtype(np.int64)
+    if isinstance(dt, DateType):
+        return np.dtype(np.int32)
+    if isinstance(dt, IntType):
+        return np.dtype(np.int32 if dt.bits <= 32 else np.int64)
+    raise TypeError(f"unbindable scalar param dtype {dt!r}")
+
+
+def slot_name(e) -> str:
+    if isinstance(e, ir.ParamRef):
+        return f"p{e.index}"
+    if isinstance(e, ir.DictParamIR):
+        return f"d{e.index}"
+    if isinstance(e, ir.InListParamIR):
+        return f"v{e.index}"
+    raise TypeError(f"not a param node: {e!r}")
+
+
+def derive_dictionary(chain: tuple, tables: dict, table: str,
+                      column: str) -> np.ndarray:
+    """Replicate the device trace's dictionary transform chain on the
+    host: the trace rewrites dictionaries with
+    ``np.unique(transformed.astype(str))`` per step
+    (device_exec._rewrite_dict/_eval_substr), so replaying the
+    DictParamIR's chain spec (innermost-first) on the same base
+    dictionary yields the same (sorted, deduped) final dictionary the
+    compiled program's codes index."""
+    col = tables[table].columns[column]
+    if col.dictionary is None:
+        raise ValueError(f"{table}.{column} is not dictionary-encoded")
+    d = np.asarray(col.dictionary, dtype=object)
+    for step in chain:
+        vals = d.astype(str)
+        if step[0] == "map":
+            f = str.upper if step[1] == "upper" else str.lower
+            out = np.array([f(s) for s in vals], dtype=object)
+        elif step[0] == "concat":
+            out = np.array([step[1] + s + step[2] for s in vals],
+                           dtype=object)
+        elif step[0] == "substr":
+            lo = step[1] - 1
+            hi = None if step[2] is None else lo + step[2]
+            out = np.array([s[lo:hi] for s in vals], dtype=object)
+        else:
+            raise ValueError(f"unknown chain step {step!r}")
+        d = np.unique(out.astype(str)).astype(object)
+    return d
+
+
+def _np_cmp(op, vals, lit):
+    if op == "=":
+        return vals == lit
+    if op == "<>":
+        return vals != lit
+    if op == "<":
+        return vals < lit
+    if op == "<=":
+        return vals <= lit
+    if op == ">":
+        return vals > lit
+    if op == ">=":
+        return vals >= lit
+    raise ValueError(op)
+
+
+def param_nodes(planned: P.PlannedQuery):
+    """Every distinct parameter node in the plan (dict-keyed by slot:
+    one hoisted literal appears exactly once by construction)."""
+    out: dict = {}
+    seen: set = set()
+    for root in [planned.root, *planned.scalar_subplans]:
+        for node in P.walk_plan(root):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            for e in P.all_exprs(node):
+                if e is None:
+                    continue
+                for x in ir.walk(e):
+                    if isinstance(x, (ir.ParamRef, ir.DictParamIR,
+                                      ir.InListParamIR)):
+                        out[slot_name(x)] = x
+    return out
+
+
+def bind_params(planned: P.PlannedQuery, tables: dict) -> dict:
+    """slot -> host numpy value for one dispatch: scalars at their
+    canonical dtypes, dictionary membership tables (negation applied in
+    the traced program, NOT here — the table is canonical per value),
+    and fixed-width IN-list vectors. Cheap by design: dictionary-sized
+    numpy work, no row-count-sized work."""
+    values = getattr(planned, "param_values", None)
+    if not values:
+        return {}
+    from nds_tpu.engine.cpu_exec import like_mask
+    out: dict = {}
+    for slot, e in param_nodes(planned).items():
+        if isinstance(e, ir.ParamRef):
+            v = values[e.index]
+            dt = scalar_np_dtype(e.dtype)
+            if isinstance(e.dtype, DecimalType):
+                # decimal literals are already plan-time scaled ints
+                v = int(v)
+            out[slot] = np.asarray(v, dtype=dt)
+        elif isinstance(e, ir.InListParamIR):
+            vals = list(values[e.index])
+            dt = scalar_np_dtype(e.operand.dtype)
+            if isinstance(e.operand.dtype, DecimalType):
+                s = e.operand.dtype.scale
+                vals = [int(round(float(x) * 10 ** s)) for x in vals]
+            out[slot] = np.asarray(vals, dtype=dt)
+        else:  # DictParamIR
+            d = derive_dictionary(e.chain, tables, e.table, e.column)
+            vals = d.astype(str)
+            v = values[e.index]
+            if e.kind == "like":
+                table = like_mask(d, v)
+            elif e.kind == "inlist":
+                table = np.isin(vals, np.array([str(x) for x in v]))
+            else:
+                table = _np_cmp(e.op, vals, str(v))
+            out[slot] = np.asarray(table, dtype=bool)
+    return out

@@ -193,12 +193,18 @@ def _hoist_common_disjuncts(conjuncts: list[ast.Expr]) -> list[ast.Expr]:
 
 
 class Planner:
-    def __init__(self, catalog: CatalogInfo, views: dict | None = None):
+    def __init__(self, catalog: CatalogInfo, views: dict | None = None,
+                 parameterize: bool = False):
         self.catalog = catalog
         self.views = views if views is not None else {}
         self.scalar_subplans: list[P.Node] = []
         self._binding_counter = 0
         self._views_stack: list[dict] = [{}]
+        # hoist query literals into runtime parameters (sql/params.py):
+        # same-template literal variants then share ONE canonical plan,
+        # one AOT fingerprint, and one compiled program — the serving
+        # layer's zero-compile-per-request contract
+        self.parameterize = parameterize
 
     # ---------------------------------------------------------------- API
 
@@ -240,8 +246,12 @@ class Planner:
             return ("delete", stmt.table, stmt.where)
         root = self.plan_select(stmt, None, {})
         names = [_display_name(n) for n, _ in root.output]
-        return self._annotated(
+        planned = self._annotated(
             P.PlannedQuery(root, self.scalar_subplans, names))
+        if self.parameterize:
+            from nds_tpu.sql import params as sqlparams
+            planned = sqlparams.parameterize(planned, self.catalog)
+        return planned
 
     def _annotated(self, planned: P.PlannedQuery) -> P.PlannedQuery:
         """Stamp per-node kernel choices (engine/kernels.py) from the

@@ -65,6 +65,37 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             engine.placement.floor=cpu (the one-shot
                             stream demotion it used to trigger is now
                             the ladder + sticky demotion)
+
+Serving keys (the query server, nds_tpu/serve/ — README "Serving"):
+
+  serve.max_queue           admission bound: a submit that would make
+                            the request queue deeper than this sheds
+                            immediately (status "shed",
+                            server_shed_total; default 64). Brownout,
+                            not backpressure: past saturation the
+                            server degrades its ANSWER RATE, never
+                            its liveness
+  serve.deadline_ms         queue-age deadline: a request still queued
+                            after this many ms sheds at dequeue
+                            instead of executing late (0 = off,
+                            default)
+  serve.max_batch           same-template batching bound: how many
+                            queued requests with the SAME
+                            parameterized plan digest one dispatch
+                            group drains back-to-back against the
+                            shared compiled program (default 8)
+  serve.shed_factor         memory brownout: shed when the
+                            MemoryGovernor's pre-dispatch projection
+                            exceeds this multiple of
+                            engine.placement.device_budget_bytes
+                            (default 1.5; inside the factor the
+                            governor demotes placements instead of
+                            shedding)
+  serve.summary_dir         per-request BenchReport summaries land
+                            here (tenant field attached) so
+                            ``ndsreport analyze`` reports serving
+                            p50/p99 like any run dir (unset = no
+                            summaries)
 """
 
 from __future__ import annotations

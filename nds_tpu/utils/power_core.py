@@ -176,16 +176,12 @@ def suite_schemas(suite: Suite, config: EngineConfig) -> dict:
     return suite.get_schemas(**schema_kwargs_for(suite, config))
 
 
-def make_session(suite: Suite, config: EngineConfig) -> Session:
-    """Session from an EngineConfig — the template/property-file layer
-    actually driving engine choice (closes the reference's
-    spark-submit-template contract). EVERY backend routes through the
-    unified execution pipeline (engine/scheduler.py): the backend picks
-    the placement *universe* (tpu -> device/chunked/cpu, distributed ->
-    sharded/chunked/cpu, cpu -> cpu), and the pipeline's cost model +
-    degradation ladder schedule each query within it."""
+def prepare_engine(config: EngineConfig) -> None:
+    """Engine-wide activation shared by every session-construction
+    path (the power drivers' make_session and the query server's
+    QueryServer._build_engine): plan-cache configuration plus the
+    plan-cache/XLA-compile-cache interplay the backend requires."""
     backend = config.get("engine.backend", "cpu")
-    kwargs = schema_kwargs_for(suite, config)
     # cache.dir/cache.readonly activate the persistent AOT plan cache
     # for every executor this session schedules (README "Plan cache");
     # configs without the keys leave the NDS_TPU_PLAN_CACHE env
@@ -224,6 +220,19 @@ def make_session(suite: Suite, config: EngineConfig) -> Session:
             xla_cache.disable()
     elif backend != "cpu":
         raise ValueError(f"unknown engine.backend {backend!r}")
+
+
+def make_session(suite: Suite, config: EngineConfig) -> Session:
+    """Session from an EngineConfig — the template/property-file layer
+    actually driving engine choice (closes the reference's
+    spark-submit-template contract). EVERY backend routes through the
+    unified execution pipeline (engine/scheduler.py): the backend picks
+    the placement *universe* (tpu -> device/chunked/cpu, distributed ->
+    sharded/chunked/cpu, cpu -> cpu), and the pipeline's cost model +
+    degradation ladder schedule each query within it."""
+    backend = config.get("engine.backend", "cpu")
+    kwargs = schema_kwargs_for(suite, config)
+    prepare_engine(config)
     from nds_tpu.engine.scheduler import make_pipeline
     return suite.session_for(make_pipeline(config, backend), **kwargs)
 

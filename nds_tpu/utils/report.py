@@ -302,6 +302,14 @@ class BenchReport:
                 block["entries"] = int(entries)
             self.summary["flight"] = block
 
+    def attach_tenant(self, tenant: str | None) -> None:
+        """Serving-layer attribution (nds_tpu/serve/): which tenant
+        submitted the request this summary bills. Absent on benchmark
+        summaries; ndsreport analyze groups per-tenant latency
+        quantiles over it."""
+        if tenant:
+            self.summary["tenant"] = str(tenant)
+
     def attach_incarnation(self, incarnation: int | None) -> None:
         """Record which resume incarnation produced this summary
         (resilience/journal.QueryJournal). 0 = the original process;

@@ -524,3 +524,70 @@ class TestSummarySchema:
                                "load_ms": "fast"})
         assert self._validate({"hits": 1, "misses": 0,
                                "bytes_read": -5})
+
+
+# ------------------------------------- parameterized-fingerprint sharing
+
+class TestParameterizedSharing:
+    """ISSUE 12: same-template literal variants must land on ONE cache
+    entry and pay zero compiles after the first (sql/params.py)."""
+
+    def _variant(self, seed: int) -> str:
+        import random
+
+        from nds_tpu.nds_h import streams as hs
+        return hs.render_query(5, hs.random_params(
+            5, random.Random(seed), 0))
+
+    def test_two_literal_variants_one_entry_zero_miss(self, raw,
+                                                      tmp_path):
+        from nds_tpu.engine.device_exec import make_device_factory
+        plan_cache.configure(str(tmp_path / "pc"))
+        dev = _session(raw, make_device_factory())
+        dev.parameterize = True
+        oracle = _session(raw)
+        a, b = self._variant(31), self._variant(32)
+        assert a != b, "variants must differ in literals"
+
+        before = obs_metrics.snapshot()
+        ra = dev.sql(a)
+        cold = _counters(before)
+        assert cold.get("compiles_total", 0) >= 1
+        store = PlanCache(str(tmp_path / "pc"), readonly=True)
+        entries_cold = len(store.entries())
+
+        before = obs_metrics.snapshot()
+        rb = dev.sql(b)
+        warm = _counters(before)
+        # the literal variant shares the in-process compiled program:
+        # no compile, no cache consult, no new entry
+        assert not warm.get("compiles_total")
+        assert not warm.get("compile_cache_misses_total")
+        assert len(store.entries()) == entries_cold
+
+        # parity: each variant's rows equal the CPU oracle's for the
+        # SAME literals
+        from test_device_engine import assert_frames_close
+        assert_frames_close(ra.to_pandas(), oracle.sql(a).to_pandas(),
+                            5)
+        assert_frames_close(rb.to_pandas(), oracle.sql(b).to_pandas(),
+                            5)
+
+    def test_variant_hits_across_processes_via_store(self, raw,
+                                                     tmp_path):
+        """Variant B in a FRESH executor (new in-process caches) must
+        be served by the store entry variant A persisted — the
+        cross-process sharing the fingerprint identity buys."""
+        from nds_tpu.engine.device_exec import make_device_factory
+        plan_cache.configure(str(tmp_path / "pc"))
+        dev_a = _session(raw, make_device_factory())
+        dev_a.parameterize = True
+        dev_a.sql(self._variant(41))
+
+        dev_b = _session(raw, make_device_factory())
+        dev_b.parameterize = True
+        before = obs_metrics.snapshot()
+        dev_b.sql(self._variant(42))
+        warm = _counters(before)
+        assert not warm.get("compiles_total")
+        assert warm.get("compile_cache_hits_total", 0) >= 1

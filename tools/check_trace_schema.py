@@ -176,6 +176,17 @@ def validate_summary(obj: object) -> list[str]:
                 or mem["device_hwm_bytes"] < 0
                 or mem.get("source") not in _HWM_SOURCES):
             errs.append(f"bad memory block {mem!r}")
+    # serving-layer fields (nds_tpu/serve/): tenant attribution on
+    # per-request summaries; stale_device_times marks banked (not
+    # freshly measured) numbers — a bool that must never be false-y
+    # noise
+    if "tenant" in obj and (not isinstance(obj["tenant"], str)
+                            or not obj["tenant"]):
+        errs.append(f"bad tenant {obj.get('tenant')!r}")
+    if "stale_device_times" in obj and obj["stale_device_times"] \
+            is not True:
+        errs.append(f"bad stale_device_times "
+                    f"{obj['stale_device_times']!r}")
     if "retries" in obj and (not isinstance(obj["retries"], int)
                              or obj["retries"] < 0):
         errs.append(f"bad retries {obj['retries']!r}")

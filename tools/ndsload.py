@@ -1,0 +1,222 @@
+"""Seeded load generator for the query server (README "Serving").
+
+Builds a deterministic stream of literal-variant NDS + NDS-H requests
+(the suites' own seeded parameter generators — dsqgen/qgen `-rngseed`
+semantics) spread across tenants, and drives a server either in-process
+(`run_inproc`) or over the TCP JSON-lines front (`run_tcp`). A load is
+three phases:
+
+  warmup   every (suite, template) once, sequentially — pays the
+           compile/cache-load cost outside the timed window
+  load     N requests at a given concurrency: mixed templates, mixed
+           tenants, every instance a fresh literal draw
+  burst    optional oversubscription spike (fire `burst` requests at
+           once) to prove brownout sheds instead of collapsing
+
+The report carries per-phase status counts, latency quantiles
+(p50/p95/p99 over the load phase), and the engine metric deltas the
+acceptance gates read (compiles_total, compile_cache_misses_total,
+server_shed_total). Multi-statement templates (NDS 14/23/24/39 parts,
+NDS-H q15's view lifecycle) are excluded: a serving request is one
+statement by contract.
+
+CLI (standalone, against a running TCP server):
+
+  python tools/ndsload.py --host 127.0.0.1 --port 9321 \
+      --requests 64 --concurrency 8 --tenants 4 --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# single-statement serving defaults: small, planner-fast templates from
+# each suite (serve_check narrows further)
+DEFAULT_NDS_H = (1, 5, 6)
+DEFAULT_NDS = (7, 96, 93)
+
+MULTIPART_NDS = {14, 23, 24, 39}
+
+
+def render(suite: str, template: int, rng: random.Random) -> str:
+    """One fresh literal-variant statement of a template."""
+    if suite == "nds_h":
+        from nds_tpu.nds_h import streams as hs
+        if template == 15:
+            raise ValueError("q15 (view lifecycle) is not servable as "
+                             "one statement")
+        return hs.render_query(
+            template, hs.random_params(template, rng, 0))
+    from nds_tpu.nds import streams as ds
+    if template in MULTIPART_NDS:
+        raise ValueError(f"NDS q{template} is multi-statement")
+    sql = ds.render_query(
+        template, ds.random_params(template, rng, 0))
+    stmts = [s.strip() for s in sql.split(";") if s.strip()]
+    if len(stmts) != 1:
+        raise ValueError(f"NDS q{template} rendered {len(stmts)} "
+                         f"statements")
+    return stmts[0]
+
+
+def build_requests(count: int, seed: int, tenants: int = 2,
+                   nds_h_templates=DEFAULT_NDS_H,
+                   nds_templates=DEFAULT_NDS) -> list:
+    """Deterministic request docs: round-robin over the mixed template
+    pool, fresh literal draw per instance, tenants interleaved."""
+    rng = random.Random(seed)
+    pool = ([("nds_h", t) for t in nds_h_templates]
+            + [("nds", t) for t in nds_templates])
+    docs = []
+    for i in range(count):
+        suite, tpl = pool[i % len(pool)]
+        docs.append({
+            "tenant": f"tenant{i % max(1, tenants)}",
+            "suite": suite,
+            "qname": f"{suite}-q{tpl}#{i}",
+            "sql": render(suite, tpl, rng),
+        })
+    return docs
+
+
+def warmup_docs(seed: int, nds_h_templates=DEFAULT_NDS_H,
+                nds_templates=DEFAULT_NDS) -> list:
+    rng = random.Random(seed * 7919 + 1)
+    return ([{"tenant": "warmup", "suite": "nds_h",
+              "qname": f"warm-h{t}",
+              "sql": render("nds_h", t, rng)}
+             for t in nds_h_templates]
+            + [{"tenant": "warmup", "suite": "nds",
+                "qname": f"warm-d{t}",
+                "sql": render("nds", t, rng)}
+               for t in nds_templates])
+
+
+def _quantiles(samples: list) -> dict:
+    # the analyzer's nearest-rank implementation: load-generator and
+    # ndsreport quantiles must agree when read side by side
+    from nds_tpu.obs.analyze import _quantiles as q
+    return q(samples)
+
+
+def summarize(responses: list) -> dict:
+    by_status: dict = {}
+    shed_reasons: dict = {}
+    lat = []
+    for r in responses:
+        by_status[r.get("status", "?")] = by_status.get(
+            r.get("status", "?"), 0) + 1
+        if r.get("status") == "ok":
+            lat.append(float(r.get("elapsed_ms", 0.0)))
+        elif r.get("status") == "shed":
+            # reason class only (strip the :detail tail): the report
+            # distinguishes queue-depth vs deadline vs governor sheds
+            why = str(r.get("shed_reason", "?")).split(":")[0]
+            shed_reasons[why] = shed_reasons.get(why, 0) + 1
+    out = {"responses": len(responses), "status": by_status,
+           "latency_ms": _quantiles(lat)}
+    if shed_reasons:
+        out["shed_reasons"] = shed_reasons
+    return out
+
+
+# ------------------------------------------------------------ drivers
+
+def run_inproc(server, docs: list, concurrency: int = 8) -> list:
+    """Drive an in-process QueryServer: submit with at most
+    ``concurrency`` outstanding futures (the client-side window; the
+    server's own queue depth is what brownout watches)."""
+    out = []
+    window: list = []
+    for doc in docs:
+        window.append(server.submit(doc["tenant"], doc["suite"],
+                                    doc["sql"], doc["qname"]))
+        if len(window) >= concurrency:
+            out.append(_resp_doc(window.pop(0).result(timeout=600)))
+    for fut in window:
+        out.append(_resp_doc(fut.result(timeout=600)))
+    return out
+
+
+def burst_inproc(server, docs: list) -> list:
+    """Fire every doc at once (no client window): the overload spike
+    the brownout gate wants."""
+    futs = [server.submit(d["tenant"], d["suite"], d["sql"],
+                          d["qname"]) for d in docs]
+    return [_resp_doc(f.result(timeout=600)) for f in futs]
+
+
+def _resp_doc(resp) -> dict:
+    import dataclasses
+    return {k: v for k, v in dataclasses.asdict(resp).items()
+            if v is not None}
+
+
+def run_tcp(host: str, port: int, docs: list,
+            concurrency: int = 8) -> list:
+    from nds_tpu.serve.net import request_many
+    return asyncio.run(request_many(host, port, docs, concurrency))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--tenants", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--burst", type=int, default=0,
+                    help="extra simultaneous overload requests after "
+                         "the load phase")
+    ap.add_argument("--warmup", action="store_true",
+                    help="run the one-per-template warmup phase first")
+    ap.add_argument("--nds_h_templates",
+                    default=",".join(map(str, DEFAULT_NDS_H)),
+                    help="comma list of NDS-H templates ('' = none)")
+    ap.add_argument("--nds_templates",
+                    default=",".join(map(str, DEFAULT_NDS)),
+                    help="comma list of NDS templates ('' = none)")
+    args = ap.parse_args(argv)
+    h_tpls = tuple(int(x) for x in args.nds_h_templates.split(",")
+                   if x.strip())
+    d_tpls = tuple(int(x) for x in args.nds_templates.split(",")
+                   if x.strip())
+    if not h_tpls and not d_tpls:
+        ap.error("template pool is empty")
+
+    report: dict = {"seed": args.seed}
+    if args.warmup:
+        t0 = time.monotonic()
+        w = run_tcp(args.host, args.port,
+                    warmup_docs(args.seed, h_tpls, d_tpls), 1)
+        report["warmup"] = {**summarize(w),
+                            "wall_s": round(time.monotonic() - t0, 3)}
+    docs = build_requests(args.requests, args.seed, args.tenants,
+                          h_tpls, d_tpls)
+    t0 = time.monotonic()
+    responses = run_tcp(args.host, args.port, docs, args.concurrency)
+    report["load"] = {**summarize(responses),
+                      "wall_s": round(time.monotonic() - t0, 3)}
+    if args.burst:
+        bdocs = build_requests(args.burst, args.seed + 1, args.tenants,
+                               h_tpls, d_tpls)
+        burst = run_tcp(args.host, args.port, bdocs,
+                        concurrency=args.burst)
+        report["burst"] = summarize(burst)
+    print(json.dumps(report, indent=2))
+    ok = report["load"]["status"].get("ok", 0)
+    return 0 if ok == args.requests else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,16 @@ def cmd_analyze(args) -> int:
                      if h.get(k) is not None)
         print(f"hist {name}: count={h['count']:g} "
               f"sum={h['sum']:g}{qs}")
+    for tenant, q in sorted((a.get("tenants") or {}).items()):
+        # serving run dirs (nds_tpu/serve/): per-tenant latency line
+        print(f"tenant {tenant}: requests={q['requests']} "
+              f"p50={q.get('p50_ms')}ms p95={q.get('p95_ms')}ms "
+              f"p99={q.get('p99_ms')}ms")
+    if a.get("stale_device_times"):
+        print(f"WARNING: {len(a['stale_device_times'])} summar"
+              f"{'y' if len(a['stale_device_times']) == 1 else 'ies'} "
+              f"carry banked/stale device times — not fresh "
+              f"measurements (ndsreport diff refuses to gate on them)")
     out_dir = args.out or args.run_dir
     paths = analyze.write_outputs(a, out_dir)
     print(f"wrote {paths['analysis']} and {paths['report']}")

@@ -1,0 +1,278 @@
+"""Serving gate: the query server proven end-to-end on the CPU backend.
+
+tier-1 (via tools/static_checks.py section 10) builds tiny in-memory
+NDS + NDS-H warehouses, starts a QueryServer (``engine.backend=tpu`` —
+the device executor compiled by CPU XLA, exactly like the chaos ladder
+scenarios — with a fresh persistent plan cache), and proves the
+acceptance contract:
+
+1. **warmup** — one request per (suite, template) pays every compile;
+2. **mixed load** — literal-VARIANT requests across 6 templates, 3
+   tenants, 8 concurrent in flight: every request completes, with
+   ZERO compiles and ZERO plan-cache misses after warmup
+   (``compiles_total`` / ``compile_cache_misses_total`` deltas), and
+   the plan-cache entry count UNCHANGED from warmup — same-template
+   literal variants share one entry (parameterized fingerprints,
+   sql/params.py);
+3. **oracle** — every load response's result digest equals a
+   sequential power-run-style replay of the same statements on a
+   fresh session (identical engine, identical programs);
+4. **observability** — the OpenMetrics exposition validates and
+   carries tenant-labeled request counters + latency quantiles;
+   every per-request summary passes the BenchReport schema
+   (check_trace_schema --summary semantics) and ``ndsreport analyze``
+   derives per-tenant p50/p99 from the serve run dir;
+5. **brownout** — an oversubscription burst (3x the queue bound, fired
+   at once) sheds with ``server_shed_total`` > 0, every ADMITTED
+   request still completes correctly, and the server keeps answering
+   afterward (shed, never collapse);
+6. **wire** — the asyncio TCP JSON-lines front answers a short mixed
+   load (tools/ndsload.py --port against a live socket).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import ndsload  # noqa: E402
+
+SCALE = 0.01
+NDS_H_TEMPLATES = (1, 5, 6)
+NDS_TEMPLATES = (7, 96, 93)
+# every base table the three NDS templates (and their literal
+# variants) scan
+NDS_TABLES = ("store_sales", "store_returns", "date_dim", "store",
+              "customer", "customer_address", "customer_demographics",
+              "household_demographics", "item", "promotion", "reason",
+              "time_dim")
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _build_server(workdir: str):
+    from nds_tpu.datagen import tpcds as gen_d
+    from nds_tpu.datagen import tpch as gen_h
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds.schema import get_schemas as d_schemas
+    from nds_tpu.nds_h.schema import get_schemas as h_schemas
+    from nds_tpu.serve import QueryServer
+    from nds_tpu.utils.config import EngineConfig
+
+    cfg = EngineConfig(overrides={
+        "engine.backend": "tpu",
+        "cache.dir": os.path.join(workdir, "plancache"),
+        "serve.max_queue": "16",
+        "serve.summary_dir": os.path.join(workdir, "serve_json"),
+        "engine.retry.base_delay_s": "0.01",
+    })
+    srv = QueryServer(cfg)
+    for t, sch in h_schemas().items():
+        srv.register_table(
+            from_arrays(t, sch, gen_h.gen_table(t, SCALE)), "nds_h")
+    ds = d_schemas()
+    for t in NDS_TABLES:
+        srv.register_table(
+            from_arrays(t, ds[t], gen_d.gen_table(t, SCALE)), "nds")
+    return srv, cfg
+
+
+def _cache_entry_count(cfg) -> int:
+    from nds_tpu.cache.store import PlanCache
+    return len(PlanCache(cfg.get("cache.dir"), readonly=True).entries())
+
+
+def _oracle_digests(srv, docs: list) -> dict:
+    """Sequential replay on fresh sessions sharing the server's table
+    registries and plan cache (readonly consult): qname -> digest."""
+    from nds_tpu.engine.scheduler import make_pipeline
+    from nds_tpu.engine.session import Session
+    from nds_tpu.io.result_io import result_digest
+    sessions = {
+        "nds": Session.for_nds(
+            make_pipeline(srv.config, "tpu"), parameterize=True),
+        "nds_h": Session.for_nds_h(
+            make_pipeline(srv.config, "tpu"), parameterize=True),
+    }
+    for suite, s in sessions.items():
+        s.tables = srv.sessions[suite].tables
+    out = {}
+    for doc in docs:
+        res = sessions[doc["suite"]].sql(doc["sql"])
+        out[doc["qname"]] = result_digest(res)
+    return out
+
+
+def run_serve_gate(workdir: str) -> int:
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.obs.snapshot import to_openmetrics, validate_openmetrics
+
+    srv, cfg = _build_server(workdir)
+    srv.start()
+    try:
+        # -- 1: warmup pays every compile
+        warm = ndsload.run_inproc(
+            srv, ndsload.warmup_docs(7, NDS_H_TEMPLATES,
+                                     NDS_TEMPLATES), 1)
+        ws = ndsload.summarize(warm)
+        if ws["status"].get("ok") != len(warm):
+            return _fail(f"warmup did not complete clean: {ws}")
+        entries_warm = _cache_entry_count(cfg)
+        if entries_warm < len(NDS_H_TEMPLATES) + len(NDS_TEMPLATES):
+            return _fail(f"warmup persisted only {entries_warm} "
+                         f"plan-cache entries")
+
+        # -- 2: mixed literal-variant load, zero compiles/misses, no
+        #       new cache entries (variants share one fingerprint)
+        before = obs_metrics.snapshot()
+        docs = ndsload.build_requests(24, 7, tenants=3,
+                                      nds_h_templates=NDS_H_TEMPLATES,
+                                      nds_templates=NDS_TEMPLATES)
+        resp = ndsload.run_inproc(srv, docs, 8)
+        ls = ndsload.summarize(resp)
+        if ls["status"].get("ok") != len(docs):
+            return _fail(f"load phase not fully ok: {ls}")
+        delta = obs_metrics.delta(
+            before, obs_metrics.snapshot()).get("counters", {})
+        if delta.get("compiles_total", 0) != 0:
+            return _fail(f"warm load compiled "
+                         f"{delta['compiles_total']} programs")
+        if delta.get("compile_cache_misses_total", 0) != 0:
+            return _fail(f"warm load missed the plan cache "
+                         f"{delta['compile_cache_misses_total']}x")
+        if _cache_entry_count(cfg) != entries_warm:
+            return _fail(
+                f"literal variants minted new cache entries "
+                f"({entries_warm} -> {_cache_entry_count(cfg)})")
+        if srv.stats["max_inflight"] < 4:
+            return _fail(f"peak in-flight {srv.stats['max_inflight']} "
+                         f"< 4 concurrent requests")
+        print(f"OK: load {len(docs)} literal-variant requests, "
+              f"0 compiles, 0 cache misses, {entries_warm} shared "
+              f"entries, p99={ls['latency_ms'].get('p99')}ms, "
+              f"max_inflight={srv.stats['max_inflight']}, "
+              f"batched={srv.stats['batched']}")
+
+        # -- 3: sequential oracle parity (digest-exact: same engine,
+        #       same compiled programs)
+        oracle = _oracle_digests(srv, docs)
+        for r in resp:
+            if r.get("digest") != oracle.get(r.get("qname")):
+                return _fail(f"{r.get('qname')}: served digest "
+                             f"{r.get('digest')} != oracle "
+                             f"{oracle.get(r.get('qname'))}")
+        print(f"OK: {len(resp)} responses digest-identical to the "
+              f"sequential oracle")
+
+        # -- 4: observability — OpenMetrics + summaries + analyze
+        om = to_openmetrics(obs_metrics.snapshot())
+        errs = validate_openmetrics(om)
+        if errs:
+            return _fail(f"OpenMetrics invalid: {errs[:3]}")
+        for needle in ('server_requests_total{tenant="tenant0"}',
+                       'tenant="tenant0",quantile="0.99"',
+                       'tenant="tenant0",quantile="0.50"'):
+            if needle not in om:
+                return _fail(f"OpenMetrics missing {needle!r}")
+        import check_trace_schema
+        sdir = cfg.get("serve.summary_dir")
+        summaries = [f for f in os.listdir(sdir) if f.endswith(".json")]
+        if len(summaries) < len(docs):
+            return _fail(f"only {len(summaries)} serve summaries "
+                         f"written")
+        serrs = []
+        for f in summaries:
+            serrs.extend(check_trace_schema.validate_summary_file(
+                os.path.join(sdir, f)))
+        if serrs:
+            return _fail(f"serve summary schema errors: {serrs[:3]}")
+        from nds_tpu.obs import analyze
+        analysis = analyze.analyze_run(sdir)
+        tenants = analysis.get("tenants") or {}
+        if "tenant0" not in tenants or "p99_ms" not in tenants.get(
+                "tenant0", {}):
+            return _fail(f"ndsreport analyze derived no per-tenant "
+                         f"quantiles: {tenants}")
+        print(f"OK: OpenMetrics valid with tenant labels, "
+              f"{len(summaries)} schema-clean summaries, analyze "
+              f"p99={tenants['tenant0']['p99_ms']}ms for tenant0")
+
+        # -- 5: brownout — 3x queue-bound burst sheds, never collapses
+        bdocs = ndsload.build_requests(48, 8, tenants=3,
+                                       nds_h_templates=NDS_H_TEMPLATES,
+                                       nds_templates=NDS_TEMPLATES)
+        burst = ndsload.burst_inproc(srv, bdocs)
+        bs = ndsload.summarize(burst)
+        shed = bs["status"].get("shed", 0)
+        bad = bs["status"].get("error", 0)
+        if shed == 0:
+            return _fail(f"overload burst shed nothing: {bs}")
+        if bad:
+            return _fail(f"burst produced {bad} errors (shed-not-fail "
+                         f"contract): {bs}")
+        # every ADMITTED burst request completed with oracle rows
+        admitted = [r for r in burst if r.get("status") == "ok"]
+        byname = {d["qname"]: d for d in bdocs}
+        boracle = _oracle_digests(
+            srv, [byname[r["qname"]] for r in admitted])
+        for r in admitted:
+            if r.get("digest") != boracle.get(r.get("qname")):
+                return _fail(f"burst {r.get('qname')}: served digest "
+                             f"!= oracle under overload")
+        if obs_metrics.snapshot()["counters"].get(
+                "server_shed_total", 0) <= 0:
+            return _fail("server_shed_total did not move")
+        # the server still answers after the burst
+        post = ndsload.run_inproc(
+            srv, ndsload.build_requests(4, 9, tenants=1,
+                                        nds_h_templates=NDS_H_TEMPLATES,
+                                        nds_templates=NDS_TEMPLATES), 2)
+        ps = ndsload.summarize(post)
+        if ps["status"].get("ok") != 4:
+            return _fail(f"server unhealthy after burst: {ps}")
+        print(f"OK: burst shed {shed}/{len(burst)} with "
+              f"{bs['status'].get('ok', 0)} admitted completions; "
+              f"server healthy after")
+
+        # -- 6: the TCP JSON-lines front serves a short mixed load
+        async def _tcp_phase():
+            from nds_tpu.serve.net import request_many, start_tcp
+            tcp = await start_tcp(srv, "127.0.0.1", 0)
+            port = tcp.sockets[0].getsockname()[1]
+            tdocs = ndsload.build_requests(
+                8, 11, tenants=2, nds_h_templates=NDS_H_TEMPLATES,
+                nds_templates=NDS_TEMPLATES)
+            out = await request_many("127.0.0.1", port, tdocs, 4)
+            tcp.close()
+            await tcp.wait_closed()
+            return out
+
+        tcp_resp = asyncio.run(_tcp_phase())
+        ts = ndsload.summarize(tcp_resp)
+        if ts["status"].get("ok") != len(tcp_resp):
+            return _fail(f"TCP front failed requests: {ts}")
+        print(f"OK: TCP front answered {len(tcp_resp)}/"
+              f"{len(tcp_resp)} requests")
+        return 0
+    finally:
+        srv.stop()
+
+
+def main(argv=None) -> int:
+    with tempfile.TemporaryDirectory(prefix="nds_serve_check_") as wd:
+        rc = run_serve_gate(wd)
+    print("SERVE CHECK", "OK" if rc == 0 else "FAILED")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

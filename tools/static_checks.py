@@ -67,6 +67,19 @@ the one to run locally before pushing:
                         uninterrupted run, the merged phase report +
                         ndsreport bill merged incarnations once, and
                         the torn-state path never fired
+ 10. serve              query-server smoke (tools/serve_check.py): a
+                        warmed QueryServer (nds_tpu/serve/) handles a
+                        mixed NDS+NDS-H literal-variant load at >=4
+                        concurrent in-flight requests with ZERO
+                        compiles and zero plan-cache misses
+                        (parameterized fingerprints: variants share
+                        one cache entry), responses digest-identical
+                        to a sequential oracle, tenant-labeled
+                        OpenMetrics + schema-clean per-request
+                        summaries + per-tenant p50/p99 via ndsreport
+                        analyze, an overload burst sheds
+                        (server_shed_total > 0) without a single
+                        error, and the TCP JSON-lines front answers
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -89,6 +102,7 @@ import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
+import serve_check  # noqa: E402
 import soak_check  # noqa: E402
 
 
@@ -157,6 +171,7 @@ def main() -> int:
         ("ndsperf", lambda: ndsperf.main(["--smoke"])),
         ("fleet", fleet_check.main),
         ("soak", lambda: soak_check.main([])),
+        ("serve", lambda: serve_check.main([])),
     ]
     failed = []
     for name, fn in sections:
