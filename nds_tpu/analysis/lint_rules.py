@@ -894,6 +894,65 @@ class BlockingInAsyncRule(Rule):
         return out
 
 
+class EarlyMaterializationRule(Rule):
+    """NDS116: decoding dictionary codes to string bytes inside the
+    engine/parallel dataflow outside the result compactor. The
+    columnar contract (nds_tpu/columnar/; README "Compressed columnar
+    store") is LATE materialization: operators consume int32 codes /
+    packed words end-to-end and values materialize exactly once, at
+    ``_materialize``. A ``col.decode()`` call or a
+    ``something.dictionary[...]`` gather anywhere else in the engine
+    re-inflates a column to full width mid-plan — the exact bytes the
+    compressed store exists to never move. The CPU oracle
+    (``engine/cpu_exec.py``) and host-side DML (``engine/dml.py``)
+    materialize BY CONTRACT (they are the host reference semantics,
+    not device dataflow) and are exempt by path; host-side *planning*
+    uses elsewhere carry waivers saying so."""
+
+    id = "NDS116"
+    name = "early-materialization"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+    ALLOWED = ("engine/cpu_exec.py", "engine/dml.py")
+
+    @staticmethod
+    def _in_materialize(funcs: list, node: ast.AST) -> bool:
+        for f in funcs:
+            if f.name in ("_materialize", "materialize") and any(
+                    ch is node for ch in ast.walk(f)):
+                return True
+        return False
+
+    def check(self, tree, src, path):
+        norm = path.replace("\\", "/")
+        if any(a in norm for a in self.ALLOWED):
+            return []
+        out = []
+        funcs = list(_walk_funcs(tree))
+        for n in ast.walk(tree):
+            hit = None
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "decode"
+                    and not n.args and not n.keywords):
+                hit = (".decode() materializes a dictionary column "
+                       "to python values")
+            elif (isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Attribute)
+                    and n.value.attr == "dictionary"):
+                hit = (".dictionary[...] gathers string bytes "
+                       "through the dictionary")
+            if hit is None or self._in_materialize(funcs, n):
+                continue
+            out.append(LintViolation(
+                self.id, path, n.lineno,
+                f"{hit} outside the result compactor — the engine "
+                f"operates on codes end-to-end (late "
+                f"materialization, nds_tpu/columnar/); decode at "
+                f"_materialize, or waive with why this site is "
+                f"host-side planning, not dataflow"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
@@ -901,7 +960,7 @@ def default_rules() -> "list[Rule]":
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
             UncachedCompileRule(), Int64EmulationHazardRule(),
             DirectProfilerRule(), UnchainedSignalHandlerRule(),
-            BlockingInAsyncRule()]
+            BlockingInAsyncRule(), EarlyMaterializationRule()]
 
 
 # -------------------------------------------------------------- driver

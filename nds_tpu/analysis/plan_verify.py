@@ -602,6 +602,90 @@ def _dtype_width(dt: DType) -> int:
     return 8
 
 
+def _scan_bytes(table, output, nrows: int,
+                encoded: "bool | None" = None) -> int:
+    """Bytes a device scan of these columns moves. With a real
+    HostTable and an active columnar mode (nds_tpu/columnar/) the
+    per-column ENCODED widths apply — so the scheduler cost model and
+    the MemoryGovernor's pre-admission budget both see the compressed
+    working set (an SF that only fits encoded must not be demoted off
+    device on raw arithmetic). ``encoded=False`` forces raw widths —
+    the scheduler passes it when costing a placement that uploads raw
+    (the sharded SPMD path opts out of columnar upload, so shrinking
+    ITS working-set math by the compression ratio would under-admit).
+    Catalog-only estimates (and mode off) keep the raw device-width
+    formula."""
+    cols = getattr(table, "columns", None)
+    if cols is not None:
+        from nds_tpu import columnar
+        if columnar.enabled() and encoded is not False:
+            total = 0
+            for name, dt in output:
+                col = cols.get(name)
+                total += (columnar.scan_nbytes(col)
+                          if col is not None
+                          else _dtype_width(dt) * nrows)
+            return total
+    return nrows * sum(_dtype_width(dt) for _n, dt in output)
+
+
+def check_encoding_spec(spec, values, mask, nrows=None) -> list:
+    """Invariants for one column-encoding choice (nds_tpu/columnar/):
+    violations mean the spec cannot faithfully reproduce the column.
+    Run at encode time under the verify gate (always on in tests).
+    ``nrows`` bounds the LIVE prefix — pad rows past it are gated by
+    the row mask at trace time and may clip freely."""
+    import numpy as np
+    out = []
+    kind = getattr(spec, "kind", None)
+    if kind not in ("bitpack", "rle", "raw"):
+        out.append(f"unknown encoding kind {kind!r}")
+        return out
+    if spec.rows != len(values):
+        out.append(f"{kind}: spec rows {spec.rows} != column rows "
+                   f"{len(values)}")
+    if spec.dtype != values.dtype.name:
+        out.append(f"{kind}: spec dtype {spec.dtype!r} != column "
+                   f"dtype {values.dtype.name!r}")
+    if kind == "bitpack":
+        if spec.bits not in (1, 2, 4, 8, 16, 32):
+            out.append(f"bitpack: unsupported width {spec.bits}")
+        else:
+            live = values if nrows is None else values[:nrows]
+            lmask = mask if nrows is None or mask is None \
+                else mask[:nrows]
+            live = live if lmask is None else live[lmask]
+            if len(live):
+                lo, hi = int(live.min()), int(live.max())
+                top = spec.lo + ((2**31 - 1) if spec.bits >= 32
+                                 else (1 << spec.bits) - 1)
+                if lo < spec.lo or hi > top:
+                    out.append(
+                        f"bitpack: values [{lo},{hi}] exceed packed "
+                        f"range [{spec.lo},{top}] — decode would "
+                        f"clip live data")
+    elif kind == "rle":
+        if mask is not None:
+            out.append("rle: null-masked column cannot RLE (runs "
+                       "would splice null and live values)")
+        if np.issubdtype(values.dtype, np.floating):
+            out.append("rle: float column cannot RLE (value-equality "
+                       "runs splice -0.0/+0.0; decode would flip "
+                       "signbits vs the raw upload)")
+        live = values if nrows is None else values[:nrows]
+        if len(live) >= 2:
+            actual = int(np.count_nonzero(
+                live[1:] != live[:-1])) + 1
+        else:
+            actual = len(live)
+        if spec.runs != actual:
+            out.append(f"rle: spec runs {spec.runs} != actual "
+                       f"{actual}")
+    if spec.mask_packed and mask is None:
+        out.append(f"{kind}: mask_packed without a null mask")
+    return out
+
+
 @dataclass
 class PlanEstimate:
     """Static size estimate for one planned statement — the cost-model
@@ -623,14 +707,17 @@ class PlanEstimate:
 
 
 def estimate_plan(planned: P.PlannedQuery, tables: "dict | None" = None,
-                  catalog=None) -> PlanEstimate:
+                  catalog=None,
+                  encoded: "bool | None" = None) -> PlanEstimate:
     """Scan-level size estimate over every root (scalar subplans
     included). Row counts prefer the executor's registered HostTables
     (exact); the catalog's ``sizes`` statistics (relative row weights)
     are the planning-time fallback. Unknown tables estimate as 0 rows —
     the scheduler treats an all-unknown plan as small, which is the
     conservative direction for placement (the ladder recovers from an
-    underestimate; overestimating would pin small queries off-device)."""
+    underestimate; overestimating would pin small queries off-device).
+    ``encoded=False`` forces raw scan widths even under an active
+    columnar mode (see ``_scan_bytes``)."""
     est = PlanEstimate(tables={})
     if not isinstance(planned, P.PlannedQuery):
         return est
@@ -653,12 +740,12 @@ def estimate_plan(planned: P.PlannedQuery, tables: "dict | None" = None,
             if not isinstance(node, P.Scan):
                 continue
             nrows = 0
-            if tables is not None and node.table in tables:
-                nrows = tables[node.table].nrows
+            t = tables.get(node.table) if tables is not None else None
+            if t is not None:
+                nrows = t.nrows
             elif catalog is not None:
                 nrows = int(catalog.sizes.get(node.table, 0))
-            width = sum(_dtype_width(dt) for _n, dt in node.output)
-            nbytes = nrows * width
+            nbytes = _scan_bytes(t, node.output, nrows, encoded)
             rows0, bytes0 = est.tables.get(node.table, (0, 0))
             # one table scanned by several Scan nodes: rows count once,
             # bytes accumulate per scan (each scan uploads its columns)

@@ -195,6 +195,12 @@ def fingerprint(planned, tables: dict, *, kind: str,
     h = hashlib.sha256()
     h.update(f"fp_v{FP_VERSION}".encode())
     h.update(code_epoch().encode())
+    # columnar encoding mode (nds_tpu/columnar/): encoded buffer sets
+    # change every program's input signature and fused decode; specs
+    # derive deterministically from table CONTENT (stamped below), so
+    # version+mode is the whole remaining degree of freedom
+    from nds_tpu import columnar
+    h.update(f"columnar={columnar.fingerprint_token()}".encode())
     h.update(kind.encode())
     h.update(canonical(planned).encode())
     for root in (extra_roots or []):

@@ -58,12 +58,13 @@ DEFAULT_CHUNK_ROWS = 1 << 20
 
 
 def _table_bytes(t: HostTable) -> int:
-    total = 0
-    for c in t.columns.values():
-        total += c.values.nbytes
-        if c.null_mask is not None:
-            total += c.null_mask.nbytes
-    return total
+    """Raw host bytes of a table — the stream/upload decision input.
+    Deliberately NOT the encoded size: streaming is about host->device
+    transfer and residency headroom for the UNREDUCED table, and a
+    table that only fits encoded should still take the chunked path's
+    conservative route (the governor's budget math is where encoded
+    widths apply — analysis/plan_verify._scan_bytes)."""
+    return sum(c.nbytes for c in t.columns.values())
 
 
 class _PhaseBExecutor(dx.DeviceExecutor):
@@ -87,15 +88,11 @@ class _PhaseBExecutor(dx.DeviceExecutor):
     def _upload(self, bufs: dict, table: str, name: str) -> None:
         pool = (self._buffers if table in self._streamed
                 else self._shared)
-        key = f"{table}.{name}"
-        if key not in pool:
-            col = self.tables[table].columns[name]
-            pool[key] = jnp.asarray(col.values)
-            if col.null_mask is not None:
-                pool[key + "#v"] = jnp.asarray(col.null_mask)
-        bufs[key] = pool[key]
-        if key + "#v" in pool:
-            bufs[key + "#v"] = pool[key + "#v"]
+        # the shared pool-placement helper also applies the columnar
+        # encoding (nds_tpu/columnar/): dimension columns upload
+        # encoded ONCE into the shared pool, reduced streamed tables
+        # encode into the executor-local pool per plan
+        self._pool_upload(pool, bufs, table, name)
 
 
 def _walk_skip(node: P.Node, skip: set):
@@ -532,6 +529,12 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 {**base, table: self._slice_table(big, s0, e0)},
                 self.float_dtype, self._buffers, plan_local)
             ex._bounds.update(full_bounds)
+            # the swap loop below rebuilds this table's buffers as
+            # RAW slices each chunk; an encoded chunk-0 program would
+            # misread them, so the chunked table uploads raw (the
+            # phase-A keep-mask scan is where streamed chunks scan
+            # encoded)
+            ex._no_encode = {table}
             parts.append(ex.execute(planned_a))  # compiles + runs chunk 0
             entry = ex._compiled[id(planned_a)]
             compiled, side = entry["compiled"], entry["side"]
@@ -668,9 +671,23 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return np.ones(n, dtype=bool)
         live_scans = scans
 
+        # encoded chunk scans (nds_tpu/columnar/): bitpack-only, with
+        # bounds from the WHOLE table, so every chunk of a column
+        # shares one spec and the compiled chunk program is reused
+        # unchanged across chunks (RLE would change shape per chunk)
+        from nds_tpu import columnar
+        chunk_specs: dict = {}
+        if columnar.enabled() and self.COLUMNAR_UPLOAD:
+            for cname in need_cols:
+                spec = columnar.chunk_spec(
+                    t.columns[cname], C, self.col_bounds(table, cname))
+                if spec is not None:
+                    chunk_specs[cname] = spec
+
         skipped: list = []
 
         def fn(bufs, n_valid):
+            from nds_tpu.columnar import device as columnar_dev
             base = jnp.arange(C, dtype=jnp.int32) < n_valid
             keep = jnp.zeros(C, dtype=bool)
             for scan in live_scans:
@@ -680,8 +697,14 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     col = t.columns[name]
                     lo, hi = self.col_bounds(table, name)
                     sdict = col.dictionary if col.is_string else None
+                    spec = chunk_specs.get(name)
+                    if spec is not None:
+                        arr, valid = columnar_dev.decode(
+                            spec, bufs, name)
+                    else:
+                        arr, valid = bufs[name], bufs.get(name + "#v")
                     ctx.cols[(scan.binding, name)] = DVal(
-                        bufs[name], bufs.get(name + "#v"), sdict, lo, hi)
+                        arr, valid, sdict, lo, hi)
                 for pred in scan.filters:
                     # PER-PREDICATE fallback: a filter the chunk
                     # program cannot evaluate (e.g. it references a
@@ -719,6 +742,17 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         if m is not None:
                             m = np.concatenate(
                                 [m, np.zeros(pad, dtype=bool)])
+                    spec = chunk_specs.get(name)
+                    if spec is not None:
+                        # every chunk encodes with the shared
+                        # full-bounds spec: shapes stay static, so
+                        # the one compiled program serves all chunks
+                        # (the padded tail past nrows clips freely)
+                        for sfx, arr in columnar.encode_values(
+                                spec, sl, m,
+                                nrows=stop - start).items():
+                            bufs[name + sfx] = jnp.asarray(arr)
+                        continue
                     bufs[name] = jnp.asarray(sl)
                     if m is not None:
                         bufs[name + "#v"] = jnp.asarray(m)
@@ -733,7 +767,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         # buffers, consulting the persistent plan cache
                         # so a warm process scans with zero compiles
                         compiled = self._keep_mask_compiled(
-                            table, scans, need_cols, C, fn, bufs)
+                            table, scans, need_cols, C, fn, bufs,
+                            chunk_specs)
                     keep_np[start:stop] = np.asarray(
                         compiled(bufs,
                                  jnp.int32(stop - start)))[:stop - start]
@@ -759,7 +794,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
             return np.ones(n, dtype=bool)
 
     def _keep_mask_compiled(self, table: str, scans: list,
-                            need_cols: list, C: int, fn, bufs: dict):
+                            need_cols: list, C: int, fn, bufs: dict,
+                            chunk_specs: "dict | None" = None):
         """AOT form of the phase-A chunk-scan program, consulted
         against the persistent plan cache (kind ``chunkscan``): the
         fingerprint folds in the scans' filter trees (extra roots),
@@ -773,7 +809,13 @@ class ChunkedExecutor(dx.DeviceExecutor):
             "chunkscan",
             {"table": table, "chunk": C, "cols": tuple(need_cols),
              "float_dtype": str(self.float_dtype),
-             "donate": KX.donate_enabled()},
+             "donate": KX.donate_enabled(),
+             # per-column chunk encodings shape the program (packed
+             # word shapes, fused decode); specs are deterministic
+             # from content+mode but the explicit fold keeps the key
+             # honest even if that ever changes
+             "enc": tuple(sorted((n, repr(s)) for n, s in
+                          (chunk_specs or {}).items()))},
             tables=self.tables, extra_roots=list(scans))
         # chunk buffers are rebuilt per chunk and used exactly once:
         # donating them halves the phase-A device residency (the keep

@@ -352,6 +352,17 @@ class DeviceExecutor:
         self._buffers: dict[str, jnp.ndarray] = {}
         self._bounds: dict[tuple, tuple] = {}
         self._compiled: dict[object, tuple] = {}
+        # columnar encoding state (nds_tpu/columnar/): buffer key ->
+        # EncSpec for every ENCODED upload (the trace's decode reads
+        # it), and the raw host bytes that buffer set replaces (the
+        # per-query compression_ratio numerator). Lives and dies with
+        # the corresponding _buffers entries.
+        self._enc_specs: dict[str, object] = {}
+        self._raw_nbytes: dict[str, float] = {}
+        # tables whose buffers are swapped in-place per chunk by the
+        # partial-agg loop upload RAW (the swap rebuilds plain value
+        # buffers; an encoded chunk-0 program would misread them)
+        self._no_encode: set = set()
         # survivor-reduced scan views keyed by (table, filter signature);
         # values are _ReducedScan or the "full" no-reduction marker.
         # (NOT named _reduced: ChunkedExecutor already uses that name
@@ -420,13 +431,19 @@ class DeviceExecutor:
             return
         self._stage_fps[temp] = fp
         self.tables[temp] = table
-        pref = temp + "."
-        for k in [k for k in self._buffers if k.startswith(pref)]:
-            del self._buffers[k]
+        self._drop_col_buffers(temp + ".")
         for k in [k for k in self._bounds if k[0] == temp]:
             del self._bounds[k]
         for k in [k for k in self._scan_views if k[0] == temp]:
             del self._scan_views[k]
+
+    def _drop_col_buffers(self, prefix: str) -> None:
+        """Free every device buffer under a key prefix together with
+        its encoding bookkeeping (a stale EncSpec surviving its buffer
+        would mis-decode whatever re-uploads under the same key)."""
+        for d in (self._buffers, self._enc_specs, self._raw_nbytes):
+            for k in [k for k in d if k.startswith(prefix)]:
+                del d[k]
 
     def _staged_effective(self, planned: P.PlannedQuery, key):
         """Resolve plan splitting for `planned`: execute + register any
@@ -500,7 +517,8 @@ class DeviceExecutor:
                 rt = self.execute(sub, key=(key, "__stage__", i))
             for k, v in self.last_timings.items():
                 if k in ("compile_ms", "execute_ms", "materialize_ms",
-                         "bytes_scanned", "ops_est"):
+                         "bytes_scanned", "bytes_scanned_raw",
+                         "ops_est"):
                     agg[k] = agg.get(k, 0.0) + v
                 elif k == "__kernels":
                     kacc = agg.setdefault("__kernels", {})
@@ -530,9 +548,7 @@ class DeviceExecutor:
         (device buffers, bounds, scan views)."""
         self.tables.pop(temp, None)
         self._stage_fps.pop(temp, None)
-        pref = temp + "."
-        for k in [k for k in self._buffers if k.startswith(pref)]:
-            del self._buffers[k]
+        self._drop_col_buffers(temp + ".")
         for k in [k for k in self._bounds if k[0] == temp]:
             del self._bounds[k]
         for k in [k for k in self._scan_views if k[0] == temp]:
@@ -583,6 +599,11 @@ class DeviceExecutor:
         if bs and timings.get("ops_est"):
             timings["ops_per_byte"] = round(
                 timings["ops_est"] / bs, 4)
+        if bs and timings.get("bytes_scanned_raw"):
+            # whole-query ratio: the folded sub-programs' raw bytes
+            # count too (staging targets exactly the big queries)
+            timings["compression_ratio"] = round(
+                timings["bytes_scanned_raw"] / bs, 4)
 
     def execute(self, planned: P.PlannedQuery, key: object = None):
         return self.execute_async(planned, key).result()
@@ -718,6 +739,7 @@ class DeviceExecutor:
             # bandwidth, not only against a host CPU
             timings["bytes_scanned"] = float(
                 sum(b.nbytes for b in bufs.values()))
+            self._attach_compression(timings, bufs)
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
@@ -737,6 +759,29 @@ class DeviceExecutor:
                                    else entry["compiled"](bufs))
         return _AsyncResult(self, planned, key, entry, timings, t1,
                             (row, outs, overflow), qspan)
+
+    def _attach_compression(self, timings: dict, bufs: dict) -> None:
+        """Per-query compression accounting (nds_tpu/columnar/):
+        ``bytes_scanned`` already measures the ENCODED buffer bytes
+        (the sum above counts what is actually resident); this adds
+        the raw bytes those buffers replace and the resulting
+        compression_ratio. Emitted only under an active mode so
+        ``columnar.encode=off`` summaries stay byte-identical."""
+        from nds_tpu import columnar
+        if not columnar.enabled():
+            return
+        raw = 0.0
+        for k, b in bufs.items():
+            base = k[:-2] if k.endswith(("#v", "#x")) else k
+            if base in self._enc_specs:
+                if k == base:
+                    raw += self._raw_nbytes.get(base, float(b.nbytes))
+            else:
+                raw += float(b.nbytes)
+        timings["bytes_scanned_raw"] = raw
+        if timings.get("bytes_scanned") and raw:
+            timings["compression_ratio"] = round(
+                raw / timings["bytes_scanned"], 4)
 
     # ------------------------------------------------- plan cache (AOT)
 
@@ -1118,9 +1163,7 @@ class DeviceExecutor:
         while len(self._scan_views) >= self.MAX_SCAN_VIEWS:
             old = self._scan_views.pop(next(iter(self._scan_views)))
             if isinstance(old, _ReducedScan):
-                for k in [k for k in self._buffers
-                          if k.startswith(old.prefix + ".")]:
-                    del self._buffers[k]
+                self._drop_col_buffers(old.prefix + ".")
         self._scan_views[ck] = rv
         return rv
 
@@ -1132,6 +1175,7 @@ class DeviceExecutor:
         ctx = cx.Context(t.nrows)
         for name, _dt in node.output:
             col = t.columns[name]
+            # ndslint: waive[NDS116] -- host-side scan-reduction planning (compile-time filter eval via the CPU evaluator), not device dataflow; nothing decoded here reaches a device buffer
             arr = col.decode() if col.is_string else col.values
             ctx.put((node.binding, name), np.asarray(arr), col.null_mask)
         # ndslint: waive[NDS110] -- expression-evaluation helper inside the device scan path, not a placement: only eval()/like_mask run, never execute()
@@ -1167,10 +1211,17 @@ class DeviceExecutor:
         mode."""
         return jnp.asarray(arr)
 
+    # encoded upload is the default; executors whose buffer layout the
+    # columnar subsystem does not understand yet (the sharded SPMD
+    # shard/pad layout) opt out wholesale and keep raw uploads even
+    # when the mode is on
+    COLUMNAR_UPLOAD = True
+
     def _upload_reduced(self, bufs: dict, rv: "_ReducedScan",
                         name: str) -> None:
         key = f"{rv.prefix}.{name}"
         if key not in self._buffers:
+            from nds_tpu import columnar
             col = self.tables[rv.table].columns[name]
             vals = col.values[rv.idx]
             nulls = (None if col.null_mask is None
@@ -1182,24 +1233,68 @@ class DeviceExecutor:
                 if nulls is not None:
                     nulls = np.concatenate(
                         [nulls, np.zeros(pad, dtype=bool)])
-            self._buffers[key] = self._reduced_to_device(vals)
-            if nulls is not None:
-                self._buffers[key + "#v"] = self._reduced_to_device(
-                    nulls)
-        bufs[key] = self._buffers[key]
-        if key + "#v" in self._buffers:
-            bufs[key + "#v"] = self._buffers[key + "#v"]
+            # reduced views re-plan their encoding on the SURVIVOR
+            # rows (runs/bounds differ from the base column; the pad
+            # tail is gated by the row mask, so its zeros must not
+            # drag the bitpack bounds down to 0 and forfeit the
+            # shrink on exactly the hot filtered-scan path); the spec
+            # lives with the buffers and evicts with them
+            spec = (columnar.plan_padded(vals, nulls, rv.nrows,
+                                         is_string=col.is_string)
+                    if self.COLUMNAR_UPLOAD and columnar.enabled()
+                    else None)
+            if spec is not None:
+                for sfx, arr in columnar.encode_values(
+                        spec, vals, nulls, nrows=rv.nrows).items():
+                    self._buffers[key + sfx] = self._reduced_to_device(
+                        arr)
+                self._enc_specs[key] = spec
+                self._raw_nbytes[key] = float(
+                    columnar.raw_nbytes(vals, nulls))
+            else:
+                self._buffers[key] = self._reduced_to_device(vals)
+                if nulls is not None:
+                    self._buffers[key + "#v"] = self._reduced_to_device(
+                        nulls)
+        for sfx in ("", "#v", "#x"):
+            if key + sfx in self._buffers:
+                bufs[key + sfx] = self._buffers[key + sfx]
 
     def _upload(self, bufs: dict, table: str, name: str) -> None:
+        self._pool_upload(self._buffers, bufs, table, name)
+
+    def _pool_upload(self, pool: dict, bufs: dict, table: str,
+                     name: str) -> None:
+        """One host->device column placement into ``pool`` (shared by
+        the chunked engine's phase-B executors, whose pool choice
+        differs). Under an active columnar mode the column uploads in
+        its ENCODED form (nds_tpu/columnar/); the spec registers on
+        THIS executor even when a sibling sharing the pool already
+        placed the buffers — specs are deterministic per content+mode,
+        so the recomputed choice always matches the resident bytes."""
         key = f"{table}.{name}"
-        if key not in self._buffers:
-            col = self.tables[table].columns[name]
-            self._buffers[key] = jnp.asarray(col.values)
-            if col.null_mask is not None:
-                self._buffers[key + "#v"] = jnp.asarray(col.null_mask)
-        bufs[key] = self._buffers[key]
-        if key + "#v" in self._buffers:
-            bufs[key + "#v"] = self._buffers[key + "#v"]
+        col = self.tables[table].columns[name]
+        from nds_tpu import columnar
+        spec = (columnar.column_spec(col)
+                if (self.COLUMNAR_UPLOAD and columnar.enabled()
+                    and table not in self._no_encode)
+                else None)
+        if key not in pool:
+            if spec is not None:
+                for sfx, arr in columnar.encode_column(
+                        spec, col).items():
+                    pool[key + sfx] = jnp.asarray(arr)
+            else:
+                pool[key] = jnp.asarray(col.values)
+                if col.null_mask is not None:
+                    pool[key + "#v"] = jnp.asarray(col.null_mask)
+        if spec is not None:
+            self._enc_specs[key] = spec
+            self._raw_nbytes[key] = float(
+                columnar.raw_nbytes(col.values, col.null_mask))
+        for sfx in ("", "#v", "#x"):
+            if key + sfx in pool:
+                bufs[key + sfx] = pool[key + sfx]
 
     def col_is_sorted(self, table: str, name: str) -> bool:
         """Host-cached: column is non-null and nondecreasing. The
@@ -1377,8 +1472,18 @@ class _Trace:
         ctx = DCtx(n, row)
         for name, _dt in node.output:
             col = t.columns[name]
-            arr = self.bufs[f"{prefix}.{name}"]
-            valid = self.bufs.get(f"{prefix}.{name}#v")
+            key = f"{prefix}.{name}"
+            spec = self.ex._enc_specs.get(key)
+            if spec is not None:
+                # encoded buffer set (nds_tpu/columnar/): the decode
+                # traces INTO this program, so XLA fuses the unpack
+                # into every consumer and the full-width values never
+                # round-trip through HBM
+                from nds_tpu.columnar import device as columnar_dev
+                arr, valid = columnar_dev.decode(spec, self.bufs, key)
+            else:
+                arr = self.bufs[key]
+                valid = self.bufs.get(key + "#v")
             if arr.shape[0] == 0:
                 arr = jnp.zeros((1,), dtype=arr.dtype)
                 valid = None
@@ -1496,8 +1601,13 @@ class _Trace:
         return acc
 
     # bound on memoized dictionary unions (each entry pins two host
-    # dictionaries plus two host remap tables)
-    MAX_UNION_CACHE = 256
+    # dictionaries plus two host remap tables): ``columnar.
+    # dict_union_cap`` / NDS_TPU_DICT_UNION_CAP — a serving workload
+    # cycling many table pairs thrashed the old hard 256 silently
+    @staticmethod
+    def _union_cap() -> int:
+        from nds_tpu import columnar
+        return columnar.dict_union_cap()
 
     def _dict_union(self, lsd, rsd):
         """Memoized string-dictionary union for one (left, right)
@@ -1515,7 +1625,8 @@ class _Trace:
             union = np.union1d(lsd.astype(str), rsd.astype(str))
             lmap = np.searchsorted(union, lsd.astype(str))
             rmap = np.searchsorted(union, rsd.astype(str))
-            while len(ex._union_cache) >= self.MAX_UNION_CACHE:
+            cap = self._union_cap()
+            while len(ex._union_cache) >= cap:
                 ex._union_cache.pop(next(iter(ex._union_cache)))
             # the stored tuple pins both keyed dictionaries, and the
             # identity re-check above rejects any recycled address

@@ -571,6 +571,16 @@ class ExecutionPipeline:
             rungs = rungs[:rungs.index(self.floor) + 1]
         return rungs
 
+    def _encoded_estimates(self) -> bool:
+        """Whether size estimates may use the columnar ENCODED widths:
+        only when the universe's fast placement actually consumes
+        encoded buffers. The sharded SPMD path uploads raw
+        (DistributedExecutor.COLUMNAR_UPLOAD = False), so costing it
+        at encoded widths would under-count residency by the
+        compression ratio and admit queries that then OOM on device —
+        the reactive failure the cost model exists to prevent."""
+        return self.universe[0] != SHARDED
+
     def _initial_placement(self, planned, qname) -> tuple:
         self._gov_shrink = False
         if self.forced:
@@ -580,7 +590,8 @@ class ExecutionPipeline:
         catalog = None
         from nds_tpu.analysis import plan_verify
         est = plan_verify.estimate_plan(planned, tables=self._tables,
-                                        catalog=catalog)
+                                        catalog=catalog,
+                                        encoded=self._encoded_estimates())
         placement, why = self.cost_model.choose(
             planned, self.universe, tables=self._tables,
             catalog=catalog, qname=qname, est=est)
@@ -634,7 +645,9 @@ class ExecutionPipeline:
         if self.governor is None or self._multi:
             return 0, 0
         from nds_tpu.analysis import plan_verify
-        est = plan_verify.estimate_plan(planned, tables=self._tables)
+        est = plan_verify.estimate_plan(
+            planned, tables=self._tables,
+            encoded=self._encoded_estimates())
         return self.governor.project(est), self.governor.budget
 
     def choose_placement(self, planned, qname: "str | None" = None,
@@ -643,9 +656,14 @@ class ExecutionPipeline:
         the bench planners): -> (placement, reason)."""
         if self.forced:
             return self.forced, "forced"
+        from nds_tpu.analysis import plan_verify
+        est = plan_verify.estimate_plan(
+            planned, tables=self._tables, catalog=catalog,
+            encoded=self._encoded_estimates())
         return self.cost_model.choose(planned, self.universe,
                                       tables=self._tables,
-                                      catalog=catalog, qname=qname)
+                                      catalog=catalog, qname=qname,
+                                      est=est)
 
     def execute(self, planned, key: object = None):
         qname = self._current_query()

@@ -42,6 +42,14 @@ class HostColumn:
     def is_string(self) -> bool:
         return self.dictionary is not None
 
+    @property
+    def nbytes(self) -> int:
+        """Raw host bytes (values + null mask) — the uncompressed
+        width the columnar subsystem (nds_tpu/columnar/) measures its
+        encodings against."""
+        return int(self.values.nbytes) + (
+            0 if self.null_mask is None else int(self.null_mask.nbytes))
+
     def decode(self) -> np.ndarray:
         """Materialize python-visible values (strings decoded)."""
         if self.is_string:

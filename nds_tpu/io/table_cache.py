@@ -35,6 +35,15 @@ def save_table(dirpath: str, table: HostTable) -> str:
     # on-disk rot (io/integrity.py; verification gated on load)
     from nds_tpu.io import integrity
     integrity.update_manifest(dirpath, [f"{table.name}.npz"])
+    # columnar encoding metadata rides the same manifest (nds_tpu/
+    # columnar/): the load-time encoding choice round-trips with the
+    # artifact instead of being re-derived on every process start
+    from nds_tpu import columnar
+    if columnar.enabled():
+        columnar.manifest_set_encodings(
+            dirpath, table.name,
+            columnar.table_specs(table))
+        integrity.clear_cache()  # the manifest just changed on disk
     return path
 
 
@@ -55,6 +64,17 @@ def load_table(dirpath: str, name: str, schema: Schema) -> HostTable | None:
             dictionary = data[f"{f.name}::dict"].astype(object)
         mask = data.get(f"{f.name}::mask")
         cols[f.name] = HostColumn(f.dtype, data[key], dictionary, mask)
+    # restore persisted encoding choices (written by save_table under
+    # an active columnar mode): seeds the per-column spec memo so the
+    # executors encode without re-deriving stats — and stale entries
+    # (row-count drift, other mode/version) are rejected per column
+    from nds_tpu import columnar
+    if columnar.enabled():
+        persisted = columnar.manifest_encodings(dirpath, name)
+        if persisted:
+            for cname, spec in persisted.items():
+                if cname in cols:
+                    columnar.seed_column_spec(cols[cname], spec)
     return HostTable(name, schema, cols)
 
 

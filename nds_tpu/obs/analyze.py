@@ -156,6 +156,13 @@ def attribute_query(summary: dict) -> dict:
     for k in ("ops_per_byte", "roofline_frac"):
         if isinstance(et.get(k), (int, float)):
             row[k] = float(et[k])
+    # columnar compression (nds_tpu/columnar/): encoded bytes the
+    # query actually scanned, plus the ratio vs raw when the
+    # compressed store was active (absent rows keep pre-columnar run
+    # dirs analyzing byte-identically)
+    for k in ("bytes_scanned", "compression_ratio"):
+        if isinstance(et.get(k), (int, float)):
+            row[k] = float(et[k])
     # on-demand XLA capture (obs/profile.py; README "Fleet &
     # profiling"): which trigger fired and where the capture landed
     prof = summary.get("profile")
@@ -493,6 +500,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     has_cache = any("cache_hits" in r for r in rows)
     has_roofline = any("ops_per_byte" in r or "roofline_frac" in r
                        for r in rows)
+    has_bytes = any("bytes_scanned" in r for r in rows)
     has_profile = any("profile" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
@@ -500,6 +508,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         + ("  placement" if has_placement else "")
         + ("  cache" if has_cache else "")
         + ("   roofline" if has_roofline else "")
+        + ("         bytes" if has_bytes else "")
         + ("  profile" if has_profile else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
@@ -539,6 +548,17 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
                     + "@"
                     + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
             roof_col = f"  {cell:>9}"
+        bytes_col = ""
+        if has_bytes:
+            # encoded scan bytes + compression ratio ("1.9M x5.0"):
+            # how much the columnar store shrank this query's HBM
+            # traffic (README "Compressed columnar store")
+            bs = r.get("bytes_scanned")
+            cell = "-" if bs is None else _fmt_bytes(bs)
+            cr = r.get("compression_ratio")
+            if cr is not None:
+                cell += f" x{cr:.1f}"
+            bytes_col = f"  {cell:>12}"
         prof_col = ""
         if has_profile:
             prof_col = ("  {:>7}".format(
@@ -546,7 +566,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + cache_col + roof_col + prof_col
+            + place + cache_col + roof_col + bytes_col + prof_col
             + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
@@ -677,6 +697,40 @@ def kernel_changes(base_rows: dict, cur_rows: dict) -> list:
     return out
 
 
+# absolute floor for the bytes_scanned gate: sub-MiB wobble (a reduced
+# scan view flipping on a borderline survivor count) is noise, a MiB+
+# growth is a real bandwidth regression
+BYTES_ABS_FLOOR = 1 << 20
+
+
+def bytes_changes(base_rows: dict, cur_rows: dict,
+                  pct: float = 10.0) -> list:
+    """Per-query ``bytes_scanned`` changes between two runs, gated the
+    same way steady-state time is: a query whose scanned bytes grew by
+    BOTH >pct% and >=1 MiB carries ``regressed: True`` and fails the
+    diff — the engine is bandwidth-bound, so silently re-inflating the
+    scan working set (an encoding demoted to raw, a reduced view lost)
+    is a perf regression even when the fixture machine hid the time.
+    Queries without the field on either side (pre-columnar run dirs)
+    are skipped; a side MISSING it entirely is flagged but never
+    fails the gate (first diff across the feature boundary)."""
+    out = []
+    for name in sorted(set(base_rows) & set(cur_rows)):
+        b = base_rows[name].get("bytes_scanned")
+        c = cur_rows[name].get("bytes_scanned")
+        if b is None and c is None:
+            continue
+        if b == c:
+            continue
+        entry = {"query": name, "base_bytes": b, "cur_bytes": c}
+        if (b is not None and c is not None
+                and c - b >= BYTES_ABS_FLOOR
+                and c > b * (1 + pct / 100.0)):
+            entry["regressed"] = True
+        out.append(entry)
+    return out
+
+
 def cache_hit_rate(analysis: dict) -> "dict | None":
     """Run-level plan-cache summary from the per-query rows:
     ``{"hits", "misses", "rate"}`` (rate = hits / consults), or None
@@ -731,14 +785,22 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
     # the fixture machine was fast that day
     kchanges = kernel_changes(b_rows, c_rows)
     demoted = [e["query"] for e in kchanges if e.get("demoted")]
+    # bytes_scanned regressions gate like steady-state time: the
+    # roofline says these queries are bandwidth-bound, so scanned
+    # bytes ARE a perf surface (README "Compressed columnar store")
+    bchanges = bytes_changes(b_rows, c_rows, pct=pct)
+    bytes_regressed = [e["query"] for e in bchanges
+                       if e.get("regressed")]
     d.update({
         "base_dir": base.get("run_dir"),
         "cur_dir": cur.get("run_dir"),
         "compile_changes": compile_changes,
         "kernel_changes": kchanges,
+        "bytes_changes": bchanges,
         "newly_failed": newly_failed,
         "passed": not d["regressions"] and not d["removed"]
-                  and not newly_failed and not demoted,
+                  and not newly_failed and not demoted
+                  and not bytes_regressed,
     })
     # plan-cache hit-rate per run, the compile-count-change flag's
     # natural companion: a run whose compile counts dropped to 0
@@ -792,6 +854,15 @@ def format_diff(d: dict) -> str:
         lines.append(
             f"  {label:<11} {e['query']:<14} "
             f"{_mix(e['base'])} -> {_mix(e['cur'])}")
+    for e in d.get("bytes_changes", []):
+        # widest label in this block is BYTES-REGRESSED (15): pad the
+        # whole block to it so flagged rows don't shear the columns
+        label = "BYTES-REGRESSED" if e.get("regressed") else "bytes"
+        def _b(v):
+            return "-" if v is None else _fmt_bytes(v)
+        lines.append(
+            f"  {label:<15} {e['query']:<14} "
+            f"{_b(e['base_bytes'])} -> {_b(e['cur_bytes'])}")
     chr_ = d.get("cache_hit_rate") or {}
     if any(chr_.get(k) for k in ("base", "cur")):
         def _rate(r):
@@ -978,7 +1049,7 @@ def render_html(analysis: dict, diff: dict | None = None,
         "<table><tr><th class='q'>query</th><th>wall ms</th>"
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
         "<th>cache</th><th>retries</th><th>placement</th>"
-        "<th>kernels</th><th>roofline</th>"
+        "<th>kernels</th><th>roofline</th><th>bytes</th>"
         "<th>straggler</th><th>profile</th>"
         "<th>mem HWM</th><th>status</th></tr>",
     ]
@@ -1005,6 +1076,12 @@ def render_html(analysis: dict, diff: dict | None = None,
         if ob is not None or rf is not None:
             roof = ((f"{ob:.2f}" if ob is not None else "?") + " @ "
                     + (f"{rf * 100.0:.0f}%" if rf is not None else "?"))
+        # encoded scan bytes + compression ratio (nds_tpu/columnar/)
+        bcell = ""
+        if row.get("bytes_scanned") is not None:
+            bcell = _fmt_bytes(row["bytes_scanned"])
+            if row.get("compression_ratio") is not None:
+                bcell += f" &times;{row['compression_ratio']:.1f}"
         strag = ""
         if row.get("straggler"):
             s = row["straggler"]
@@ -1023,6 +1100,7 @@ def render_html(analysis: dict, diff: dict | None = None,
             f"<td>{row['retries']}</td>"
             f"<td>{place}</td>"
             f"<td class='q'>{kern}</td><td>{roof}</td>"
+            f"<td>{bcell}</td>"
             f"<td>{strag}</td><td>{prof}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")

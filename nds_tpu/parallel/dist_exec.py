@@ -77,6 +77,15 @@ class DistributedExecutor(dx.DeviceExecutor):
     # shard layout as their capacity story
     SCAN_REDUCE = True
 
+    # columnar encoding (nds_tpu/columnar/) stays OFF on the sharded
+    # path: packed words don't align with the shard/pad row layout
+    # (a row's field may straddle a shard boundary word) and RLE run
+    # ends are global offsets a per-shard trace can't interpret.
+    # Sharded placements scan raw even when the mode is on — results
+    # stay identical, only the bytes win is forfeit (ROADMAP item 3
+    # owns making multi-host first-class)
+    COLUMNAR_UPLOAD = False
+
     def __init__(self, tables: dict[str, HostTable], mesh=None,
                  n_devices: int | None = None,
                  shard_tables: set[str] | None = None,

@@ -66,6 +66,28 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             stream demotion it used to trigger is now
                             the ladder + sticky demotion)
 
+Columnar keys (compressed device-resident store, nds_tpu/columnar/ —
+README "Compressed columnar store"):
+
+  columnar.encode           off (default) | auto | dict | bitpack |
+                            rle. ``auto`` picks per column from
+                            load-time stats (dictionary codes and
+                            narrow ints bitpack into int32 words,
+                            sorted fact columns run-length encode)
+                            and the engine scans/joins/aggregates the
+                            encoded form directly, decoding once
+                            inside the compiled program (late
+                            materialization). The forced modes apply
+                            ONE encoding family wherever applicable
+                            (differential debugging). ``off``
+                            preserves byte-identical pre-columnar
+                            behavior. Env: NDS_TPU_COLUMNAR.
+  columnar.dict_union_cap   bound on the executor's memoized string-
+                            dictionary unions (default 256; was a
+                            hard cap — serving workloads cycling many
+                            table pairs need it raised). Env:
+                            NDS_TPU_DICT_UNION_CAP.
+
 Serving keys (the query server, nds_tpu/serve/ — README "Serving"):
 
   serve.max_queue           admission bound: a submit that would make

@@ -182,6 +182,12 @@ def prepare_engine(config: EngineConfig) -> None:
     QueryServer._build_engine): plan-cache configuration plus the
     plan-cache/XLA-compile-cache interplay the backend requires."""
     backend = config.get("engine.backend", "cpu")
+    # columnar.encode/columnar.dict_union_cap activate the compressed
+    # device-resident store (nds_tpu/columnar/; README "Compressed
+    # columnar store"); configs without the keys defer to
+    # NDS_TPU_COLUMNAR, and `off` keeps byte-identical raw behavior
+    from nds_tpu import columnar
+    columnar.configure_from(config)
     # cache.dir/cache.readonly activate the persistent AOT plan cache
     # for every executor this session schedules (README "Plan cache");
     # configs without the keys leave the NDS_TPU_PLAN_CACHE env

@@ -1,0 +1,193 @@
+"""Columnar compression gate: encoded == raw results, fewer bytes.
+
+tier-1 (via tools/static_checks.py) proves the compressed
+device-resident columnar store (nds_tpu/columnar/; README "Compressed
+columnar store") end-to-end on the CPU backend:
+
+1. **power-stream parity + bytes** — a 3-query NDS-H power stream
+   (q1/q3/q6: string group keys, date-range filters, a 3-way join)
+   runs on the device placement twice — ``columnar.encode=off`` then
+   ``=auto`` — over the same generated warehouse. The gate asserts
+   every query Completed in both runs, result rows are IDENTICAL, the
+   encoded run's measured ``bytes_scanned`` never exceeds the raw
+   run's, at least one query's drops >= 2x (the ROADMAP item 4
+   acceptance shape), and every encoded summary carries a
+   ``compression_ratio``.
+2. **manifest round-trip** — a table cached via
+   ``io/table_cache.save_table`` under an active mode records its
+   per-column encoding specs in ``_manifest.json``; a fresh
+   ``load_table`` restores EXACTLY those specs (seeded memo, no
+   re-derivation), and a mode change invalidates them.
+
+The suite-level compression ratio prints for the record (the real-chip
+acceptance — SF3 NDS-H device-resident where SF1 was the ceiling —
+scales from the same per-table ratios).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCALE = 0.01
+TEMPLATES = (1, 3, 6)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _write_stream(path: str) -> None:
+    from nds_tpu.nds_h import streams as hstreams
+    parts = [f"-- Template file: {qn}\n\n"
+             f"{hstreams.render_query(qn, None, stream=0)}\n"
+             for qn in TEMPLATES]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(parts))
+
+
+def _summaries(jsons: str) -> dict:
+    out = {}
+    for name in os.listdir(jsons):
+        with open(os.path.join(jsons, name)) as f:
+            s = json.load(f)
+        if isinstance(s, dict) and "query" in s and "queryStatus" in s:
+            out[s["query"]] = s
+    return out
+
+
+def _run_stream(workdir: str, raw: str, stream: str,
+                label: str, encode: str) -> "dict | None":
+    from nds_tpu.nds_h.power import SUITE
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    jsons = os.path.join(workdir, f"json_{label}")
+    out = os.path.join(workdir, f"rows_{label}")
+    cfg = EngineConfig(overrides={
+        "engine.backend": "tpu",          # device placement on the
+        "columnar.encode": encode,        # local CPU jax backend
+    })
+    failures = power_core.run_query_stream(
+        SUITE, raw, stream, os.path.join(workdir, f"{label}.csv"),
+        config=cfg, input_format="raw", json_summary_folder=jsons,
+        output_prefix=out)
+    if failures:
+        print(f"FAIL: {failures} query failure(s) in the {label} run")
+        return None
+    return {"summaries": _summaries(jsons), "rows": out}
+
+
+def run_power_parity(workdir: str) -> int:
+    from nds_tpu.io.result_io import read_result
+    from nds_tpu.nds_h import gen_data
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "stream.sql")
+    gen_data.generate_data_local(SCALE, 2, raw, workers=2)
+    _write_stream(stream)
+    base = _run_stream(workdir, raw, stream, "rawrun", "off")
+    if base is None:
+        return 1
+    enc = _run_stream(workdir, raw, stream, "encoded", "auto")
+    if enc is None:
+        return 1
+    best = 0.0
+    for qn in TEMPLATES:
+        q = f"query{qn}"
+        b, e = base["summaries"].get(q), enc["summaries"].get(q)
+        if not b or not e:
+            return _fail(f"{q} summary missing")
+        rb = read_result(os.path.join(base["rows"], q))
+        re_ = read_result(os.path.join(enc["rows"], q))
+        if rb is None or re_ is None:
+            return _fail(f"{q} result rows missing on disk")
+        if not rb.equals(re_):
+            return _fail(f"{q} rows differ between raw and encoded")
+        bs_b = (b.get("engineTimings") or {}).get("bytes_scanned")
+        bs_e = (e.get("engineTimings") or {}).get("bytes_scanned")
+        if not bs_b or not bs_e:
+            return _fail(f"{q} missing bytes_scanned "
+                         f"(raw={bs_b!r} enc={bs_e!r})")
+        if bs_e > bs_b:
+            return _fail(f"{q} encoded run scanned MORE bytes "
+                         f"({bs_e:.0f} > {bs_b:.0f})")
+        ratio = (e.get("engineTimings") or {}).get("compression_ratio")
+        if not ratio or ratio < 1.0:
+            return _fail(f"{q} encoded summary lacks a sane "
+                         f"compression_ratio ({ratio!r})")
+        drop = bs_b / bs_e
+        best = max(best, drop)
+        print(f"  {q}: bytes {bs_b:.0f} -> {bs_e:.0f} "
+              f"({drop:.2f}x drop, ratio {ratio:.2f})")
+    if best < 2.0:
+        return _fail(f"no query dropped bytes_scanned >= 2x "
+                     f"(best {best:.2f}x)")
+    print(f"OK: power parity — rows identical, best bytes drop "
+          f"{best:.2f}x across {len(TEMPLATES)} queries")
+    return 0
+
+
+def run_manifest_roundtrip(workdir: str) -> int:
+    from nds_tpu import columnar
+    from nds_tpu.datagen import tpch as gen_h
+    from nds_tpu.io import table_cache
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds_h.schema import get_schemas
+    cache_dir = os.path.join(workdir, "tcache")
+    schema = get_schemas()["orders"]
+    table = from_arrays("orders", schema,
+                        gen_h.gen_table("orders", SCALE))
+    columnar.set_mode("auto")
+    try:
+        specs = columnar.table_specs(table)
+        encoded = {n: s for n, s in specs.items() if s is not None}
+        if not encoded:
+            return _fail("orders planned no encodings under auto")
+        table_cache.save_table(cache_dir, table)
+        loaded = table_cache.load_table(cache_dir, "orders", schema)
+        if loaded is None:
+            return _fail("cached orders failed to load back")
+        specs2 = columnar.table_specs(loaded)
+        if specs2 != specs:
+            return _fail(f"specs did not round-trip: {specs2} != "
+                         f"{specs}")
+        comp = columnar.table_compression(loaded)
+        if comp["ratio"] <= 1.0:
+            return _fail(f"orders table compression <= 1x: {comp}")
+        print(f"OK: manifest round-trip — {len(encoded)} encoded "
+              f"column(s), table ratio {comp['ratio']:.2f}x")
+    finally:
+        columnar.set_mode(None)
+    # a DIFFERENT mode must reject the persisted specs (stale-metadata
+    # guard), not silently decode with them
+    columnar.set_mode("rle")
+    try:
+        if columnar.manifest_encodings(cache_dir, "orders") is not None:
+            return _fail("mode change did not invalidate persisted "
+                         "encoding metadata")
+    finally:
+        columnar.set_mode(None)
+    print("OK: mode-change invalidation of persisted encodings")
+    return 0
+
+
+def main(argv=None) -> int:
+    with tempfile.TemporaryDirectory(prefix="nds_compress_") as wd:
+        for name, fn in (("power-parity", run_power_parity),
+                         ("manifest", run_manifest_roundtrip)):
+            print(f"-- compress_check: {name} --")
+            rc = fn(wd)
+            if rc:
+                return rc
+    print("COMPRESS CHECK OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

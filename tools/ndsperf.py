@@ -23,6 +23,18 @@ Benchmark lanes (old -> new):
   sort.width    the NDS112 lint rule's premise, measured: one
                 ``lax.sort`` of int64 keys vs the same keys as int32
 
+Encoded-vs-raw lanes (nds_tpu/columnar/; README "Compressed columnar
+store") — each measures one encoding's decode fused into its consumer
+against the same operator over raw buffers, so the bytes-vs-ALU trade
+is visible in isolation:
+
+  enc.bitpack   range filter over raw int64 vs 16-bit fields packed
+                into int32 words (gather + shift/mask unpack fused)
+  enc.rle       date-range count over a sorted raw int32 column vs
+                its run-length form (scatter+cumsum run-id rebuild)
+  enc.dictjoin  direct-address dict-code join probing raw int32
+                codes vs bit-packed codes unpacked into the gather
+
 Timing protocol: each lane jit-compiles both paths, runs one warmup
 call (compile + first-touch excluded), then reports the BEST of
 ``--repeat`` timed calls with ``block_until_ready`` inside the clock —
@@ -265,6 +277,107 @@ def lane_sort_width(n: int, rng):
     return (lambda: old(keys64)), (lambda: new(keys32)), (), check
 
 
+def lane_enc_bitpack(n: int, rng):
+    """Encoded-vs-raw filter scan (nds_tpu/columnar/): the same range
+    predicate over an int64 column read RAW vs read as 16-bit fields
+    bit-packed into int32 words with the unpack fused into the filter
+    — the lane measures whether moving 1/4 the bytes beats the extra
+    shift/mask ALU."""
+    from nds_tpu.engine import device_exec  # noqa: F401 -- x64 on
+    import jax.numpy as jnp
+    from nds_tpu.columnar import device as cdev
+    from nds_tpu.columnar.encodings import EncSpec, encode_values
+    vals = rng.integers(10_000, 40_000, n).astype(np.int64)
+    spec = EncSpec("bitpack", n, "int64", bits=16, lo=10_000)
+    words = jnp.asarray(encode_values(spec, vals)[""])
+    raw = jnp.asarray(vals)
+    lo_q, hi_q = 15_000, 25_000
+
+    # both paths take BOTH buffer sets as real jit arguments (each
+    # ignores the other's): a zero-arg closure would let XLA constant-
+    # fold the whole scan at compile time and time nothing
+    def old(v, _w):
+        return jnp.sum((v >= lo_q) & (v < hi_q))
+
+    def new(_v, w):
+        dv, _ = cdev.decode(spec, {"k": w}, "k")
+        return jnp.sum((dv >= lo_q) & (dv < hi_q))
+
+    def check(o, nw):
+        assert int(o) == int(nw), (int(o), int(nw))
+
+    return old, new, (raw, words), check
+
+
+def lane_enc_rle(n: int, rng):
+    """Encoded-vs-raw scan of a SORTED fact column (the RLE shape:
+    date / surrogate-key columns): a date-range count over the raw
+    int32 column vs the run-length form (run values + run starts;
+    run ids rebuilt by scatter + prefix sum, fused into the count)."""
+    import jax.numpy as jnp
+    from nds_tpu.columnar import device as cdev
+    from nds_tpu.columnar.encodings import plan_values, encode_values
+    # ~64 rows per run (a clustered fact date column): the RLE form
+    # must actually be smaller at every benchmarked size
+    dom = max(n // 64, 4)
+    vals = (np.sort(rng.integers(0, dom, n)).astype(np.int32)
+            + np.int32(10_000))
+    spec = plan_values(vals, mode="rle")
+    assert spec is not None and spec.kind == "rle"
+    enc = encode_values(spec, vals)
+    rv, ends = jnp.asarray(enc[""]), jnp.asarray(enc["#x"])
+    raw = jnp.asarray(vals)
+    lo_q, hi_q = 10_000 + dom // 4, 10_000 + dom // 2
+
+    def old(v, _r, _e):
+        return jnp.sum((v >= lo_q) & (v < hi_q))
+
+    def new(_v, r, e):
+        dv, _ = cdev.decode(spec, {"k": r, "k#x": e}, "k")
+        return jnp.sum((dv >= lo_q) & (dv < hi_q))
+
+    def check(o, nw):
+        assert int(o) == int(nw), (int(o), int(nw))
+
+    return old, new, (raw, rv, ends), check
+
+
+def lane_enc_dictjoin(n: int, rng):
+    """Dict-code join, raw vs packed codes: today's engine probes
+    direct-address joins with int32 dictionary codes; under the
+    columnar store the probe side's codes arrive bit-packed and
+    unpack INTO the gather. Same join, 1/2-1/4 the probe bytes."""
+    import jax.numpy as jnp
+    from nds_tpu.columnar import device as cdev
+    from nds_tpu.columnar.encodings import EncSpec, encode_values
+    from nds_tpu.engine import kernels as KX
+    dom = 4096  # dictionary size -> 16-bit codes
+    bkey = jnp.asarray(rng.permutation(dom)[:dom // 2]
+                       .astype(np.int32))
+    bok = jnp.ones(bkey.shape, bool)
+    pcodes = rng.integers(0, dom, n).astype(np.int32)
+    spec = EncSpec("bitpack", n, "int32", bits=16, lo=0)
+    pwords = jnp.asarray(encode_values(spec, pcodes)[""])
+    praw = jnp.asarray(pcodes)
+    pok = jnp.ones(n, bool)
+
+    def old(bk, bo, pk, _pw, po):
+        return KX.direct_lookup_join(bk, bo, pk, po, 0, dom)
+
+    def new(bk, bo, _pk, pw, po):
+        pk, _ = cdev.decode(spec, {"k": pw}, "k")
+        return KX.direct_lookup_join(bk, bo, pk, po, 0, dom)
+
+    def check(o, nw):
+        np.testing.assert_array_equal(np.asarray(o[1]),
+                                      np.asarray(nw[1]))
+        np.testing.assert_array_equal(
+            np.asarray(o[0])[np.asarray(o[1])],
+            np.asarray(nw[0])[np.asarray(nw[1])])
+
+    return old, new, (bkey, bok, praw, pwords, pok), check
+
+
 LANES = {
     "join.unique": lane_join_unique,
     "join.tiny": lane_join_tiny,
@@ -272,6 +385,9 @@ LANES = {
     "semi": lane_semi,
     "agg.minmax": lane_agg_minmax,
     "sort.width": lane_sort_width,
+    "enc.bitpack": lane_enc_bitpack,
+    "enc.rle": lane_enc_rle,
+    "enc.dictjoin": lane_enc_dictjoin,
 }
 
 

@@ -67,7 +67,18 @@ the one to run locally before pushing:
                         uninterrupted run, the merged phase report +
                         ndsreport bill merged incarnations once, and
                         the torn-state path never fired
- 10. serve              query-server smoke (tools/serve_check.py): a
+ 10. compress           columnar compression gate
+                        (tools/compress_check.py): a 3-query NDS-H
+                        power stream runs on the device placement
+                        encoded (columnar.encode=auto) and raw, rows
+                        must be IDENTICAL with >=2x measured
+                        bytes_scanned drop on at least one query and
+                        a compression_ratio on every encoded summary;
+                        plus the table_cache manifest round-trip of
+                        per-column encoding specs and its mode-change
+                        invalidation (nds_tpu/columnar/; README
+                        "Compressed columnar store")
+ 11. serve              query-server smoke (tools/serve_check.py): a
                         warmed QueryServer (nds_tpu/serve/) handles a
                         mixed NDS+NDS-H literal-variant load at >=4
                         concurrent in-flight requests with ZERO
@@ -97,6 +108,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
+import compress_check  # noqa: E402
 import fleet_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
@@ -171,6 +183,7 @@ def main() -> int:
         ("ndsperf", lambda: ndsperf.main(["--smoke"])),
         ("fleet", fleet_check.main),
         ("soak", lambda: soak_check.main([])),
+        ("compress", lambda: compress_check.main([])),
         ("serve", lambda: serve_check.main([])),
     ]
     failed = []
