@@ -13,8 +13,26 @@ Two subsystems live here, both gated into tier-1 by
   (id()-keyed caches without a pinning ref, raw timing calls in the
   engine, prefix-only content fingerprints, dead dataclass fields, ...),
   driven by ``tools/ndslint.py``.
+- ``concurrency``: cross-module lock-discipline auditor (guard
+  inference, the static lock-order graph, signal-handler safety,
+  thread-shared mutation), driven by ``tools/ndsraces.py``.
+- ``locksan``: the opt-in runtime lock-order sanitizer
+  (``NDS_TPU_LOCKSAN=1``) witnessing the order graph on the real
+  chaos/soak/serve workloads.
+
+The package ``__init__`` is deliberately lazy (PEP 562 re-exports):
+``locksan`` must be importable by ``obs/metrics.py`` at interpreter
+start without dragging the plan verifier's sql/engine import chain in
+behind it.
 """
 
-from nds_tpu.analysis.plan_verify import (  # noqa: F401
-    PlanVerifyError, Violation, assert_valid, verify, verify_enabled,
-)
+_PLAN_VERIFY_NAMES = frozenset(
+    ("PlanVerifyError", "Violation", "assert_valid", "verify",
+     "verify_enabled"))
+
+
+def __getattr__(name):
+    if name in _PLAN_VERIFY_NAMES:
+        from nds_tpu.analysis import plan_verify
+        return getattr(plan_verify, name)
+    raise AttributeError(name)

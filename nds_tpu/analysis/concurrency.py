@@ -1,0 +1,849 @@
+"""Concurrency auditor: lock-discipline static analysis over nds_tpu/.
+
+Every concurrency bug shipped in PRs 9-11 — the FlightRecorder pid-tmp
+truncation race, the QueryJournal write outside its lock, the profiler
+stall-hook self-deadlock, the engine thread's double-resolved batch
+futures — was found by human review AFTER landing. This module codifies
+those bug classes as cross-module ast rules the way plan bugs got
+``plan_verify``: ``tools/ndsraces.py`` drives it, ``static_checks.py``
+gates it in tier-1, and the opt-in runtime lock-order sanitizer
+(``nds_tpu/analysis/locksan.py``, ``NDS_TPU_LOCKSAN=1``) witnesses at
+runtime the order graph this module proposes statically.
+
+Rules (waiver grammar: ``# ndsraces: waive[NDSR2xx] -- why``, same
+semantics as ndslint's — mandatory justification, stale waivers fail):
+
+- NDSR201 unguarded-shared-attr  **guard inference**: per class, every
+                                 ``self._*`` attribute mutated under a
+                                 ``with self._lock`` (any lock attr of
+                                 the class) in ANY method is inferred
+                                 lock-guarded; a read or write of it in
+                                 the same class holding none of its
+                                 guard locks flags (the QueryJournal
+                                 bug: readout methods touching
+                                 ``self.state`` lock-free while the
+                                 drain thread mutates it). Methods
+                                 named ``*_locked`` declare the
+                                 caller-holds-the-guard contract and
+                                 are exempt; ``__init__`` is exempt
+                                 (construction happens-before
+                                 publication).
+- NDSR202 lock-order-cycle       **static acquisition graph**: lock A
+                                 held while acquiring B — directly
+                                 nested ``with``s or across resolved
+                                 call edges within nds_tpu/ — builds a
+                                 directed graph whose cycles are
+                                 potential deadlocks; acquiring a
+                                 non-reentrant lock already held (via a
+                                 call edge) is the degenerate cycle
+                                 (the ``request_stall_capture``
+                                 self-deadlock bug).
+- NDSR203 signal-unsafe          functions reachable from a
+                                 ``signal.signal`` registration must
+                                 not take locks (the interrupted frame
+                                 may hold them — unbounded
+                                 self-deadlock), block on a
+                                 timeout-less ``join()``/``wait()``/
+                                 ``acquire()``, or spawn subprocesses.
+                                 A ``waive[NDSR203]`` on a function's
+                                 ``def`` line declares it a BOUNDED
+                                 signal boundary (e.g. lock-taking work
+                                 delegated to a worker thread joined
+                                 with a timeout) and prunes traversal.
+- NDSR204 thread-shared-mutation objects whose methods run as a
+                                 ``threading.Thread(target=self.X)``
+                                 while other methods mutate the same
+                                 attributes lock-free (both sides
+                                 unguarded — rule 201 can't see them
+                                 because no lock discipline exists to
+                                 infer); plus ``.tmp`` names in atomic
+                                 writes that embed ``os.getpid()`` but
+                                 not ``threading.get_ident()`` in
+                                 threading modules — the flight-dump
+                                 truncation race, where two THREADS of
+                                 one pid interleave one tmp file.
+
+The call graph is best-effort by construction (``self.m()``, same-
+module and imported nds_tpu functions, plus attribute calls whose
+method name is defined by exactly one audited class); what it cannot
+resolve it skips, which under-reports rather than drowning the gate —
+the runtime sanitizer exists for exactly the dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from nds_tpu.analysis.lint_rules import (
+    LintResult, LintViolation, parse_waivers,
+)
+
+RULE_IDS = ("NDSR201", "NDSR202", "NDSR203", "NDSR204")
+META_RULE = "NDSR200"
+TOOL = "ndsraces"
+
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": False}
+_LOCKSAN_CTORS = {"lock": False, "rlock": True, "condition": False}
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|cv|cond|mutex)s?$")
+_INIT_NAMES = ("__init__", "__new__", "__post_init__", "__del__")
+# method calls that mutate the receiver's container in place
+_MUTATORS = {"append", "appendleft", "extend", "insert", "remove",
+             "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "add", "discard"}
+
+
+# --------------------------------------------------------------- index
+
+@dataclass
+class FuncNode:
+    key: str                    # "path::Qual.name"
+    path: str
+    name: str
+    node: object
+    cls: "ClassNode | None" = None
+    # (attr, is_write, frozenset(held lock ids), lineno)
+    accesses: list = field(default_factory=list)
+    # (lock id, reentrant, lineno)
+    acquires: list = field(default_factory=list)
+    # (held id, acquired id, acquired reentrant, lineno)
+    direct_edges: list = field(default_factory=list)
+    # (frozenset(callee keys), frozenset(held ids), lineno)
+    calls: list = field(default_factory=list)
+    # (lineno, description) — blocking primitives for the signal rule
+    blocking: list = field(default_factory=list)
+
+
+@dataclass
+class ClassNode:
+    key: str                    # "path::Name"
+    path: str
+    name: str
+    methods: "dict[str, FuncNode]" = field(default_factory=dict)
+    lock_attrs: "dict[str, bool]" = field(default_factory=dict)
+    event_attrs: set = field(default_factory=set)
+    thread_targets: set = field(default_factory=set)  # method names
+
+
+@dataclass
+class Index:
+    funcs: "dict[str, FuncNode]" = field(default_factory=dict)
+    classes: "dict[str, ClassNode]" = field(default_factory=dict)
+    # per-path: bare func name -> key (module-level + nested defs)
+    mod_funcs: "dict[str, dict[str, str]]" = field(default_factory=dict)
+    # method name -> set of keys, for the unique-method fallback
+    methods_by_name: "dict[str, set]" = field(default_factory=dict)
+    # per-path: handler func keys registered via signal.signal
+    handlers: "dict[str, list]" = field(default_factory=dict)
+    # per-path: module uses threading at all (scopes the tmp-name rule)
+    uses_threading: "dict[str, bool]" = field(default_factory=dict)
+    # per-path: tmp-name findings (lineno)
+    tmp_findings: "dict[str, list]" = field(default_factory=dict)
+
+
+def _ctor_kind(call: ast.AST) -> "bool | None":
+    """reentrant flag when ``call`` constructs a lock (threading.Lock/
+    RLock/Condition or locksan.lock/rlock/condition), else None."""
+    if not (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)):
+        return None
+    mod, attr = call.func.value.id, call.func.attr
+    if mod == "threading" and attr in _LOCK_CTORS:
+        return _LOCK_CTORS[attr]
+    if mod == "locksan" and attr in _LOCKSAN_CTORS:
+        return _LOCKSAN_CTORS[attr]
+    return None
+
+
+def _is_event_ctor(call: ast.AST) -> bool:
+    return (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "Event"
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "threading")
+
+
+def _self_base_attr(expr: ast.AST) -> "str | None":
+    """``self.a`` / ``self.a.b`` / ``self.a[k].c`` -> ``a``."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        expr = expr.value
+    return None
+
+
+class _ModuleInfo:
+    """Per-module import maps and module-level locks."""
+
+    def __init__(self, path: str, tree: ast.AST, all_paths: set):
+        self.path = path
+        self.tree = tree
+        self.aliases: dict[str, str] = {}   # name -> nds module path
+        self.imported_funcs: dict[str, str] = {}  # name -> func key
+        self.foreign: set = set()           # non-nds imported names
+        self.module_locks: dict[str, bool] = {}   # name -> reentrant
+        self._collect(all_paths)
+
+    @staticmethod
+    def _mod_path(dotted: str, all_paths: set) -> "str | None":
+        base = dotted.replace(".", "/")
+        for cand in (base + ".py", base + "/__init__.py"):
+            if cand in all_paths:
+                return cand
+        return None
+
+    def _collect(self, all_paths: set) -> None:
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    name = a.asname or a.name.split(".")[0]
+                    p = self._mod_path(a.name, all_paths)
+                    if p:
+                        self.aliases[a.asname or a.name] = p
+                    else:
+                        self.foreign.add(name)
+            elif isinstance(n, ast.ImportFrom) and n.module:
+                for a in n.names:
+                    name = a.asname or a.name
+                    sub = self._mod_path(f"{n.module}.{a.name}",
+                                         all_paths)
+                    if sub:
+                        self.aliases[name] = sub
+                        continue
+                    p = self._mod_path(n.module, all_paths)
+                    if p:
+                        self.imported_funcs[name] = f"{p}::{a.name}"
+                    else:
+                        self.foreign.add(name)
+        for n in self.tree.body:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                kind = _ctor_kind(n.value)
+                if kind is not None:
+                    self.module_locks[n.targets[0].id] = kind
+
+
+class _FuncScanner:
+    """One function's body walk: accesses, acquisitions, call sites and
+    blocking primitives, tracking the held-lock set through ``with``
+    regions. Nested defs get their own FuncNode (empty held set — their
+    execution time is unknown)."""
+
+    def __init__(self, idx: Index, mod: _ModuleInfo,
+                 cls: "ClassNode | None", out: FuncNode):
+        self.idx = idx
+        self.mod = mod
+        self.cls = cls
+        self.out = out
+
+    # ------------------------------------------------- lock expressions
+
+    def _lock_id(self, expr: ast.AST) -> "tuple[str, bool] | None":
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.module_locks:
+                return (f"{self.mod.path}::{expr.id}",
+                        self.mod.module_locks[expr.id])
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        owner = None
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls"):
+                owner = self.cls
+            else:
+                owner = self.idx.classes.get(
+                    f"{self.mod.path}::{base.id}")
+        if owner is None:
+            return None
+        reent = owner.lock_attrs.get(expr.attr)
+        if reent is None and _LOCK_NAME_RE.search(expr.attr):
+            # param-passed locks (obs/metrics instruments): the attr is
+            # USED as a lock and NAMED one — infer non-reentrant
+            owner.lock_attrs.setdefault(expr.attr, False)
+            reent = owner.lock_attrs[expr.attr]
+        if reent is None:
+            return None
+        return (f"{owner.key}.{expr.attr}", reent)
+
+    # ------------------------------------------------- call resolution
+
+    def _resolve_call(self, call: ast.Call) -> set:
+        f = call.func
+        keys: set = set()
+        if isinstance(f, ast.Name):
+            local = self.idx.mod_funcs.get(self.mod.path, {})
+            if f.id in local:
+                keys.add(local[f.id])
+            elif f.id in self.mod.imported_funcs:
+                keys.add(self.mod.imported_funcs[f.id])
+            return keys
+        if not isinstance(f, ast.Attribute):
+            return keys
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and self.cls is not None:
+                if f.attr in self.cls.methods:
+                    keys.add(self.cls.methods[f.attr].key)
+                return keys
+            if base.id in self.mod.foreign:
+                return keys
+            mp = self.mod.aliases.get(base.id)
+            if mp is not None:
+                k = f"{mp}::{f.attr}"
+                if k in self.idx.funcs:
+                    keys.add(k)
+                return keys
+            c = self.idx.classes.get(f"{self.mod.path}::{base.id}")
+            if c is not None and f.attr in c.methods:
+                keys.add(c.methods[f.attr].key)
+                return keys
+        # unique-method fallback: exactly one audited class defines
+        # this method name -> resolve the attribute call to it
+        cands = self.idx.methods_by_name.get(f.attr, set())
+        if len(cands) == 1:
+            keys.add(next(iter(cands)))
+        return keys
+
+    # ------------------------------------------------------ specials
+
+    def _thread_target(self, call: ast.Call) -> None:
+        f = call.func
+        is_thread = (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "threading") or (
+                         isinstance(f, ast.Name) and f.id == "Thread")
+        if not is_thread or self.cls is None:
+            return
+        for kw in call.keywords:
+            if (kw.arg == "target"
+                    and isinstance(kw.value, ast.Attribute)
+                    and isinstance(kw.value.value, ast.Name)
+                    and kw.value.value.id == "self"):
+                self.cls.thread_targets.add(kw.value.attr)
+
+    def _signal_reg(self, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "signal"
+                and isinstance(f.value, ast.Name)
+                and f.value.id.lstrip("_") == "signal"
+                and len(call.args) >= 2):
+            return
+        h = call.args[1]
+        key = None
+        if isinstance(h, ast.Name):
+            key = self.idx.mod_funcs.get(self.mod.path, {}).get(h.id)
+        elif (isinstance(h, ast.Attribute)
+              and isinstance(h.value, ast.Name)
+              and h.value.id == "self" and self.cls is not None
+              and h.attr in self.cls.methods):
+            key = self.cls.methods[h.attr].key
+        if key is not None:
+            self.idx.handlers.setdefault(self.mod.path, []).append(key)
+
+    def _blocking(self, call: ast.Call) -> None:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if f.attr == "acquire":
+            lid = self._lock_id(f.value)
+            lockish = lid is not None or (
+                isinstance(f.value, ast.Name)
+                and _LOCK_NAME_RE.search(f.value.id))
+            blocking_arg = not call.args or (
+                isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is True)
+            nonblocking_kw = any(
+                kw.arg == "blocking"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in call.keywords)
+            if (lockish and blocking_arg and not has_timeout
+                    and not nonblocking_kw):
+                self.out.blocking.append(
+                    (call.lineno, "timeout-less blocking acquire()"))
+        elif f.attr in ("join", "wait"):
+            if not call.args and not has_timeout:
+                # str.join always takes an iterable arg, so a no-arg
+                # join() is a thread join; a no-arg wait() is an
+                # unbounded Event/Condition wait
+                self.out.blocking.append(
+                    (call.lineno, f"timeout-less {f.attr}()"))
+        elif (isinstance(f.value, ast.Name)
+              and f.value.id == "subprocess"):
+            self.out.blocking.append(
+                (call.lineno, f"subprocess.{f.attr}() on the signal "
+                              f"path"))
+
+    def _tmp_name(self, node: ast.JoinedStr) -> None:
+        text_parts = [v.value for v in node.values
+                      if isinstance(v, ast.Constant)
+                      and isinstance(v.value, str)]
+        if not any(".tmp" in t for t in text_parts):
+            return
+        calls = [c.func.attr for c in ast.walk(node)
+                 if isinstance(c, ast.Call)
+                 and isinstance(c.func, ast.Attribute)]
+        if "getpid" in calls and not (
+                {"get_ident", "get_native_id"} & set(calls)):
+            self.idx.tmp_findings.setdefault(
+                self.mod.path, []).append(node.lineno)
+
+    # ----------------------------------------------------------- walk
+
+    def scan(self) -> None:
+        for stmt in self.out.node.body:
+            self._walk(stmt, ())
+
+    def _record_target(self, t: ast.AST, held: tuple,
+                       lineno: int) -> None:
+        attr = _self_base_attr(t)
+        if attr is not None:
+            self.out.accesses.append((attr, True, frozenset(held),
+                                      lineno))
+        # slices/values inside the target still read
+        if isinstance(t, ast.Subscript):
+            self._walk(t.slice, held)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._record_target(el, held, lineno)
+
+    def _walk(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = FuncNode(
+                key=f"{self.out.key}.<locals>.{node.name}",
+                path=self.mod.path, name=node.name, node=node,
+                cls=self.cls)
+            self.idx.funcs[sub.key] = sub
+            self.idx.mod_funcs.setdefault(self.mod.path, {}) \
+                .setdefault(node.name, sub.key)
+            _FuncScanner(self.idx, self.mod, self.cls, sub).scan()
+            return
+        if isinstance(node, (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                lid = self._lock_id(item.context_expr)
+                if lid is None:
+                    self._walk(item.context_expr, inner)
+                    continue
+                lock, reent = lid
+                self.out.acquires.append((lock, reent, node.lineno))
+                for h in inner:
+                    self.out.direct_edges.append(
+                        (h, lock, reent, node.lineno))
+                inner = inner + (lock,)
+            for stmt in node.body:
+                self._walk(stmt, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                self._record_target(t, held, node.lineno)
+            if node.value is not None:
+                self._walk(node.value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._record_target(t, held, node.lineno)
+            return
+        if isinstance(node, ast.Call):
+            self._thread_target(node)
+            self._signal_reg(node)
+            self._blocking(node)
+            f = node.func
+            mutated = None
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATORS):
+                mutated = _self_base_attr(f.value)
+                if mutated is not None:
+                    self.out.accesses.append(
+                        (mutated, True, frozenset(held), node.lineno))
+            keys = self._resolve_call(node)
+            if keys:
+                self.out.calls.append((frozenset(keys),
+                                       frozenset(held), node.lineno))
+            for child in ast.iter_child_nodes(node):
+                # the mutator branch already recorded this access as a
+                # write; re-walking the receiver would double-report it
+                # as a read at the same line
+                if mutated is not None and child is f:
+                    continue
+                self._walk(child, held)
+            return
+        if isinstance(node, ast.JoinedStr):
+            if self.idx.uses_threading.get(self.mod.path):
+                self._tmp_name(node)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, held)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and isinstance(node.ctx, ast.Load)):
+            if (self.cls is None
+                    or node.attr not in self.cls.lock_attrs):
+                self.out.accesses.append(
+                    (node.attr, False, frozenset(held), node.lineno))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+def build_index(sources: "dict[str, str]") -> "tuple[Index, list]":
+    """Parse every source and populate the cross-module index; returns
+    (index, syntax-error violations)."""
+    idx = Index()
+    errors: list[LintViolation] = []
+    trees: dict[str, ast.AST] = {}
+    for path, src in sorted(sources.items()):
+        try:
+            trees[path] = ast.parse(src)
+        except SyntaxError as exc:
+            errors.append(LintViolation(
+                META_RULE, path, exc.lineno or 0,
+                f"syntax error: {exc.msg}"))
+            continue
+        idx.uses_threading[path] = ("threading" in src
+                                    or "locksan" in src)
+    mods = {path: _ModuleInfo(path, tree, set(trees))
+            for path, tree in trees.items()}
+    # pass 1: classes, their lock/event attrs, func skeletons
+    for path, tree in trees.items():
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = ClassNode(key=f"{path}::{node.name}", path=path,
+                                name=node.name)
+                idx.classes[cls.key] = cls
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)):
+                        kind = _ctor_kind(stmt.value)
+                        if kind is not None:
+                            cls.lock_attrs[stmt.targets[0].id] = kind
+                    if not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    fn = FuncNode(
+                        key=f"{cls.key}.{stmt.name}", path=path,
+                        name=stmt.name, node=stmt, cls=cls)
+                    idx.funcs[fn.key] = fn
+                    cls.methods[stmt.name] = fn
+                    idx.methods_by_name.setdefault(
+                        stmt.name, set()).add(fn.key)
+                    for n in ast.walk(stmt):
+                        if not (isinstance(n, ast.Assign)
+                                and len(n.targets) == 1
+                                and isinstance(n.targets[0],
+                                               ast.Attribute)):
+                            continue
+                        t = n.targets[0]
+                        if not (isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        kind = _ctor_kind(n.value)
+                        if kind is not None:
+                            cls.lock_attrs[t.attr] = kind
+                        elif _is_event_ctor(n.value):
+                            cls.event_attrs.add(t.attr)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                fn = FuncNode(key=f"{path}::{node.name}", path=path,
+                              name=node.name, node=node)
+                idx.funcs[fn.key] = fn
+                idx.mod_funcs.setdefault(path, {})[node.name] = fn.key
+    # pass 2: body scans (lock regions need every class known first)
+    for key, fn in list(idx.funcs.items()):
+        if "<locals>" in key:
+            continue  # nested defs are scanned by their parent
+        _FuncScanner(idx, mods[fn.path], fn.cls, fn).scan()
+    return idx, errors
+
+
+# --------------------------------------------------------------- rules
+
+def _rule_unguarded(idx: Index, enabled) -> list:
+    if "NDSR201" not in enabled:
+        return []
+    out = []
+    for cls in idx.classes.values():
+        if not cls.lock_attrs:
+            continue
+        own_locks = {f"{cls.key}.{a}" for a in cls.lock_attrs}
+        guard_locks: dict[str, set] = {}
+        for m in cls.methods.values():
+            for attr, write, held, _ln in m.accesses:
+                if write and held & own_locks:
+                    guard_locks.setdefault(attr, set()).update(
+                        held & own_locks)
+        for attr in list(guard_locks):
+            if attr in cls.lock_attrs or attr in cls.event_attrs:
+                del guard_locks[attr]
+        if not guard_locks:
+            continue
+        for key, fn in idx.funcs.items():
+            if (fn.cls is not cls or fn.name in _INIT_NAMES
+                    or fn.name.endswith("_locked")):
+                continue
+            for attr, write, held, ln in fn.accesses:
+                locks = guard_locks.get(attr)
+                if locks is None or held & locks:
+                    continue
+                names = ", ".join(sorted(
+                    lk.rsplit(".", 1)[-1] for lk in locks))
+                out.append(LintViolation(
+                    "NDSR201", fn.path, ln,
+                    f"{cls.name}.{attr} is guarded by {names} "
+                    f"(mutated under it elsewhere in the class) but "
+                    f"{'written' if write else 'read'} lock-free in "
+                    f"{fn.name}() — take the lock, or waive with why "
+                    f"this access cannot race"))
+    return out
+
+
+def _may_acquire(idx: Index) -> dict:
+    """Fixpoint closure: every lock a function may acquire directly or
+    through resolved callees."""
+    may = {k: {(lid, r) for lid, r, _ln in f.acquires}
+           for k, f in idx.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, f in idx.funcs.items():
+            cur = may[k]
+            before = len(cur)
+            for callees, _held, _ln in f.calls:
+                for c in callees:
+                    cur |= may.get(c, set())
+            if len(cur) != before:
+                changed = True
+    return may
+
+
+def _rule_lock_order(idx: Index, enabled) -> list:
+    if "NDSR202" not in enabled:
+        return []
+    may = _may_acquire(idx)
+    # edge: (held, acquired) -> (reentrant, path, line) first witness
+    edges: dict = {}
+    for f in idx.funcs.values():
+        for h, lock, reent, ln in f.direct_edges:
+            edges.setdefault((h, lock), (reent, f.path, ln))
+        for callees, held, ln in f.calls:
+            if not held:
+                continue
+            for c in callees:
+                for lock, reent in may.get(c, set()):
+                    for h in held:
+                        edges.setdefault((h, lock),
+                                         (reent, f.path, ln))
+    out = []
+    seen_self: set = set()
+    graph: dict[str, set] = {}
+    for (a, b), (reent, path, ln) in sorted(
+            edges.items(), key=lambda kv: (kv[1][1], kv[1][2])):
+        if a == b:
+            if not reent and (path, ln) not in seen_self:
+                seen_self.add((path, ln))
+                out.append(LintViolation(
+                    "NDSR202", path, ln,
+                    f"non-reentrant lock {a.rsplit('::', 1)[-1]} "
+                    f"acquired while already held (self-deadlock; "
+                    f"the request_stall_capture bug class) — hoist "
+                    f"the inner acquisition out, use an RLock, or "
+                    f"waive with why re-entry is impossible"))
+            continue
+        graph.setdefault(a, set()).add(b)
+    # cycles: report once per unordered lock set, at the first witness
+    def _reach(src: str, dst: str) -> bool:
+        stack, seen = [src], set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    reported: set = set()
+    for (a, b), (_reent, path, ln) in sorted(
+            edges.items(), key=lambda kv: (kv[1][1], kv[1][2])):
+        if a == b or not _reach(b, a):
+            continue
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        reported.add(key)
+        short = [x.rsplit("::", 1)[-1] for x in (a, b)]
+        out.append(LintViolation(
+            "NDSR202", path, ln,
+            f"lock-order cycle: {short[0]} is held while acquiring "
+            f"{short[1]} here, and elsewhere {short[1]} is held "
+            f"while (transitively) acquiring {short[0]} — a "
+            f"potential deadlock; pick one order, or waive with why "
+            f"the two paths cannot interleave"))
+    return out
+
+
+def _rule_signal_safety(idx: Index, enabled, waiver_lines) -> list:
+    if "NDSR203" not in enabled:
+        return []
+    out = []
+    queue = [k for keys in idx.handlers.values() for k in keys]
+    seen: set = set()
+    while queue:
+        key = queue.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        fn = idx.funcs.get(key)
+        if fn is None:
+            continue
+        defline = fn.node.lineno
+        waived = waiver_lines.get(fn.path, {}).get(defline)
+        if waived and "NDSR203" in waived:
+            # declared signal boundary: its blocking work is bounded
+            # (worker thread + timeout) — emit the boundary finding so
+            # the waiver registers used, and prune traversal
+            out.append(LintViolation(
+                "NDSR203", fn.path, defline,
+                f"signal path enters {fn.name}() (declared bounded "
+                f"boundary)"))
+            continue
+        for lock, _reent, ln in fn.acquires:
+            out.append(LintViolation(
+                "NDSR203", fn.path, ln,
+                f"{fn.name}() acquires {lock.rsplit('::', 1)[-1]} on "
+                f"a signal-handler path — the interrupted frame may "
+                f"hold it (unbounded self-deadlock absorbing the "
+                f"signal); move the lock-taking work to a bounded "
+                f"worker thread, or waive the def line as a bounded "
+                f"boundary"))
+        for ln, why in fn.blocking:
+            out.append(LintViolation(
+                "NDSR203", fn.path, ln,
+                f"{why} in {fn.name}() on a signal-handler path — "
+                f"bound it with a timeout, or waive with why it "
+                f"cannot block"))
+        for callees, _held, _ln in fn.calls:
+            queue.extend(callees)
+    return out
+
+
+def _rule_thread_shared(idx: Index, enabled) -> list:
+    if "NDSR204" not in enabled:
+        return []
+    out = []
+    for cls in idx.classes.values():
+        if not cls.thread_targets:
+            continue
+
+        def _closure(entry_names) -> set:
+            todo = [cls.methods[n].key for n in entry_names
+                    if n in cls.methods]
+            seen: set = set()
+            while todo:
+                k = todo.pop()
+                if k in seen:
+                    continue
+                seen.add(k)
+                fn = idx.funcs.get(k)
+                if fn is None:
+                    continue
+                for callees, _held, _ln in fn.calls:
+                    todo.extend(c for c in callees
+                                if c.startswith(cls.key + "."))
+            return seen
+
+        thread_keys = _closure(cls.thread_targets)
+
+        def _unguarded_writes(keys) -> dict:
+            w: dict[str, int] = {}
+            for k in keys:
+                fn = idx.funcs.get(k)
+                if fn is None:
+                    continue
+                for attr, write, held, ln in fn.accesses:
+                    if write and not held:
+                        w.setdefault(attr, ln)
+            return w
+
+        thread_writes = _unguarded_writes(thread_keys)
+        other = [m.name for m in cls.methods.values()
+                 if m.key not in thread_keys
+                 and m.name not in _INIT_NAMES]
+        off_writes = _unguarded_writes(_closure(other))
+        skip = (set(cls.lock_attrs) | cls.event_attrs
+                | set(cls.thread_targets))
+        for attr in sorted(set(thread_writes) & set(off_writes)
+                           - skip):
+            entry = "/".join(sorted(cls.thread_targets))
+            out.append(LintViolation(
+                "NDSR204", cls.path, off_writes[attr],
+                f"{cls.name}.{attr} is mutated lock-free both on the "
+                f"{entry} thread and from other methods — guard it, "
+                f"or waive with why the race is benign"))
+    for path, lines in idx.tmp_findings.items():
+        for ln in sorted(set(lines)):
+            out.append(LintViolation(
+                "NDSR204", path, ln,
+                "atomic-write tmp name embeds os.getpid() but not "
+                "threading.get_ident(): two threads of one process "
+                "truncate each other's stream (the flight-dump race) "
+                "— add the thread ident, or route through "
+                "io.integrity.write_json_atomic"))
+    return out
+
+
+# -------------------------------------------------------------- driver
+
+def audit_sources(sources: "dict[str, str]",
+                  enabled: "set[str] | None" = None) -> LintResult:
+    """Audit {path: source}; returns the same LintResult shape ndslint
+    uses (violations / waived / errors), with the ``ndsraces`` waiver
+    marker."""
+    enabled = set(RULE_IDS) if enabled is None else enabled
+    res = LintResult()
+    idx, errors = build_index(sources)
+    res.errors.extend(errors)
+    waivers_by_path: dict = {}
+    waiver_lines: dict = {}
+    for path, src in sources.items():
+        waivers, werrs = parse_waivers(src, tool=TOOL,
+                                       meta_rule=META_RULE)
+        for w in werrs:
+            w.path = path
+            res.errors.append(w)
+        waivers_by_path[path] = waivers
+        waiver_lines[path] = {ln: set(w.rules)
+                              for ln, w in waivers.items()}
+    violations = (_rule_unguarded(idx, enabled)
+                  + _rule_lock_order(idx, enabled)
+                  + _rule_signal_safety(idx, enabled, waiver_lines)
+                  + _rule_thread_shared(idx, enabled))
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.msg)):
+        w = waivers_by_path.get(v.path, {}).get(v.line)
+        if w is not None and v.rule in w.rules:
+            w.used = True
+            v.waived = True
+            v.waiver_note = w.note
+            res.waived.append(v)
+        else:
+            res.violations.append(v)
+    for path, waivers in waivers_by_path.items():
+        for w in waivers.values():
+            if not w.used:
+                res.errors.append(LintViolation(
+                    META_RULE, path, w.line,
+                    f"waiver for {','.join(w.rules)} matches no "
+                    f"violation — stale, remove it"))
+    return res

@@ -140,19 +140,39 @@ class Waiver:
 
 # ------------------------------------------------------------- waivers
 
+# one waiver grammar, two tools: ndslint (this module's rules) and
+# ndsraces (nds_tpu/analysis/concurrency.py) share the marker syntax
+# differing only in the tool name, so the waiver-report and the
+# stale-waiver semantics stay identical across both gates
 WAIVER_RE = re.compile(
     r"#\s*ndslint:\s*waive\[(?P<rules>[A-Z0-9, ]+)\]"
     r"(?:\s*--\s*(?P<note>.*\S))?")
 
+_WAIVER_RES: dict = {"ndslint": WAIVER_RE}
 
-def parse_waivers(src: str) -> "tuple[dict, list[LintViolation]]":
+
+def waiver_re(tool: str) -> "re.Pattern":
+    pat = _WAIVER_RES.get(tool)
+    if pat is None:
+        pat = _WAIVER_RES[tool] = re.compile(
+            r"#\s*" + re.escape(tool)
+            + r":\s*waive\[(?P<rules>[A-Z0-9, ]+)\]"
+            r"(?:\s*--\s*(?P<note>.*\S))?")
+    return pat
+
+
+def parse_waivers(src: str, tool: str = "ndslint",
+                  meta_rule: str = "NDS100"
+                  ) -> "tuple[dict, list[LintViolation]]":
     """{covered_line: Waiver} plus violations for malformed waivers
     (missing justification). A waiver on its own line covers the next
-    line; an end-of-line waiver covers its own."""
+    line; an end-of-line waiver covers its own. ``tool`` picks the
+    marker (``ndslint`` / ``ndsraces``); ``meta_rule`` is the rule id
+    malformed-waiver errors report under."""
     waivers: dict[int, Waiver] = {}
     errors: list[LintViolation] = []
     for lineno, text in enumerate(src.splitlines(), 1):
-        m = WAIVER_RE.search(text)
+        m = waiver_re(tool).search(text)
         if not m:
             continue
         rules = [r.strip() for r in m.group("rules").split(",")
@@ -162,12 +182,42 @@ def parse_waivers(src: str) -> "tuple[dict, list[LintViolation]]":
         covered = lineno + 1 if standalone else lineno
         if not note:
             errors.append(LintViolation(
-                "NDS100", "", lineno,
-                "waiver without justification (use "
-                "'# ndslint: waive[NDS1xx] -- why')"))
+                meta_rule, "", lineno,
+                f"waiver without justification (use "
+                f"'# {tool}: waive[...] -- why')"))
             continue
         waivers[covered] = Waiver(covered, rules, note)
     return waivers, errors
+
+
+def waiver_report(results: "dict[str, LintResult]",
+                  verbose: bool = False) -> "list[str]":
+    """Tree-wide waiver hygiene report shared by ``ndslint
+    --waiver-report`` and ``ndsraces --waiver-report``: per-rule waiver
+    counts per tool, each waiver's site + note under ``verbose``, and
+    every STALE waiver (one matching no live finding — already a gate
+    error) flagged explicitly so audits see exactly what to drop."""
+    lines: list[str] = []
+    for tool in sorted(results):
+        res = results[tool]
+        by_rule: dict[str, list] = {}
+        for v in res.waived:
+            by_rule.setdefault(v.rule, []).append(v)
+        total = sum(len(vs) for vs in by_rule.values())
+        lines.append(f"{tool}: {total} waiver(s) across "
+                     f"{len(by_rule)} rule(s)")
+        for rule in sorted(by_rule):
+            vs = by_rule[rule]
+            lines.append(f"  {rule}: {len(vs)}")
+            if verbose:
+                for v in sorted(vs, key=lambda x: (x.path, x.line)):
+                    lines.append(f"    {v.path}:{v.line}: "
+                                 f"{v.waiver_note}")
+        stale = [e for e in res.errors
+                 if "matches no violation" in e.msg]
+        for e in sorted(stale, key=lambda x: (x.path, x.line)):
+            lines.append(f"  STALE: {e.path}:{e.line}: {e.msg}")
+    return lines
 
 
 # --------------------------------------------------------------- rules

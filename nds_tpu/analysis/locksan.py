@@ -1,0 +1,399 @@
+"""Runtime lock-order sanitizer: witness the order graph at runtime.
+
+The static auditor (``nds_tpu/analysis/concurrency.py``) PROPOSES the
+lock acquisition graph from the ast; this module WITNESSES it on real
+concurrent workloads. Under ``NDS_TPU_LOCKSAN=1`` every lock the
+engine's threaded modules create through the :func:`lock` /
+:func:`rlock` / :func:`condition` factories is a thin wrapper that
+records, per thread, the stack of currently-held lock NAMES plus the
+Python traceback of each first-witnessed acquisition edge:
+
+- acquiring B while holding A adds the directed edge ``A -> B``; the
+  first time an edge closes a cycle (``B ⇝ A`` already witnessed) an
+  INVERSION is recorded with both witness stacks, counted on
+  ``lock_order_inversions_total``, and printed loudly — the exact
+  interleaving evidence a post-hoc deadlock leaves nowhere;
+- re-acquiring a non-reentrant lock the same thread already holds (the
+  ``request_stall_capture`` bug class) raises ``RuntimeError``
+  immediately instead of deadlocking the process under test;
+- at process exit the graph + inversions are reported: written as JSON
+  to ``$NDS_TPU_LOCKSAN_REPORT/locksan-<pid>.json`` (via
+  ``io.integrity.write_json_atomic`` — whose tmp names are
+  thread-unique, our own NDS109 dogfood) when the env names a
+  directory, else printed to stderr when inversions exist.
+
+Disabled (the default), the factories return plain ``threading``
+primitives — zero overhead, zero behavior change. Tests enable it
+process-wide (tests/conftest.py) and ``tools/static_checks.py`` runs
+the chaos/soak/serve gates under it, asserting the real workloads stay
+inversion-free while a seeded inversion (``selftest``) proves the
+detector actually fires. Lock identity is the NAME (one per creation
+site), so every instance of a class shares one node in the order graph
+— which is the discipline being checked; self-deadlock detection uses
+object identity, so two instances of one class never false-positive.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+import traceback
+
+ENV = "NDS_TPU_LOCKSAN"
+REPORT_ENV = "NDS_TPU_LOCKSAN_REPORT"
+
+# witness stacks are trimmed to this many frames (deepest first): deep
+# jax/pytest frames bury the engine frame the report exists to show
+_STACK_FRAMES = 12
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV, "0") == "1"
+
+
+def _stack() -> "list[str]":
+    frames = traceback.format_stack()[:-2]
+    return [ln.rstrip("\n") for ln in frames[-_STACK_FRAMES:]]
+
+
+class OrderGraph:
+    """One acquisition-order graph: edges, inversions, per-thread held
+    stacks. The global instance backs every factory-made lock; tests
+    and the selftest build private instances so seeded inversions never
+    pollute the process verdict."""
+
+    def __init__(self, metric: bool = True):
+        # the sanitizer's own lock is a PLAIN lock: it must be
+        # invisible to itself, and nothing is ever acquired inside it
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.edges: dict = {}        # (a, b) -> {count, stack}
+        self.inversions: list = []
+        self.metric = metric
+
+    # ------------------------------------------------------- held state
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def held_names(self) -> "list[str]":
+        return [name for name, _ident in self._held()]
+
+    def holds(self, ident: int) -> bool:
+        return any(i == ident for _n, i in self._held())
+
+    # -------------------------------------------------------- recording
+
+    def on_acquired(self, name: str, ident: int) -> None:
+        held = self._held()
+        new_inversion = None
+        if held:
+            prior = {n for n, _i in held if n != name}
+            with self._lock:
+                for h in prior:
+                    edge = self.edges.get((h, name))
+                    if edge is not None:
+                        edge["count"] += 1
+                        continue
+                    self.edges[(h, name)] = {"count": 1,
+                                             "stack": _stack()}
+                    if self._reaches_locked(name, h):
+                        new_inversion = {
+                            "cycle": [h, name],
+                            "stack": self.edges[(h, name)]["stack"],
+                            "prior_stack": self._witness_locked(name,
+                                                                h),
+                            "thread": threading.current_thread().name,
+                            "ts": time.time(),
+                        }
+                        self.inversions.append(new_inversion)
+        held.append((name, ident))
+        if new_inversion is not None:
+            # metric + print OUTSIDE the graph lock: the counter's own
+            # (sanitized) lock would re-enter on_acquired
+            self._announce(new_inversion)
+
+    def on_reacquired(self, name: str, ident: int) -> None:
+        """A legal reentrant re-acquire (RLock depth > 1): push the
+        held record so release stays symmetric, but record NO edges —
+        a re-acquire of a lock this thread already owns can never
+        block, so it must never synthesize an inversion."""
+        self._held().append((name, ident))
+
+    def on_released(self, name: str, ident: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == (name, ident):
+                del held[i]
+                return
+
+    def drop_all(self, ident: int) -> int:
+        """Remove every held record for ``ident`` (RLock fully
+        releasing inside Condition.wait); returns how many were held
+        so the restore can push them back."""
+        held = self._held()
+        n = len([1 for _name, i in held if i == ident])
+        held[:] = [(nm, i) for nm, i in held if i != ident]
+        return n
+
+    def on_self_deadlock(self, name: str) -> None:
+        rec = {"cycle": [name, name], "stack": _stack(),
+               "prior_stack": [],
+               "thread": threading.current_thread().name,
+               "ts": time.time()}
+        with self._lock:
+            self.inversions.append(rec)
+        self._announce(rec)
+
+    def _reaches_locked(self, src: str, dst: str) -> bool:
+        stack, seen = [src], set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(b for (a, b) in self.edges if a == n)
+        return False
+
+    def _witness_locked(self, a: str, b: str) -> "list[str]":
+        edge = self.edges.get((a, b))
+        return edge["stack"] if edge else []
+
+    def _announce(self, rec: dict) -> None:
+        if self.metric:
+            try:
+                from nds_tpu.obs import metrics as obs_metrics
+                obs_metrics.counter(
+                    "lock_order_inversions_total").inc()
+            except Exception:  # noqa: BLE001 - detector must not crash
+                pass
+        a, b = rec["cycle"]
+        kind = ("re-entrant acquire of non-reentrant lock"
+                if a == b else "lock-order inversion")
+        print(f"[locksan] {kind}: {a} -> {b} "
+              f"(thread {rec['thread']})", file=sys.stderr)
+
+    # --------------------------------------------------------- readout
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "edges": {f"{a} -> {b}": dict(e)
+                          for (a, b), e in self.edges.items()},
+                "inversions": [dict(i) for i in self.inversions],
+            }
+
+    def inversion_count(self) -> int:
+        with self._lock:
+            return len(self.inversions)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.edges.clear()
+            self.inversions.clear()
+
+
+class SanLock:
+    """Order-recording wrapper around ``threading.Lock``."""
+
+    reentrant = False
+
+    def __init__(self, name: str, graph: "OrderGraph | None" = None):
+        self._name = name
+        self._graph = graph if graph is not None else _GRAPH
+        self._inner = self._make_inner()
+        self._ident = id(self._inner)
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if (blocking and timeout < 0 and not self.reentrant
+                and self._graph.holds(self._ident)):
+            self._graph.on_self_deadlock(self._name)
+            raise RuntimeError(
+                f"locksan: re-entrant acquire of non-reentrant lock "
+                f"{self._name} would deadlock")
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph.on_acquired(self._name, self._ident)
+        return got
+
+    def release(self) -> None:
+        self._graph.on_released(self._name, self._ident)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SanRLock(SanLock):
+    """Order-recording wrapper around ``threading.RLock``: recursion is
+    legal, and only the outermost acquire records ORDER EDGES — a
+    re-acquire of a lock the thread already owns can never block, so it
+    must never synthesize an inversion (nested re-acquires still push
+    held records, keeping release symmetric)."""
+
+    reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        recursing = self._graph.holds(self._ident)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if recursing:
+                self._graph.on_reacquired(self._name, self._ident)
+            else:
+                self._graph.on_acquired(self._name, self._ident)
+        return got
+
+    # Condition-wait protocol: a Condition backed by this lock must
+    # FULLY release the recursion on wait() and restore it after
+    # (threading.Condition uses these when present; its fallbacks call
+    # bare release()/acquire(), which only drop one recursion level)
+    def _release_save(self):
+        depth = self._graph.drop_all(self._ident)
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        self._graph.on_acquired(self._name, self._ident)
+        for _ in range(depth - 1):
+            self._graph.on_reacquired(self._name, self._ident)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+_GRAPH = OrderGraph()
+
+
+def graph() -> OrderGraph:
+    return _GRAPH
+
+
+def inversion_count() -> int:
+    return _GRAPH.inversion_count()
+
+
+def reset() -> None:
+    _GRAPH.reset()
+
+
+# ------------------------------------------------------------ factories
+
+def lock(name: str):
+    """A mutex for ``name`` (one name per creation site, e.g.
+    ``"serve.QueryServer._lock"``): sanitized under NDS_TPU_LOCKSAN=1,
+    a plain ``threading.Lock`` otherwise."""
+    if enabled():
+        _ensure_exit_report()
+        return SanLock(name)
+    return threading.Lock()
+
+
+def rlock(name: str):
+    if enabled():
+        _ensure_exit_report()
+        return SanRLock(name)
+    return threading.RLock()
+
+
+def condition(name: str):
+    """A ``threading.Condition`` whose underlying mutex is sanitized:
+    ``wait()`` releases and re-acquires through the wrapper, so the
+    order graph sees exactly what the threads do. Backed by a
+    SanRLock — ``threading.Condition()``'s default lock is an RLock,
+    and the sanitized primitive must keep the same reentrancy
+    semantics, not just observe."""
+    if enabled():
+        _ensure_exit_report()
+        return threading.Condition(lock=SanRLock(name))
+    return threading.Condition()
+
+
+# ---------------------------------------------------------- exit report
+
+_exit_registered = False
+
+
+def write_report(path: "str | None" = None) -> "str | None":
+    """Write the global graph's snapshot as JSON (atomic, thread-unique
+    tmp via io.integrity). Default path comes from
+    ``$NDS_TPU_LOCKSAN_REPORT`` (a directory; the file is
+    ``locksan-<pid>.json``); returns the path written, or None when no
+    destination is configured."""
+    if path is None:
+        d = os.environ.get(REPORT_ENV)
+        if not d:
+            return None
+        path = os.path.join(d, f"locksan-{os.getpid()}.json")
+    from nds_tpu.io.integrity import write_json_atomic
+    write_json_atomic(path, _GRAPH.snapshot())
+    return path
+
+
+def _at_exit() -> None:
+    try:
+        wrote = write_report()
+    except Exception:  # noqa: BLE001 - exit path, best effort
+        wrote = None
+    n = _GRAPH.inversion_count()
+    if n and not wrote:
+        print(f"[locksan] exiting with {n} unreported lock-order "
+              f"inversion(s) — set {REPORT_ENV} to capture them",
+              file=sys.stderr)
+
+
+def _ensure_exit_report() -> None:
+    global _exit_registered
+    if not _exit_registered:
+        _exit_registered = True
+        atexit.register(_at_exit)
+
+
+# -------------------------------------------------------------- selftest
+
+def selftest() -> bool:
+    """Seed a deliberate AB/BA inversion on a PRIVATE graph and return
+    whether the detector fired — the tier-1 proof that the sanitizer
+    catches what it claims to (static_checks ``locksan`` section)."""
+    g = OrderGraph(metric=False)
+    a, b = SanLock("selftest.A", g), SanLock("selftest.B", g)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    seeded = g.inversion_count() == 1
+    # and the re-entrant-acquire guard: must raise, not deadlock
+    try:
+        with a:
+            a.acquire()
+        reentry = False
+    except RuntimeError:
+        reentry = True
+    return seeded and reentry and g.inversion_count() == 2

@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import zlib
 
 MANIFEST_NAME = "_manifest.json"
@@ -247,12 +248,15 @@ def check_crc(doc: dict, key: str = "crc") -> bool:
 def write_json_atomic(path: str, doc, indent: int = 2) -> None:
     """tmp + rename JSON write: a crash mid-write leaves the previous
     complete file, never a torn one; readers never see partial JSON.
-    pid-suffixed tmp so two processes pointed at one path each rename
-    a complete file into place."""
+    The tmp is pid- AND thread-suffixed: two processes pointed at one
+    path each rename a complete file into place, and two THREADS of one
+    process (the watchdog's stall dump racing a SIGTERM dump — the
+    PR-9 flight-recorder truncation race, ndsraces NDSR204) never
+    truncate each other's stream mid-write."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    tmp = f"{path}.{os.getpid()}.tmp"
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=indent)
     os.replace(tmp, path)

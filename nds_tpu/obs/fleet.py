@@ -49,6 +49,7 @@ import threading
 import time
 from collections import deque
 
+from nds_tpu.analysis import locksan
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs import trace as obs_trace
 
@@ -216,7 +217,7 @@ class FlightRecorder:
         self.ring: deque = deque(maxlen=max(maxlen, 1))
         self.dumps = 0
         self.reasons: list[str] = []
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("obs.FlightRecorder._lock")
 
     @property
     def path(self) -> str:
@@ -267,6 +268,7 @@ class FlightRecorder:
             "heartbeats": watchdog.snapshot_heartbeats(),
         }
 
+    # ndsraces: waive[NDSR203] -- bounded boundary: lock-taking gather runs on a worker thread joined with timeout_s on the signal path
     def dump(self, reason: str,
              timeout_s: "float | None" = None) -> "str | None":
         """Atomic ``flight-r<rank>.json`` write (latest dump wins; the
@@ -299,24 +301,18 @@ class FlightRecorder:
             doc = box.get("doc") or {
                 "rank": self.rank, "host": socket.gethostname(),
                 "pid": os.getpid(), "reason": reason,
+                # ndsraces: waive[NDSR201] -- signal-path fallback: taking the ring lock here is the self-deadlock this branch avoids
                 "reasons": [reason], "dumps": self.dumps + 1,
                 "ts": time.time(), "entries": [], "metrics": {},
                 "partial": True,
             }
         try:
-            import json
-            os.makedirs(self.run_dir, exist_ok=True)
-            # THREAD-unique tmp, then rename: the watchdog thread (a
-            # stall dump) and the main thread (a SIGTERM dump — the
-            # exact stall-then-supervisor-kill sequence) can dump the
-            # same recorder concurrently, and a pid-only tmp name
-            # (io.integrity.write_json_atomic) would truncate one
-            # writer's stream under the other
-            tmp = (f"{self.path}.{os.getpid()}"
-                   f".{threading.get_ident()}.tmp")
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, self.path)
+            from nds_tpu.io.integrity import write_json_atomic
+            # write_json_atomic's tmp names are thread-unique, so the
+            # watchdog thread (a stall dump) and the main thread (a
+            # SIGTERM dump — the exact stall-then-supervisor-kill
+            # sequence) can dump the same recorder concurrently
+            write_json_atomic(self.path, doc)
         except Exception as exc:  # noqa: BLE001 - post-mortem best effort
             print(f"[obs] flight-recorder dump failed: "
                   f"{type(exc).__name__}: {exc}")

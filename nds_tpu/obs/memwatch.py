@@ -31,9 +31,9 @@ the ``device_hwm_bytes`` metrics gauge so live snapshots
 
 from __future__ import annotations
 
-import threading
+from nds_tpu.analysis import locksan
 
-_LOCK = threading.Lock()
+_LOCK = locksan.lock("obs.memwatch._LOCK")
 
 
 def table_bytes(table) -> int:

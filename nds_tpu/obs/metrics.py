@@ -36,8 +36,9 @@ JSON under ``metrics``.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
+
+from nds_tpu.analysis import locksan
 
 
 class Counter:
@@ -45,7 +46,7 @@ class Counter:
 
     __slots__ = ("name", "value", "_lock")
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock):
         self.name = name
         self.value = 0
         self._lock = lock
@@ -60,7 +61,7 @@ class Gauge:
 
     __slots__ = ("name", "value", "_lock")
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock):
         self.name = name
         self.value = 0
         self._lock = lock
@@ -83,7 +84,7 @@ class Histogram:
     __slots__ = ("name", "count", "sum", "min", "max", "_samples",
                  "_lock")
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock):
         self.name = name
         self.count = 0
         self.sum = 0.0
@@ -100,9 +101,7 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
             self._samples.append(v)
 
-    def percentiles(self) -> dict:
-        """Nearest-rank p50/p95/p99 over the recent-sample window
-        ({} before the first observation)."""
+    def _percentiles_locked(self) -> dict:
         s = sorted(self._samples)
         if not s:
             return {}
@@ -110,41 +109,53 @@ class Histogram:
         return {f"p{q}": s[min(n - 1, max(0, (q * n + 99) // 100 - 1))]
                 for q in (50, 95, 99)}
 
+    def percentiles(self) -> dict:
+        """Nearest-rank p50/p95/p99 over the recent-sample window
+        ({} before the first observation)."""
+        with self._lock:
+            return self._percentiles_locked()
+
     def summary(self) -> dict:
-        return {"count": self.count, "sum": self.sum,
-                "min": self.min, "max": self.max,
-                **self.percentiles()}
+        with self._lock:
+            return {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max,
+                    **self._percentiles_locked()}
 
 
 class MetricsRegistry:
+    """One lock for the registry AND every instrument it creates —
+    REENTRANT, so snapshot() can roll up instrument summaries while
+    holding it and instruments can guard their own reads for direct
+    callers. Instrument updates are query-granularity events, never
+    per-row, so one shared lock stays cheaper than per-instrument
+    locking everywhere."""
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locksan.rlock("obs.MetricsRegistry._lock")
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
-        c = self._counters.get(name)
-        if c is None:
-            with self._lock:
-                c = self._counters.setdefault(
-                    name, Counter(name, self._lock))
-        return c
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, self._lock)
+            return c
 
     def gauge(self, name: str) -> Gauge:
-        g = self._gauges.get(name)
-        if g is None:
-            with self._lock:
-                g = self._gauges.setdefault(name, Gauge(name, self._lock))
-        return g
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, self._lock)
+            return g
 
     def histogram(self, name: str) -> Histogram:
-        h = self._histograms.get(name)
-        if h is None:
-            with self._lock:
-                h = self._histograms.setdefault(
-                    name, Histogram(name, self._lock))
-        return h
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, self._lock)
+            return h
 
     def snapshot(self) -> dict:
         """Point-in-time copy: {"counters": {...}, "gauges": {...},

@@ -44,8 +44,8 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 
+from nds_tpu.analysis import locksan
 from nds_tpu.obs import metrics as obs_metrics
 
 PROFILE_ENV = "NDS_TPU_PROFILE"
@@ -125,7 +125,7 @@ class Profiler:
         # "previous run" memory; process-local by design — a serving
         # process watches its own latency)
         self.history: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("obs.Profiler._lock")
         self._active = False
         self._warned = False
         self._seq = 0

@@ -25,7 +25,6 @@ snapshot on ``stop()`` so short runs still leave a file.
 
 from __future__ import annotations
 
-import json
 import os
 import re
 import threading
@@ -204,22 +203,21 @@ class MetricsSnapshotter:
         if hb:
             doc["heartbeats"] = hb
         try:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            # pid-suffixed tmp: two processes pointed at the same
-            # snapshot path (mis-threaded env) must still each rename
-            # a COMPLETE file into place, never interleave one tmp
-            tmp = f"{self.path}.{os.getpid()}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, self.path)
+            # pid+thread-unique tmps (write_json_atomic, and the same
+            # scheme for the OpenMetrics sibling): two processes
+            # pointed at one snapshot path (mis-threaded env) AND the
+            # daemon thread racing a final stop() write each rename a
+            # COMPLETE file into place, never interleave one tmp
+            from nds_tpu.io.integrity import write_json_atomic
+            write_json_atomic(self.path, doc)
             om = om_path_for(self.path)
-            with open(f"{om}.{os.getpid()}.tmp", "w") as f:
+            tmp = f"{om}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
                 f.write(to_openmetrics(snap))
-            os.replace(f"{om}.{os.getpid()}.tmp", om)
+            os.replace(tmp, om)
         except OSError as exc:
             if not self._warned:  # observability must not fail the run
+                # ndsraces: waive[NDSR204] -- warn-once latch: a lost update costs at most one duplicate warning line
                 self._warned = True
                 print(f"[obs] metrics snapshot write failed: {exc}")
 

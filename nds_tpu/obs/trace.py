@@ -39,6 +39,8 @@ import threading
 import time
 from collections import deque
 
+from nds_tpu.analysis import locksan
+
 TRACE_ENV = "NDS_TPU_TRACE"
 _OBS_ENV = "NDS_TPU_OBS"
 
@@ -47,7 +49,7 @@ _OBS_ENV = "NDS_TPU_OBS"
 # only clock spans ever read
 _EPOCH_OFFSET = time.time() - time.perf_counter()
 
-_EXPORT_LOCK = threading.Lock()
+_EXPORT_LOCK = locksan.lock("obs.trace._EXPORT_LOCK")
 
 # deterministic export identity (obs/fleet.py): multi-process fleets
 # export with pid=rank and supervised throughput streams with

@@ -40,9 +40,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 import time
 
+from nds_tpu.analysis import locksan
 from nds_tpu.io import integrity
 
 
@@ -166,7 +166,7 @@ class QueryJournal:
         # track state in memory (their replay decisions must match the
         # primary's) but never race it onto the shared file
         self.readonly = False
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("resilience.QueryJournal._lock")
         self.state: dict = self._fresh()
 
     def _fresh(self) -> dict:
@@ -174,9 +174,13 @@ class QueryJournal:
                 "config_digest": self.digest, "incarnation": 0,
                 "queries": {}}
 
+    def _incarnation_locked(self) -> int:
+        return int(self.state.get("incarnation", 0))
+
     @property
     def incarnation(self) -> int:
-        return int(self.state.get("incarnation", 0))
+        with self._lock:
+            return self._incarnation_locked()
 
     def load(self) -> bool:
         """Read prior state; same contract as PhaseJournal.load — a
@@ -218,9 +222,10 @@ class QueryJournal:
         and completion it records carries the new number, so the merged
         phase report and the soak gate can attribute each execution."""
         with self._lock:
-            self.state["incarnation"] = self.incarnation + 1
+            inc = self._incarnation_locked() + 1
+            self.state["incarnation"] = inc
         self.write()
-        return self.incarnation
+        return inc
 
     # ------------------------------------------------------- recording
 
@@ -230,7 +235,8 @@ class QueryJournal:
         disk)."""
         with self._lock:
             q = self.state["queries"].setdefault(name, {"starts": []})
-            q.setdefault("starts", []).append(self.incarnation)
+            q.setdefault("starts", []).append(
+                self._incarnation_locked())
         self.write()
 
     def record(self, name: str, wall_ms: float, status: str,
@@ -243,7 +249,7 @@ class QueryJournal:
             q.pop("aborted", None)
             q.update({"done": True, "wall_ms": round(float(wall_ms), 3),
                       "status": str(status),
-                      "incarnation": self.incarnation,
+                      "incarnation": self._incarnation_locked(),
                       "ts": time.time()})
             if result_digest:
                 q["result_digest"] = result_digest
@@ -267,19 +273,28 @@ class QueryJournal:
     # --------------------------------------------------------- readout
 
     def done(self, name: str) -> bool:
-        return bool(self.state["queries"].get(name, {}).get("done"))
+        # readouts take the lock too (the PR-10 review finding this
+        # module's auditor rule NDSR201 now codifies): the drain
+        # deadline thread mutates ``state`` while the main loop reads
+        # its replay decisions
+        with self._lock:
+            return bool(self.state["queries"].get(name, {}).get("done"))
 
     def entry(self, name: str) -> dict:
-        return dict(self.state["queries"].get(name, {}))
+        with self._lock:
+            return dict(self.state["queries"].get(name, {}))
 
     def completed(self) -> dict:
         """{name: entry} of every journaled-done statement."""
-        return {n: dict(e) for n, e in self.state["queries"].items()
-                if e.get("done")}
+        with self._lock:
+            return {n: dict(e)
+                    for n, e in self.state["queries"].items()
+                    if e.get("done")}
 
     def starts(self, name: str) -> list:
-        return list(self.state["queries"].get(name, {}).get("starts",
-                                                            []))
+        with self._lock:
+            return list(self.state["queries"].get(name,
+                                                  {}).get("starts", []))
 
     def write(self) -> None:
         if self.readonly:
@@ -287,11 +302,11 @@ class QueryJournal:
         with self._lock:
             doc = integrity.stamp_crc(
                 json.loads(json.dumps(self.state, default=str)))
-            # the file write stays INSIDE the lock: the drain deadline
-            # thread (mark_aborted) and the main thread (record) would
-            # otherwise race write_json_atomic's pid-only tmp name —
-            # the same same-process hazard FlightRecorder.dump guards
-            # with thread-unique tmps
+            # the file write stays INSIDE the lock: the serialized doc
+            # and the rename order must agree — a later snapshot must
+            # never be replaced by an earlier one racing it to the
+            # rename (write_json_atomic's tmp names are thread-unique,
+            # so only the ORDER needs the lock, but it does need it)
             integrity.write_json_atomic(self.path, doc)
 
     def reset(self) -> None:

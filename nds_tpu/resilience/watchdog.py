@@ -39,6 +39,8 @@ import threading
 import time
 import traceback
 
+from nds_tpu.analysis import locksan
+
 WATCHDOG_ENV = "NDS_TPU_WATCHDOG"
 # stream supervisors name each child's unit through this env var (the
 # power loop falls back to "power-<suite>"); restarted incarnations get
@@ -49,7 +51,7 @@ STREAM_ENV = "NDS_TPU_STREAM"
 # from query failures (1) and signals (<0) in the supervisor's summary
 EXIT_STALLED = 86
 
-_lock = threading.Lock()
+_lock = locksan.lock("resilience.watchdog._lock")
 _beats: dict[str, dict] = {}
 
 # stall hooks (obs/fleet.py flight-recorder dump, obs/profile.py

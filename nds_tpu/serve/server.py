@@ -38,6 +38,7 @@ from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
+from nds_tpu.analysis import locksan
 from nds_tpu.obs import metrics as obs_metrics
 
 DEFAULT_MAX_QUEUE = 64
@@ -103,11 +104,11 @@ class QueryServer:
         # keep their arrival position — a tail re-enqueue would let
         # sustained same-template traffic starve an early stranger
         self._queue: "deque[Request]" = deque()
-        self._cv = threading.Condition()
+        self._cv = locksan.condition("serve.QueryServer._cv")
         self._running = False
         self._stopped = False
         self._thread: "threading.Thread | None" = None
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.QueryServer._lock")
         self._inflight = 0
         self.stats = {"submitted": 0, "completed": 0, "shed": 0,
                       "errors": 0, "batched": 0,
@@ -226,10 +227,13 @@ class QueryServer:
                 shed = None
                 self._queue.append(req)
                 self._cv.notify()
+            # depth captured under the condition (the engine thread
+            # mutates the deque); the gauge write happens outside it
+            depth = len(self._queue)
         if shed:
             self._finish_shed(req, shed)
             return req.future
-        obs_metrics.gauge("server_queue_depth").set(len(self._queue))
+        obs_metrics.gauge("server_queue_depth").set(depth)
         return req.future
 
     # ------------------------------------------------- engine thread
@@ -249,8 +253,9 @@ class QueryServer:
                 # and keeps serving (shed-not-crash applies to bugs too)
                 self._finish_error(req,
                                    f"{type(exc).__name__}: {exc}")
-            obs_metrics.gauge("server_queue_depth").set(
-                len(self._queue))
+            with self._cv:
+                depth = len(self._queue)
+            obs_metrics.gauge("server_queue_depth").set(depth)
 
     def _too_old(self, req: Request) -> bool:
         return (self.deadline_ms > 0
@@ -320,7 +325,11 @@ class QueryServer:
                         r for r in self._queue if id(r) not in drop)
                 group.extend(taken)
             if len(group) > 1:
-                self.stats["batched"] += len(group) - 1
+                # under the stats lock: submit() mutates sibling keys
+                # from caller threads while the engine thread runs this
+                # (the ndsraces NDSR201 finding that proved the auditor)
+                with self._lock:
+                    self.stats["batched"] += len(group) - 1
                 obs_metrics.counter("server_batched_total").inc(
                     len(group) - 1)
         for member in group:

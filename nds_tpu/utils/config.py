@@ -118,6 +118,27 @@ Serving keys (the query server, nds_tpu/serve/ — README "Serving"):
                             ``ndsreport analyze`` reports serving
                             p50/p99 like any run dir (unset = no
                             summaries)
+
+Diagnostics env toggles (no config-file analog — they gate process
+instrumentation, not workload shape, and must be readable before any
+config loads):
+
+  NDS_TPU_LOCKSAN=1         runtime lock-order sanitizer
+                            (nds_tpu/analysis/locksan.py): every lock
+                            the engine's threaded modules create is
+                            wrapped to record per-thread acquisition
+                            order; inversions print loudly, count on
+                            ``lock_order_inversions_total``, and fail
+                            the tier-1 locksan gate. On for tests
+                            (tests/conftest.py) and the chaos/soak/
+                            serve gates; off (zero overhead) by
+                            default.
+  NDS_TPU_LOCKSAN_REPORT    directory the sanitizer writes its
+                            ``locksan-<pid>.json`` exit report into
+                            (atomic, thread-unique tmp); unset =
+                            stderr-only on inversions. static_checks
+                            points subprocess fleets at a shared dir
+                            and sweeps it.
 """
 
 from __future__ import annotations

@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 import traceback
 from typing import Callable
+
+from nds_tpu.analysis import locksan
 
 _REDACTED_MARKERS = ("TOKEN", "SECRET", "PASSWORD", "KEY", "CREDENTIAL")
 
@@ -61,7 +62,7 @@ class TaskFailureCollector:
     # concurrent throughput streams notify from their own threads; the
     # class-level listener list and each listener's failure store must
     # not race (lost appends silently under-report anomalies)
-    _lock = threading.Lock()
+    _lock = locksan.lock("utils.TaskFailureCollector._lock")
 
     def __init__(self) -> None:
         # ordered UNIQUE reasons; repeats count in _counts so a noisy

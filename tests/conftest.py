@@ -25,6 +25,14 @@ os.environ["NDS_TPU_VERIFY_PLANS"] = "1"
 # fixtures predating manifests keep working
 os.environ["NDS_TPU_VERIFY_DIGESTS"] = "1"
 
+# runtime lock-order sanitizer (nds_tpu/analysis/locksan.py): every
+# engine lock created in the test process (and in the fleet/soak/serve
+# subprocesses, which inherit the env) is wrapped to record per-thread
+# acquisition order — an inversion any test provokes prints loudly and
+# fails the static_checks locksan gate. setdefault so NDS_TPU_LOCKSAN=0
+# can opt a debugging session out.
+os.environ.setdefault("NDS_TPU_LOCKSAN", "1")
+
 
 def _jaxlib_knows(*flag_names: str) -> bool:
     """True when the installed jaxlib's binaries mention EVERY given

@@ -14,7 +14,10 @@ Waivers are per-line and must carry a justification:
 
 Exit 0 when the tree is clean (waived findings print with their notes
 under -v); exit 1 on any unwaived violation, malformed waiver, or
-stale waiver. Run by tools/static_checks.py as a tier-1 gate.
+stale waiver. ``--waiver-report`` prints the tree-wide waiver-hygiene
+report instead (shared with tools/ndsraces.py: per-rule counts for
+both tools, stale waivers flagged). Run by tools/static_checks.py as a
+tier-1 gate.
 """
 
 from __future__ import annotations
@@ -36,14 +39,14 @@ DEFAULT_CONFIG = {
 }
 
 
-def load_config(repo: pathlib.Path) -> dict:
-    """[tool.ndslint] from pyproject.toml, via tomllib/tomli when
+def load_section(repo: pathlib.Path, section: str) -> dict:
+    """A ``[tool.*]`` table from pyproject.toml, via tomllib/tomli when
     available with a string/string-list fallback parser otherwise (the
-    config uses nothing fancier)."""
-    cfg = dict(DEFAULT_CONFIG)
+    configs use nothing fancier). Shared with tools/ndsraces.py — one
+    config grammar for both gates."""
     pp = repo / "pyproject.toml"
     if not pp.exists():
-        return cfg
+        return {}
     text = pp.read_text()
     data = None
     for mod in ("tomllib", "tomli"):
@@ -53,20 +56,30 @@ def load_config(repo: pathlib.Path) -> dict:
         except ImportError:
             continue
     if data is not None:
-        cfg.update(data.get("tool", {}).get("ndslint", {}))
-        return cfg
+        out = data
+        for part in section.split("."):
+            out = out.get(part, {}) if isinstance(out, dict) else {}
+        return dict(out) if isinstance(out, dict) else {}
     # minimal fallback: section header + `key = [...]` string lists
+    cfg: dict = {}
     in_section = False
     for line in text.splitlines():
         s = line.strip()
         if s.startswith("["):
-            in_section = s == "[tool.ndslint]"
+            in_section = s == f"[{section}]"
             continue
         if in_section and "=" in s:
             key, _, val = s.partition("=")
             items = [v.strip().strip("\"'")
                      for v in val.strip().strip("[]").split(",")]
             cfg[key.strip()] = [v for v in items if v]
+    return cfg
+
+
+def load_config(repo: pathlib.Path) -> dict:
+    """[tool.ndslint] overlaid on the defaults."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(load_section(repo, "tool.ndslint"))
     return cfg
 
 
@@ -106,8 +119,17 @@ def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print waived findings with their notes")
+    ap.add_argument("--waiver-report", action="store_true",
+                    help="print the tree-wide waiver-hygiene report "
+                         "(per-rule counts for ndslint AND ndsraces, "
+                         "stale waivers flagged)")
     args = ap.parse_args(argv)
     repo = pathlib.Path(__file__).resolve().parent.parent
+    if args.waiver_report:
+        # the report spans both gates; the shared implementation lives
+        # with the younger tool (lazy import breaks the import cycle)
+        import ndsraces
+        return ndsraces.waiver_report(repo, verbose=args.verbose)
     return run(repo, verbose=args.verbose)
 
 

@@ -1,0 +1,121 @@
+"""ndsraces: run the concurrency auditor over the tree.
+
+Drives ``nds_tpu/analysis/concurrency.py`` (rule catalog NDSR201-204 +
+waiver semantics live there). Configuration comes from
+``[tool.ndsraces]`` in pyproject.toml (same shape as ndslint's):
+
+    roots   = ["nds_tpu"]      # directories to audit
+    exclude = []               # path substrings to skip
+    rules   = []               # rule-id allowlist ([] = all)
+
+Waivers are per-line and must carry a justification:
+
+    self.dumps + 1  # ndsraces: waive[NDSR201] -- signal-path fallback
+
+Exit 0 when the tree is clean (waived findings print with their notes
+under -v); exit 1 on any unwaived violation, malformed waiver, or stale
+waiver. ``--waiver-report`` prints the tree-wide waiver-hygiene report
+(shared with ``ndslint --waiver-report``: per-rule counts for BOTH
+tools, stale waivers flagged); ``--locksan-selftest`` seeds a
+deliberate lock-order inversion through the runtime sanitizer
+(nds_tpu/analysis/locksan.py) and exits 0 only when it is caught — the
+tier-1 proof the detector fires. Run by tools/static_checks.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import ndslint  # noqa: E402
+
+from nds_tpu.analysis import concurrency, lint_rules  # noqa: E402
+
+DEFAULT_CONFIG = {
+    "roots": ["nds_tpu"],
+    "exclude": [],
+    "rules": [],
+}
+
+
+def load_config(repo: pathlib.Path) -> dict:
+    """[tool.ndsraces] from pyproject.toml, through ndslint's parser
+    (one config grammar for both gates)."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(ndslint.load_section(repo, "tool.ndsraces"))
+    return cfg
+
+
+def run(repo: pathlib.Path, verbose: bool = False,
+        cfg: "dict | None" = None) -> int:
+    cfg = load_config(repo) if cfg is None else cfg
+    sources = ndslint.collect_sources(repo, cfg)
+    enabled = set(cfg["rules"]) or None
+    res = concurrency.audit_sources(sources, enabled=enabled)
+    for v in res.violations + res.errors:
+        print(v)
+    if verbose:
+        for v in res.waived:
+            print(f"{v.path}:{v.line}: {v.rule} waived -- "
+                  f"{v.waiver_note}")
+    bad = len(res.violations) + len(res.errors)
+    print(f"{'FAIL' if bad else 'OK'}: {bad} violation(s), "
+          f"{len(res.waived)} waived, {len(sources)} file(s)")
+    return 1 if bad else 0
+
+
+def waiver_report(repo: pathlib.Path, verbose: bool = False) -> int:
+    """The shared ndslint+ndsraces waiver-hygiene report."""
+    lint_cfg = ndslint.load_config(repo)
+    races_cfg = load_config(repo)
+    results = {
+        "ndslint": lint_rules.lint_sources(
+            ndslint.collect_sources(repo, lint_cfg),
+            enabled=set(lint_cfg["rules"]) or None),
+        "ndsraces": concurrency.audit_sources(
+            ndslint.collect_sources(repo, races_cfg),
+            enabled=set(races_cfg["rules"]) or None),
+    }
+    for line in lint_rules.waiver_report(results, verbose=verbose):
+        print(line)
+    stale = sum(1 for res in results.values() for e in res.errors
+                if "matches no violation" in e.msg)
+    print(f"{'FAIL' if stale else 'OK'}: {stale} stale waiver(s)")
+    return 1 if stale else 0
+
+
+def locksan_selftest() -> int:
+    from nds_tpu.analysis import locksan
+    ok = locksan.selftest()
+    print(f"{'OK' if ok else 'FAIL'}: locksan "
+          f"{'caught' if ok else 'MISSED'} the seeded lock-order "
+          f"inversion + re-entrant acquire")
+    return 0 if ok else 1
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print waived findings with their notes")
+    ap.add_argument("--waiver-report", action="store_true",
+                    help="print the shared ndslint+ndsraces waiver "
+                         "hygiene report instead of auditing")
+    ap.add_argument("--locksan-selftest", action="store_true",
+                    help="seed a lock-order inversion through the "
+                         "runtime sanitizer; exit 0 iff it is caught")
+    args = ap.parse_args(argv)
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    if args.locksan_selftest:
+        return locksan_selftest()
+    if args.waiver_report:
+        return waiver_report(repo, verbose=args.verbose)
+    return run(repo, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@ the one to run locally before pushing:
   3. ndslint            hazard-class lint over nds_tpu/ + tools/
                         (rules + waiver semantics:
                         nds_tpu/analysis/lint_rules.py)
+  3b. ndsraces          concurrency audit over nds_tpu/ (guard
+                        inference, static lock-order graph,
+                        signal-handler safety, thread-shared mutation;
+                        rules NDSR201-204:
+                        nds_tpu/analysis/concurrency.py) — zero
+                        unwaived findings, stale waivers fail
   4. ndsverify          plan + verify all 103 NDS and 22 NDS-H
                         statements on CPU (invariants:
                         nds_tpu/analysis/plan_verify.py), each with a
@@ -91,6 +97,16 @@ the one to run locally before pushing:
                         analyze, an overload burst sheds
                         (server_shed_total > 0) without a single
                         error, and the TCP JSON-lines front answers
+ 12. locksan            runtime lock-order sanitizer verdict
+                        (nds_tpu/analysis/locksan.py): a SEEDED
+                        inversion + re-entrant acquire on a private
+                        graph must be caught (the detector provably
+                        fires), the chaos/soak/serve/fleet workloads
+                        above — which all ran with NDS_TPU_LOCKSAN=1 —
+                        must have witnessed ZERO inversions in this
+                        process, and every child-process report swept
+                        from NDS_TPU_LOCKSAN_REPORT must be
+                        inversion-free too
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -105,6 +121,14 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# before ANY nds_tpu import: module-level locks (obs/trace,
+# resilience/watchdog, the metrics registry) are created at import
+# time, and they must be sanitizer-wrapped for the locksan section's
+# inversion-free verdict over the chaos/soak/serve workloads to mean
+# anything. FORCED, not setdefault: an ambient NDS_TPU_LOCKSAN=0 (the
+# pytest debugging opt-out) would make section 12's verdict vacuous.
+os.environ["NDS_TPU_LOCKSAN"] = "1"
+
 import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
@@ -112,6 +136,7 @@ import compress_check  # noqa: E402
 import fleet_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
+import ndsraces  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
 import serve_check  # noqa: E402
@@ -170,13 +195,65 @@ def run_ndsreport_check() -> int:
     return 1 if (rc or errors) else 0
 
 
+def run_locksan_check() -> int:
+    """Section 12: the runtime sanitizer verdict. Three parts:
+    (1) a seeded AB/BA inversion plus a re-entrant acquire on a
+    PRIVATE graph must be caught — the detector provably fires;
+    (2) this process, which ran the chaos/compress/serve workloads
+    with every engine lock wrapped, must hold zero inversions;
+    (3) child processes (fleet/soak subprocess runs) wrote
+    locksan-<pid>.json reports into NDS_TPU_LOCKSAN_REPORT at exit —
+    sweep them, all must be inversion-free."""
+    import glob
+    import json
+    from nds_tpu.analysis import locksan
+    if not locksan.enabled():
+        # belt for the forced env above: an unsanitized run has no
+        # inversion-free claim to make, and silence would fake one
+        print(f"FAIL: {locksan.ENV} is off — the workloads above ran "
+              f"unsanitized, so this verdict would be vacuous")
+        return 1
+    if not locksan.selftest():
+        print("FAIL: locksan missed the seeded inversion")
+        return 1
+    inproc = locksan.inversion_count()
+    child_inv = 0
+    reports = 0
+    report_dir = os.environ.get(locksan.REPORT_ENV)
+    if report_dir:
+        for path in sorted(glob.glob(
+                os.path.join(report_dir, "locksan-*.json"))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            reports += 1
+            for inv in doc.get("inversions", []):
+                child_inv += 1
+                print(f"  child inversion ({os.path.basename(path)}): "
+                      f"{' -> '.join(inv.get('cycle', []))}")
+    bad = inproc + child_inv
+    print(f"{'FAIL' if bad else 'OK'}: seeded inversion caught; "
+          f"{inproc} in-process + {child_inv} child inversion(s) "
+          f"across {reports} child report(s)")
+    return 1 if bad else 0
+
+
 def main() -> int:
     import pathlib
     repo = pathlib.Path(__file__).resolve().parent.parent
+    # child runs (fleet/soak/serve subprocesses) inherit this dir and
+    # write their sanitizer reports into it at exit; section 12 sweeps
+    # it (children killed with -9 or os._exit leave none — best effort)
+    os.environ.setdefault(
+        "NDS_TPU_LOCKSAN_REPORT",
+        tempfile.mkdtemp(prefix="nds_tpu_locksan_"))
     sections = [
         ("headers", check_headers.main),
         ("trace-schema", run_trace_schema_check),
         ("ndslint", lambda: ndslint.run(repo)),
+        ("ndsraces", lambda: ndsraces.run(repo)),
         ("ndsverify", lambda: ndsverify.main([])),
         ("chaos", chaos_check.main),
         ("ndsreport", run_ndsreport_check),
@@ -185,6 +262,7 @@ def main() -> int:
         ("soak", lambda: soak_check.main([])),
         ("compress", lambda: compress_check.main([])),
         ("serve", lambda: serve_check.main([])),
+        ("locksan", run_locksan_check),
     ]
     failed = []
     for name, fn in sections:
