@@ -1003,6 +1003,63 @@ class EarlyMaterializationRule(Rule):
         return out
 
 
+class BlockingTransferInStreamLoopRule(Rule):
+    """NDS117: a blocking device->host transfer inside the chunked
+    engine's phase-A stream loops or the prefetch worker. The pipelined
+    executor (``engine/pipeline_io.py``; README "Pipelined execution")
+    exists so host staging overlaps device compute; a stray
+    ``jax.device_get(...)``, ``.block_until_ready()``, or
+    ``np.asarray(<device result>)`` inside a chunk loop serializes the
+    pipeline right back to the pre-overlap behavior — silently, since
+    results stay correct and only occupancy collapses. The two
+    SANCTIONED per-chunk sync points (the partial-agg overflow verdict,
+    the keep-mask readback — each IS the loop's product) carry waivers
+    saying so; anything new must justify why its sync cannot move to a
+    chunk boundary."""
+
+    id = "NDS117"
+    name = "blocking-transfer-in-stream-loop"
+    paths = ("engine/chunked_exec.py", "engine/pipeline_io.py")
+
+    def check(self, tree, src, path):
+        out = []
+        seen: set = set()
+        loops = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.For, ast.While))]
+        for loop in loops:
+            for n in ast.walk(loop):
+                if id(n) in seen or not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                hit = None
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "device_get":
+                        hit = "jax.device_get(...)"
+                    elif f.attr == "block_until_ready":
+                        hit = ".block_until_ready()"
+                    elif (f.attr == "asarray"
+                          and isinstance(f.value, ast.Name)
+                          and f.value.id in ("np", "numpy")
+                          and n.args
+                          and isinstance(n.args[0], ast.Call)):
+                        # np.asarray over a CALL result (a device
+                        # computation) syncs; slicing host arrays
+                        # (np.asarray(col.values[...])) does not
+                        hit = "np.asarray(<device result>)"
+                if hit is None:
+                    continue
+                seen.add(id(n))
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    f"{hit} inside a chunk-stream loop blocks the "
+                    f"prefetch pipeline (transfers must stay async — "
+                    f"jax.device_put — with syncs only at sanctioned "
+                    f"per-chunk read-back points); move the sync to a "
+                    f"chunk boundary or waive with why this sync is "
+                    f"the loop's product"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
@@ -1010,7 +1067,8 @@ def default_rules() -> "list[Rule]":
             NonAtomicJsonWriteRule(), DirectExecutorRule(),
             UncachedCompileRule(), Int64EmulationHazardRule(),
             DirectProfilerRule(), UnchainedSignalHandlerRule(),
-            BlockingInAsyncRule(), EarlyMaterializationRule()]
+            BlockingInAsyncRule(), EarlyMaterializationRule(),
+            BlockingTransferInStreamLoopRule()]
 
 
 # -------------------------------------------------------------- driver

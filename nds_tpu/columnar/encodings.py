@@ -316,7 +316,14 @@ def encode_values(spec: EncSpec, values: np.ndarray, mask=None,
     it); RLE runs derive from the live prefix and the decode extends
     the last run over the pad. Null/pad slots clip into the packed
     range — they are gated by the row/validity masks, never read as
-    values."""
+    values.
+
+    THREAD CONTRACT (engine/pipeline_io.py stages chunks on a worker
+    thread): this function is a pure function of its arguments — no
+    module/column memo is read or written here (``column_spec`` /
+    ``chunk_spec`` derive specs on the CALLING thread before staging
+    begins) — and its numpy kernels release the GIL, which is exactly
+    what lets chunk N+1's encode overlap chunk N's XLA compute."""
     from nds_tpu.analysis import plan_verify
     if plan_verify.verify_enabled():
         vs = plan_verify.check_encoding_spec(spec, values, mask,

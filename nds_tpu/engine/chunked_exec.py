@@ -26,6 +26,15 @@ loop.)
 The per-chunk program is compiled ONCE per (table, plan): every chunk
 has the same static shape; the tail chunk passes its logical row count
 as a traced scalar, not a new shape.
+
+Both phase-A loops ride the double-buffered prefetcher
+(``engine/pipeline_io.py``, README "Pipelined execution"): host-side
+slicing + columnar encoding + the ``jax.device_put`` for chunk N+1 run
+on a worker thread while the compiled program scans chunk N, so scan
+never blocks compute. ``engine.prefetch.enabled=off`` /
+``NDS_TPU_PREFETCH=0`` restores the byte-identical serial loops; the
+prefetch shapes nothing the chunkscan fingerprint sees, so warm-cache
+runs stay at zero compiles either way.
 """
 
 from __future__ import annotations
@@ -36,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from nds_tpu.engine import device_exec as dx
+from nds_tpu.engine import pipeline_io
 from nds_tpu.engine.device_exec import DCtx, DVal
 from nds_tpu.engine.types import (
     INT64, DecimalType, FloatType, Schema, StringType,
@@ -190,20 +200,34 @@ class _PartialAggExecutor(_PhaseBExecutor):
 class _ForwardResult:
     """Async handle that forwards the phase-B sub-executor's finalized
     timings + query span back onto the outer ChunkedExecutor when the
-    caller blocks on result()."""
+    caller blocks on result(). Phase A's prefetch attribution
+    (engine/pipeline_io.py) merges into the published timings here —
+    the one place the sub-executor's bill and the outer executor's
+    staging overlap meet."""
 
-    __slots__ = ("outer", "sub", "inner")
+    __slots__ = ("outer", "sub", "inner", "pf")
 
-    def __init__(self, outer, sub, inner):
+    def __init__(self, outer, sub, inner, pf=None):
         self.outer = outer
         self.sub = sub
         self.inner = inner
+        self.pf = dict(pf or {})
 
     def result(self):
         out = self.inner.result()
-        self.outer.last_timings = self.sub.last_timings
-        self.outer.last_query_span = getattr(
-            self.sub, "last_query_span", None)
+        timings = self.sub.last_timings
+        span = getattr(self.sub, "last_query_span", None)
+        if self.pf and isinstance(timings, dict):
+            timings.update(self.pf)
+            # the span carries a FILTERED copy of the timings as its
+            # exported attr (device_exec._finish_traced): update it too
+            # so span-fed consumers (obs.query_timings) see the
+            # prefetch keys
+            attr = span.attrs.get("timings") if span else None
+            if isinstance(attr, dict):
+                attr.update(self.pf)
+        self.outer.last_timings = timings
+        self.outer.last_query_span = span
         return out
 
 
@@ -218,14 +242,40 @@ class ChunkedExecutor(dx.DeviceExecutor):
     def __init__(self, tables: dict[str, HostTable],
                  stream_bytes: int = DEFAULT_STREAM_BYTES,
                  chunk_rows: int = DEFAULT_CHUNK_ROWS,
-                 float_dtype=None):
+                 float_dtype=None,
+                 prefetch_depth: "int | None" = None):
         super().__init__(tables, float_dtype)
         self.stream_bytes = stream_bytes
         self.chunk_rows = chunk_rows
+        # double-buffered phase-A prefetch depth (engine/pipeline_io.py;
+        # 0 = the byte-identical serial loops). The scheduler may lower
+        # it per query (governor depth admission, ladder relief entry)
+        # through the same _restore contract as chunk_rows
+        self.prefetch_depth = (pipeline_io.resolve_depth()
+                               if prefetch_depth is None
+                               else max(0, int(prefetch_depth)))
+        # per-query prefetch attribution (wait billed to wall-clock,
+        # hidden overlapped under compute), merged into the published
+        # timings at result() by _ForwardResult
+        self._pf_stats: dict = {}
         # (plan key) -> phase-B executor
         self._reduced: dict[object, _PhaseBExecutor] = {}
         # (table, filter repr) -> reduced HostTable, shared across plans
         self._survivor_cache: dict[tuple, HostTable] = {}
+
+    def _note_prefetch(self, stats: dict) -> None:
+        """Fold one prefetcher's close() stats into the query's
+        attribution (several phase-A loops can run per query — one per
+        streamed table plus the partial-agg chunk loop)."""
+        if not stats or stats.get("depth", 0) <= 0:
+            return
+        pf = self._pf_stats
+        pf["prefetch_wait_ms"] = (pf.get("prefetch_wait_ms", 0.0)
+                                  + stats["wait_s"] * 1000.0)
+        pf["prefetch_hidden_s"] = (pf.get("prefetch_hidden_s", 0.0)
+                                   + stats["hidden_s"])
+        pf["prefetch_depth"] = max(pf.get("prefetch_depth", 0),
+                                   stats["depth"])
 
     def _is_streamed(self, table: str) -> bool:
         return _table_bytes(self.tables[table]) > self.stream_bytes
@@ -254,6 +304,11 @@ class ChunkedExecutor(dx.DeviceExecutor):
         # executor; last_timings rebinds only after phase A succeeds)
         self.last_query_span = None
         self.last_timings = {}
+        # fresh prefetch attribution window: phase A below may run
+        # several prefetchers; their stats accumulate here and publish
+        # at result() (a plan-cache-warm query that skips phase A
+        # publishes nothing)
+        self._pf_stats = {}
         # graceful degradation: an OOM-classified failure halves the
         # chunk size and rebuilds phase A before giving up — the
         # out-of-core engine's whole premise is that residency, not
@@ -289,7 +344,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
         self.last_timings = sub.last_timings
         # the sub-executor's span/timings finalize at result(): forward
         # them so obs.query_timings(chunked_executor) sees the query
-        return _ForwardResult(self, sub, res)
+        return _ForwardResult(self, sub, res, pf=self._pf_stats)
 
     def _build_phase_b(self, planned: P.PlannedQuery, scans: dict):
         """Phase A (reduce streamed tables) + phase-B executor choice
@@ -539,59 +594,93 @@ class ChunkedExecutor(dx.DeviceExecutor):
             entry = ex._compiled[id(planned_a)]
             compiled, side = entry["compiled"], entry["side"]
             slack = entry["slack"]
-            for s, e in group[1:]:
-                check_deadline()
-                watchdog.beat("engine", phase="chunk.partial_agg",
-                              table=table)
-                bufs = ex._collect_buffers(planned_a)
+            # the swap key template: exactly the streamed table's
+            # buffer keys the compiled program consumes (raw uploads —
+            # see _no_encode above), fixed after chunk 0's compile
+            tmpl = set(ex._collect_buffers(planned_a))
+
+            def _stage_swap(span):
+                """Host half of one chunk: slice the streamed columns
+                and issue their async host->device transfer
+                (jax.device_put). Runs on the prefetch worker when
+                depth > 0 — while the compiled program is still
+                executing the previous chunk."""
+                s, e = span
+                swap = {}
                 for name in big.columns:
                     bkey = f"{table}.{name}"
-                    if bkey not in bufs:
+                    if bkey not in tmpl:
                         continue
                     col = big.columns[name]
-                    bufs[bkey] = jnp.asarray(col.values[s:e])
-                    if bkey + "#v" in bufs:
-                        bufs[bkey + "#v"] = jnp.asarray(
+                    swap[bkey] = jax.device_put(col.values[s:e])
+                    if bkey + "#v" in tmpl:
+                        swap[bkey + "#v"] = jax.device_put(
                             col.null_mask[s:e])
-                # per-chunk memory window: swapped chunk buffers are
-                # the only per-iteration live set (obs/memwatch)
-                win = sum(getattr(b, "nbytes", 0)
-                          for b in bufs.values())
-                memwatch.add_live(win)
-                try:
-                    # overflow-retry on the shared policy
-                    # (slack-doubling shape, no backoff sleep — same
-                    # as dist_exec)
-                    from nds_tpu.engine.scheduler import adaptive_policy
-                    overflow_policy = adaptive_policy(4)
-                    for attempt in overflow_policy.attempts():
-                        row, outs, overflow = compiled(bufs)
-                        row_h, outs_h, over_h = jax.device_get(
-                            (row, outs, overflow))
-                        if int(over_h) == 0:
-                            break
-                        if attempt == overflow_policy.max_attempts - 1:
-                            raise dx.DeviceExecError(
-                                "partial-agg chunk overflow persisted")
-                        # skewed chunk expands past the chunk-0-sized
-                        # join capacity: double slack and recompile,
-                        # same as the executor's own overflow-retry
-                        # contract
-                        from nds_tpu.utils.report import (
-                            TaskFailureCollector,
+                return swap, sum(b.nbytes for b in swap.values())
+
+            pf = pipeline_io.ChunkPrefetcher(
+                group[1:], _stage_swap, self.prefetch_depth,
+                table=table)
+            try:
+                for staged in pf:
+                    s, e = staged.item
+                    check_deadline()
+                    watchdog.beat("engine", phase="chunk.partial_agg",
+                                  table=table)
+                    bufs = ex._collect_buffers(planned_a)
+                    bufs.update(staged.payload)
+                    # per-chunk memory window (obs/memwatch): the
+                    # staged swap bytes are accounted by the
+                    # prefetcher from stage to release; the shared
+                    # pool references bracket the compute only —
+                    # together the live set the serial loop accounted
+                    win = sum(getattr(b, "nbytes", 0)
+                              for k, b in bufs.items()
+                              if k not in staged.payload)
+                    memwatch.add_live(win)
+                    try:
+                        # overflow-retry on the shared policy
+                        # (slack-doubling shape, no backoff sleep —
+                        # same as dist_exec)
+                        from nds_tpu.engine.scheduler import (
+                            adaptive_policy,
                         )
-                        slack *= 2
-                        TaskFailureCollector.notify(
-                            f"partial-agg chunk [{s}:{e}] overflow; "
-                            f"recompiling with slack={slack}")
-                        from nds_tpu.cache import aot as cache_aot
-                        jitted, side = ex._compile(planned_a, slack)
-                        compiled = cache_aot.lower_and_compile(jitted,
-                                                               bufs)
-                finally:
-                    memwatch.sub_live(win)
-                parts.append(ex._materialize(planned_a, row_h, outs_h,
-                                             side))
+                        overflow_policy = adaptive_policy(4)
+                        for attempt in overflow_policy.attempts():
+                            row, outs, overflow = compiled(bufs)
+                            # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the overflow verdict gates the slack-doubling retry, and the partials must land on host before the next chunk swaps buffers
+                            row_h, outs_h, over_h = jax.device_get(
+                                (row, outs, overflow))
+                            if int(over_h) == 0:
+                                break
+                            if attempt == overflow_policy.max_attempts - 1:
+                                raise dx.DeviceExecError(
+                                    "partial-agg chunk overflow "
+                                    "persisted")
+                            # skewed chunk expands past the
+                            # chunk-0-sized join capacity: double
+                            # slack and recompile, same as the
+                            # executor's own overflow-retry contract
+                            from nds_tpu.utils.report import (
+                                TaskFailureCollector,
+                            )
+                            slack *= 2
+                            TaskFailureCollector.notify(
+                                f"partial-agg chunk [{s}:{e}] "
+                                f"overflow; recompiling with "
+                                f"slack={slack}")
+                            from nds_tpu.cache import aot as cache_aot
+                            jitted, side = ex._compile(planned_a,
+                                                       slack)
+                            compiled = cache_aot.lower_and_compile(
+                                jitted, bufs)
+                    finally:
+                        memwatch.sub_live(win)
+                        staged.release()
+                    parts.append(ex._materialize(planned_a, row_h,
+                                                 outs_h, side))
+            finally:
+                self._note_prefetch(pf.close())
         return parts
 
     @staticmethod
@@ -718,48 +807,57 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 keep = keep | ctx.row
             return keep
 
+        def _stage_chunk(span):
+            """Host half of one scan chunk: slice, pad the tail to the
+            static shape, columnar-encode (pure numpy), and issue the
+            async host->device transfer. Runs on the prefetch worker
+            when depth > 0, overlapping the compiled keep-mask program
+            still scanning the previous chunk."""
+            start, stop = span
+            bufs = {}
+            for name in need_cols:
+                col = t.columns[name]
+                sl = col.values[start:stop]
+                m = (None if col.null_mask is None
+                     else col.null_mask[start:stop])
+                if stop - start < C:  # tail: pad to the chunk shape
+                    pad = C - (stop - start)
+                    sl = np.concatenate(
+                        [sl, np.zeros(pad, dtype=sl.dtype)])
+                    if m is not None:
+                        m = np.concatenate(
+                            [m, np.zeros(pad, dtype=bool)])
+                spec = chunk_specs.get(name)
+                if spec is not None:
+                    # every chunk encodes with the shared full-bounds
+                    # spec: shapes stay static, so the one compiled
+                    # program serves all chunks (the padded tail past
+                    # nrows clips freely)
+                    for sfx, arr in columnar.encode_values(
+                            spec, sl, m, nrows=stop - start).items():
+                        bufs[name + sfx] = jax.device_put(arr)
+                    continue
+                bufs[name] = jax.device_put(sl)
+                if m is not None:
+                    bufs[name + "#v"] = jax.device_put(m)
+            return bufs, sum(b.nbytes for b in bufs.values())
+
+        chunk_spans = [(start, min(start + C, n))
+                       for start in range(0, n, C)]
+        pf = pipeline_io.ChunkPrefetcher(
+            chunk_spans, _stage_chunk, self.prefetch_depth, table=table)
         try:
             compiled = None
             keep_np = np.empty(n, dtype=bool)
-            for start in range(0, n, C):
+            for staged in pf:
+                start, stop = staged.item
                 # same between-chunk control point as the partial-agg
                 # loop: deadline stops a doomed scan at the next chunk,
                 # the beat keeps the watchdog fed during long scans
                 check_deadline()
                 watchdog.beat("engine", phase="chunk.scan", table=table)
                 obs_metrics.counter("chunk_scans_total").inc()
-                stop = min(start + C, n)
-                bufs = {}
-                for name in need_cols:
-                    col = t.columns[name]
-                    sl = col.values[start:stop]
-                    m = (None if col.null_mask is None
-                         else col.null_mask[start:stop])
-                    if stop - start < C:  # tail: pad to the chunk shape
-                        pad = C - (stop - start)
-                        sl = np.concatenate(
-                            [sl, np.zeros(pad, dtype=sl.dtype)])
-                        if m is not None:
-                            m = np.concatenate(
-                                [m, np.zeros(pad, dtype=bool)])
-                    spec = chunk_specs.get(name)
-                    if spec is not None:
-                        # every chunk encodes with the shared
-                        # full-bounds spec: shapes stay static, so
-                        # the one compiled program serves all chunks
-                        # (the padded tail past nrows clips freely)
-                        for sfx, arr in columnar.encode_values(
-                                spec, sl, m,
-                                nrows=stop - start).items():
-                            bufs[name + sfx] = jnp.asarray(arr)
-                        continue
-                    bufs[name] = jnp.asarray(sl)
-                    if m is not None:
-                        bufs[name + "#v"] = jnp.asarray(m)
-                # per-chunk memory window (obs/memwatch fallback
-                # accounting): only one chunk's buffers live at a time
-                win = sum(b.nbytes for b in bufs.values())
-                memwatch.add_live(win)
+                bufs = staged.payload
                 try:
                     if compiled is None:
                         # every chunk shares one static shape (the tail
@@ -769,11 +867,12 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         compiled = self._keep_mask_compiled(
                             table, scans, need_cols, C, fn, bufs,
                             chunk_specs)
+                    # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the keep mask IS phase A's product and must land on host before the survivor gather
                     keep_np[start:stop] = np.asarray(
                         compiled(bufs,
                                  jnp.int32(stop - start)))[:stop - start]
                 finally:
-                    memwatch.sub_live(win)
+                    staged.release()
             if skipped:
                 from nds_tpu.utils.report import TaskFailureCollector
                 TaskFailureCollector.notify(
@@ -786,12 +885,26 @@ class ChunkedExecutor(dx.DeviceExecutor):
                 # deadlined queries abort; "keep all rows" would turn a
                 # timeout into an even slower full-table phase B
                 raise
+            from nds_tpu.resilience.retry import TRANSIENT, classify
+            if classify(exc) == TRANSIENT:
+                # classified transients (injected faults, OOM) PROPAGATE
+                # instead of degrading: the executor's chunk-halving
+                # loop handles the OOMs and the pipeline's retry policy
+                # re-runs the rest — retry semantics identical whether
+                # the staging ran inline or on the prefetch worker. The
+                # keep-all fallback would silently trade a retryable
+                # hiccup for a full-table phase B.
+                raise
             from nds_tpu.utils.report import TaskFailureCollector
             obs_metrics.counter("chunk_fallbacks_total").inc()
             TaskFailureCollector.notify(
                 f"chunked scan fell back to full rows for {table}: "
                 f"{type(exc).__name__}: {exc}")
             return np.ones(n, dtype=bool)
+        finally:
+            # cancel-at-chunk-boundary + unconsumed-buffer release on
+            # every exit path (success, fallback, deadline abort, drain)
+            self._note_prefetch(pf.close())
 
     def _keep_mask_compiled(self, table: str, scans: list,
                             need_cols: list, C: int, fn, bufs: dict,
@@ -829,7 +942,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
 
 def make_chunked_factory(stream_bytes: int = DEFAULT_STREAM_BYTES,
                          chunk_rows: int = DEFAULT_CHUNK_ROWS,
-                         precision: str = "f64"):
+                         precision: str = "f64",
+                         prefetch_depth: "int | None" = None):
     """Session executor factory (make_device_factory analog) for the
     out-of-core engine."""
     if precision not in dx.PRECISIONS:
@@ -842,7 +956,8 @@ def make_chunked_factory(stream_bytes: int = DEFAULT_STREAM_BYTES,
         ex = holder.get("ex")
         if ex is None or ex.tables is not tables:
             ex = ChunkedExecutor(tables, stream_bytes, chunk_rows,
-                                 float_dtype)
+                                 float_dtype,
+                                 prefetch_depth=prefetch_depth)
             holder["ex"] = ex
         return ex
 

@@ -299,28 +299,54 @@ class MemoryGovernor:
         self.governing = False
         return None
 
+    def admit_prefetch(self, est, chunk_bytes: int, depth: int) -> int:
+        """Deepest prefetch depth whose in-flight staged bytes still
+        fit the budget ON TOP of the base projection — overlap must not
+        reintroduce the OOMs the governor prevents, so depth demotes
+        BEFORE placement does: a budget that admits the serial chunked
+        loop but not depth x chunk of staged buffers runs the same
+        placement shallower, not a deeper ladder rung. Returns
+        ``depth`` unchanged when nothing constrains it (no budget, no
+        estimate, chunk size unknown)."""
+        if (self.budget <= 0 or depth <= 0 or chunk_bytes <= 0):
+            return depth
+        base = self.project(est)
+        if base <= 0:
+            return depth
+        d = depth
+        while d > 0 and base + d * chunk_bytes > self.budget:
+            d -= 1
+        return d
+
 
 # ------------------------------------------------------------- pipeline
 
 class _CompletedHandle:
     """Already-finished async handle. Carries the query's own
-    stats/schedule so interleaved dispatches (the in-process throughput
-    fleet keeps ``engine.concurrent_tasks`` queries in flight) cannot
-    clobber each other's accounting: ``result()`` re-points the
-    pipeline's ``last_stats``/``last_schedule`` at THIS query's."""
+    stats/schedule — and the timings/span the sync execution left on
+    the pipeline — so interleaved dispatches (the in-process throughput
+    fleet keeps ``engine.concurrent_tasks`` queries in flight; the
+    power loop's boundary pipelining dispatches query N+1 before
+    resolving N) cannot clobber each other's accounting: ``result()``
+    re-points the pipeline's per-query obs surface at THIS query's."""
 
-    __slots__ = ("_value", "pipe", "stats", "sched")
+    __slots__ = ("_value", "pipe", "stats", "sched", "timings", "span")
 
     def __init__(self, value, pipe=None, stats=None, sched=None):
         self._value = value
         self.pipe = pipe
         self.stats = stats
         self.sched = sched
+        self.timings = getattr(pipe, "last_timings", {}) if pipe else {}
+        self.span = (getattr(pipe, "last_query_span", None)
+                     if pipe else None)
 
     def result(self):
         if self.pipe is not None:
             self.pipe.last_stats = self.stats
             self.pipe.last_schedule = self.sched
+            self.pipe.last_timings = self.timings
+            self.pipe.last_query_span = self.span
         return self._value
 
 
@@ -375,7 +401,9 @@ class ExecutionPipeline:
                  mesh=None, precision: str = "f64",
                  stream_bytes: int = 0, chunk_rows: int | None = None,
                  consensus: "Consensus | None" = None,
-                 cost_model: "CostModel | None" = None):
+                 cost_model: "CostModel | None" = None,
+                 prefetch_depth: "int | None" = None):
+        from nds_tpu.engine import pipeline_io
         from nds_tpu.engine.chunked_exec import DEFAULT_CHUNK_ROWS
         self.backend = backend
         self.config = config
@@ -389,6 +417,14 @@ class ExecutionPipeline:
         self.precision = precision
         self.stream_bytes = stream_bytes
         self.chunk_rows = chunk_rows or DEFAULT_CHUNK_ROWS
+        # double-buffered phase-A prefetch depth for the chunked
+        # placement (engine/pipeline_io.py; engine.prefetch.* /
+        # NDS_TPU_PREFETCH; 0 = serial). The governor may demote it
+        # per query (_apply_prefetch) before demoting the placement
+        self.prefetch_depth = (pipeline_io.resolve_depth(config)
+                               if prefetch_depth is None
+                               else max(0, int(prefetch_depth)))
+        self._gov_depth: "int | None" = None
         self.universe = UNIVERSES.get(backend, (CPU,))
         self.policy = (RetryPolicy.from_config(config) if config
                        else RetryPolicy())
@@ -517,7 +553,8 @@ class ExecutionPipeline:
             from nds_tpu.engine.chunked_exec import DEFAULT_STREAM_BYTES
             ex = ChunkedExecutor(
                 tables, self.stream_bytes or DEFAULT_STREAM_BYTES,
-                self.chunk_rows, self._float_dtype())
+                self.chunk_rows, self._float_dtype(),
+                prefetch_depth=self.prefetch_depth)
         elif placement == DEVICE:
             from nds_tpu.engine.device_exec import DeviceExecutor
             ex = DeviceExecutor(tables, self._float_dtype())
@@ -583,12 +620,17 @@ class ExecutionPipeline:
 
     def _initial_placement(self, planned, qname) -> tuple:
         self._gov_shrink = False
-        if self.forced:
-            return self.forced, "forced"
-        if self._demoted_to:
-            return self._demoted_to, "sticky-demotion"
+        self._gov_depth = None
         catalog = None
         from nds_tpu.analysis import plan_verify
+        if self.forced or self._demoted_to:
+            # pinned/sticky placements skip the cost model but NOT the
+            # prefetch depth admission below (a forced chunked run
+            # still must not stage depth x chunk past the budget)
+            placement, why = ((self.forced, "forced") if self.forced
+                              else (self._demoted_to,
+                                    "sticky-demotion"))
+            return self._admit_depth(planned, placement, catalog), why
         est = plan_verify.estimate_plan(planned, tables=self._tables,
                                         catalog=catalog,
                                         encoded=self._encoded_estimates())
@@ -611,11 +653,37 @@ class ExecutionPipeline:
                 and placement in (DEVICE, SHARDED, CHUNKED)):
             reason = self.governor.decide(est)
             if reason and placement in (DEVICE, SHARDED):
-                return CHUNKED, reason
-            if reason and placement == CHUNKED:
+                placement, why = CHUNKED, reason
+            elif reason and placement == CHUNKED:
                 self._gov_shrink = True
-                return CHUNKED, reason
-        return placement, why
+                placement, why = CHUNKED, reason
+        return self._admit_depth(planned, placement, catalog,
+                                 est=est), why
+
+    def _admit_depth(self, planned, placement: str, catalog,
+                     est=None) -> str:
+        """Prefetch depth admission (engine/pipeline_io.py): a
+        chunked-bound query whose base projection fits the budget but
+        whose depth x chunk of in-flight staged buffers does not runs
+        SHALLOWER, not deeper down the ladder — depth demotes before
+        placement (applied per query via _apply_prefetch, restored by
+        _run_ladder's finally). Returns the placement unchanged."""
+        if (placement != CHUNKED or self.governor is None
+                or self._multi or self.prefetch_depth <= 0):
+            return placement
+        if est is None:
+            from nds_tpu.analysis import plan_verify
+            est = plan_verify.estimate_plan(
+                planned, tables=self._tables, catalog=catalog,
+                encoded=self._encoded_estimates())
+        from nds_tpu.engine import pipeline_io
+        chunk_bytes = pipeline_io.chunk_working_set(
+            est, self.chunk_rows)
+        allowed = self.governor.admit_prefetch(
+            est, chunk_bytes, self.prefetch_depth)
+        if allowed < self.prefetch_depth:
+            self._gov_depth = allowed
+        return placement
 
     def _apply_governor(self, sched: dict, placement: str) -> None:
         """Post-schedule governor bookkeeping: stamp ``governed`` on
@@ -633,6 +701,23 @@ class ExecutionPipeline:
             ex.chunk_rows = max(ex.chunk_rows // 2,
                                 ChunkedExecutor.MIN_CHUNK_ROWS)
         self._gov_shrink = False
+
+    def _apply_prefetch(self, sched: dict, placement: str) -> None:
+        """Apply the depth admission verdict for THIS query (restored
+        by _run_ladder's finally, like every per-query executor tweak):
+        the chunked executor runs at the admitted depth, the summary
+        records ``prefetch_depth``, and the demotion counts."""
+        d, self._gov_depth = self._gov_depth, None
+        if d is None or placement != CHUNKED:
+            return
+        ex = self._executor(CHUNKED)
+        if not hasattr(ex, "prefetch_depth"):
+            return
+        sched.setdefault("_restore", []).append(
+            (ex, "prefetch_depth", ex.prefetch_depth))
+        ex.prefetch_depth = d
+        sched["prefetch_depth"] = d
+        obs_metrics.counter("prefetch_depth_demotions_total").inc()
 
     def admission_projection(self, planned) -> tuple:
         """(projected_bytes, budget_bytes) from the MemoryGovernor's
@@ -670,6 +755,7 @@ class ExecutionPipeline:
         placement, why = self._initial_placement(planned, qname)
         stats, sched = self._new_schedule(placement, why)
         self._apply_governor(sched, placement)
+        self._apply_prefetch(sched, placement)
         self.last_stats, self.last_schedule = stats, sched
         return self._run_ladder(planned, key=key, placement=placement,
                                 stats=stats, sched=sched)
@@ -685,16 +771,22 @@ class ExecutionPipeline:
         placement, why = self._initial_placement(planned, qname)
         stats, sched = self._new_schedule(placement, why)
         self._apply_governor(sched, placement)
+        self._apply_prefetch(sched, placement)
         self.last_stats, self.last_schedule = stats, sched
         ex = self._executor(placement)
         dispatch = getattr(ex, "execute_async", None)
         # multi-rank worlds run synchronously: the per-query boundary
         # vote must fire in dispatch order on every rank, and the
         # compiled collective programs serialize execution anyway.
-        # Governed queries run synchronously too — the per-query
-        # chunk-shrink restore rides _run_ladder's finally
-        if dispatch is None or placement == CPU or self._multi \
-                or sched.get("governed"):
+        # The sharded placement is sync even single-process — the
+        # DistributedExecutor overrides execute() only, and the base
+        # executor's inherited execute_async would route it through
+        # the wrong compile path. Governed and depth-demoted queries
+        # run synchronously too — the per-query chunk-shrink /
+        # prefetch-depth restores ride _run_ladder's finally
+        if dispatch is None or placement in (CPU, SHARDED) \
+                or self._multi or sched.get("governed") \
+                or "prefetch_depth" in sched:
             out = self._run_ladder(planned, key=key, placement=placement,
                                    stats=stats, sched=sched)
             return _CompletedHandle(out, self, stats, sched)
@@ -780,9 +872,12 @@ class ExecutionPipeline:
                               overrun, flag_deadline)
         finally:
             # per-query executor tweaks (the ladder's chunk halving /
-            # stream-threshold lowering) roll back whether the walk
-            # succeeded or raised
-            for obj, attr, val in sched.pop("_restore", []):
+            # stream-threshold lowering / prefetch-depth admission)
+            # roll back whether the walk succeeded or raised — in
+            # REVERSE order: two entries for the same attribute (depth
+            # admitted pre-dispatch, then zeroed by the relief entry)
+            # must unwind to the ORIGINAL value, not the intermediate
+            for obj, attr, val in reversed(sched.pop("_restore", [])):
                 setattr(obj, attr, val)
             sched.pop("_stream_lowered", None)
             ok = sched.pop("_succeeded", False)
@@ -982,6 +1077,16 @@ class ExecutionPipeline:
                 (ex, "chunk_rows", ex.chunk_rows))
             ex.chunk_rows = max(ex.chunk_rows // 2,
                                 ChunkedExecutor.MIN_CHUNK_ROWS)
+            # the relief entry also runs serial: the OOM just proved
+            # memory is the constraint, and depth x chunk of staged
+            # prefetch buffers works against exactly that relief.
+            # Registered in the same _restore list, so depth and
+            # chunk_rows roll back TOGETHER after the walk (hasattr:
+            # test stubs model only the fields they exercise)
+            if hasattr(ex, "prefetch_depth"):
+                sched["_restore"].append(
+                    (ex, "prefetch_depth", ex.prefetch_depth))
+                ex.prefetch_depth = 0
         # deliberately NOT a TaskFailureCollector notification: a
         # reschedule is a scheduling decision, not a recovered task
         # failure — the summary's placement/reschedules/ladder fields

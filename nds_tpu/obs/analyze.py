@@ -42,11 +42,12 @@ import os
 
 # attribution categories, in display order (retry_backoff comes from
 # the summary's retry accounting, straggler_wait from cross-rank span
-# pairing in fleet runs — both not from this rank's spans; residual is
-# computed)
+# pairing in fleet runs — both not from this rank's spans; prefetch_wait
+# is the chunked engine's device-waited-on-host stall, carved out of
+# the host_staging window by its own spans; residual is computed)
 CATEGORIES = ("parse_plan", "compile", "execute", "materialize",
-              "host_staging", "exchange", "straggler_wait",
-              "retry_backoff")
+              "host_staging", "prefetch_wait", "exchange",
+              "straggler_wait", "retry_backoff")
 
 # span name -> category (exact names; see README span taxonomy)
 _SPAN_CATEGORY = {
@@ -58,6 +59,7 @@ _SPAN_CATEGORY = {
     "stage.sub": "host_staging",
     "chunk.partial_agg": "host_staging",
     "chunk.reduce": "host_staging",
+    "prefetch.wait": "prefetch_wait",
 }
 
 # summary files that live in run dirs but are not BenchReports
@@ -163,6 +165,16 @@ def attribute_query(summary: dict) -> dict:
     for k in ("bytes_scanned", "compression_ratio"):
         if isinstance(et.get(k), (int, float)):
             row[k] = float(et[k])
+    # pipelined execution (engine/pipeline_io.py): host staging time
+    # the prefetch overlapped under compute, and the derived device
+    # occupancy (1 - prefetch_wait/wall — what fraction of the query's
+    # wall the device was NOT stalled on host staging). Absent on
+    # pre-pipeline runs, so old dirs keep analyzing byte-identically
+    if isinstance(et.get("prefetch_hidden_s"), (int, float)):
+        row["prefetch_hidden_s"] = float(et["prefetch_hidden_s"])
+    if cats["prefetch_wait"] > 0 or "prefetch_hidden_s" in row:
+        row["occupancy"] = (round(1.0 - cats["prefetch_wait"] / wall_ms,
+                                  4) if wall_ms > 0 else 1.0)
     # on-demand XLA capture (obs/profile.py; README "Fleet &
     # profiling"): which trigger fired and where the capture landed
     prof = summary.get("profile")
@@ -489,8 +501,9 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     column provably equal to wall-clock."""
     short = {"parse_plan": "parse", "compile": "compile",
              "execute": "exec", "materialize": "mat",
-             "host_staging": "stage", "exchange": "exch",
-             "straggler_wait": "stragl", "retry_backoff": "retry"}
+             "host_staging": "stage", "prefetch_wait": "pfwait",
+             "exchange": "exch", "straggler_wait": "stragl",
+             "retry_backoff": "retry"}
     rows = analysis["queries"]
     if top:
         order = {q: i for i, q in enumerate(analysis["slowest"])}
@@ -502,6 +515,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
                        for r in rows)
     has_bytes = any("bytes_scanned" in r for r in rows)
     has_profile = any("profile" in r for r in rows)
+    has_occup = any("occupancy" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
         f"{short.get(c, c):>9}" for c in cols)
@@ -509,6 +523,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         + ("  cache" if has_cache else "")
         + ("   roofline" if has_roofline else "")
         + ("         bytes" if has_bytes else "")
+        + ("  occup" if has_occup else "")
         + ("  profile" if has_profile else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
@@ -559,6 +574,14 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             if cr is not None:
                 cell += f" x{cr:.1f}"
             bytes_col = f"  {cell:>12}"
+        occup_col = ""
+        if has_occup:
+            # device occupancy under pipelined execution: 100% means
+            # the device never waited on host chunk staging (README
+            # "Pipelined execution")
+            occ = r.get("occupancy")
+            occup_col = ("  {:>5}".format(
+                f"{occ * 100.0:.0f}%" if occ is not None else "-"))
         prof_col = ""
         if has_profile:
             prof_col = ("  {:>7}".format(
@@ -566,8 +589,8 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + cache_col + roof_col + bytes_col + prof_col
-            + f"  {r['status']}")
+            + place + cache_col + roof_col + bytes_col + occup_col
+            + prof_col + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -731,6 +754,53 @@ def bytes_changes(base_rows: dict, cur_rows: dict,
     return out
 
 
+# occupancy-regression threshold: the prefetch_wait SHARE of a query's
+# wall rising by more than this many points between runs means the
+# pipeline stopped hiding host staging (a lost overlap, a depth
+# demotion gone sticky, a stage function that got slower) — flagged
+# PIPELINE-STALLED and failed like a kernel demotion
+STALL_SHARE_POINTS = 0.10
+
+
+def _prefetch_share(row: dict) -> float:
+    wall = row.get("wall_ms") or 0.0
+    if wall <= 0:
+        return 0.0
+    return (row.get("categories", {}).get("prefetch_wait", 0.0)
+            or 0.0) / wall
+
+
+def pipeline_changes(base_rows: dict, cur_rows: dict) -> list:
+    """Per-query prefetch-stall changes between two runs: entries only
+    for queries where a side actually carried pipeline evidence
+    (nonzero ``prefetch_wait`` or a ``prefetch_hidden_s`` field), so
+    pre-pipeline run dirs keep diffing byte-identically. A query whose
+    ``prefetch_wait`` share of wall-clock ROSE by more than
+    ``STALL_SHARE_POINTS`` carries ``stalled: True`` and fails the
+    gate."""
+    out = []
+
+    def _evidence(r) -> bool:
+        return _prefetch_share(r) > 0 or "prefetch_hidden_s" in r
+
+    for name in sorted(set(base_rows) & set(cur_rows)):
+        b, c = base_rows[name], cur_rows[name]
+        if not _evidence(b) and not _evidence(c):
+            continue
+        bs, cs = _prefetch_share(b), _prefetch_share(c)
+        if abs(cs - bs) < 0.01:
+            continue
+        entry = {"query": name, "base_share": round(bs, 4),
+                 "cur_share": round(cs, 4)}
+        # the feature boundary never hard-fails (the kernel_changes /
+        # bytes_changes precedent): a base recorded pre-pipeline — or
+        # with prefetch off — has no occupancy claim to regress from
+        if _evidence(b) and cs - bs > STALL_SHARE_POINTS:
+            entry["stalled"] = True
+        out.append(entry)
+    return out
+
+
 def cache_hit_rate(analysis: dict) -> "dict | None":
     """Run-level plan-cache summary from the per-query rows:
     ``{"hits", "misses", "rate"}`` (rate = hits / consults), or None
@@ -791,16 +861,23 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
     bchanges = bytes_changes(b_rows, c_rows, pct=pct)
     bytes_regressed = [e["query"] for e in bchanges
                        if e.get("regressed")]
+    # occupancy regressions (engine/pipeline_io.py): a prefetch_wait
+    # share rising >STALL_SHARE_POINTS means the pipeline stopped
+    # hiding host staging — PIPELINE-STALLED fails the gate; run dirs
+    # with no pipeline evidence on either side emit nothing here
+    pchanges = pipeline_changes(b_rows, c_rows)
+    stalled = [e["query"] for e in pchanges if e.get("stalled")]
     d.update({
         "base_dir": base.get("run_dir"),
         "cur_dir": cur.get("run_dir"),
         "compile_changes": compile_changes,
         "kernel_changes": kchanges,
         "bytes_changes": bchanges,
+        "pipeline_changes": pchanges,
         "newly_failed": newly_failed,
         "passed": not d["regressions"] and not d["removed"]
                   and not newly_failed and not demoted
-                  and not bytes_regressed,
+                  and not bytes_regressed and not stalled,
     })
     # plan-cache hit-rate per run, the compile-count-change flag's
     # natural companion: a run whose compile counts dropped to 0
@@ -863,6 +940,14 @@ def format_diff(d: dict) -> str:
         lines.append(
             f"  {label:<15} {e['query']:<14} "
             f"{_b(e['base_bytes'])} -> {_b(e['cur_bytes'])}")
+    for e in d.get("pipeline_changes", []):
+        # occupancy regression: the device's prefetch_wait share of
+        # wall rose — the overlap stopped hiding host staging
+        label = "PIPELINE-STALLED" if e.get("stalled") else "pipeline"
+        lines.append(
+            f"  {label:<16} {e['query']:<14} "
+            f"stall share {e['base_share'] * 100.0:.0f}% -> "
+            f"{e['cur_share'] * 100.0:.0f}%")
     chr_ = d.get("cache_hit_rate") or {}
     if any(chr_.get(k) for k in ("base", "cur")):
         def _rate(r):
@@ -893,12 +978,14 @@ def format_diff(d: dict) -> str:
 # series hue
 _LIGHT = {"parse_plan": "#2a78d6", "compile": "#eb6834",
           "execute": "#1baf7a", "materialize": "#eda100",
-          "host_staging": "#e87ba4", "exchange": "#008300",
+          "host_staging": "#e87ba4", "prefetch_wait": "#0e8a9e",
+          "exchange": "#008300",
           "straggler_wait": "#8a6d3b", "retry_backoff": "#4a3aa7",
           "residual": "#b9b8b3"}
 _DARK = {"parse_plan": "#3987e5", "compile": "#d95926",
          "execute": "#199e70", "materialize": "#c98500",
-         "host_staging": "#d55181", "exchange": "#008300",
+         "host_staging": "#d55181", "prefetch_wait": "#23a9bf",
+         "exchange": "#008300",
          "straggler_wait": "#b0905a", "retry_backoff": "#9085e9",
          "residual": "#6e6d69"}
 

@@ -14,7 +14,15 @@ Registered sites (the call sites live inline in the layer they test):
                       (CPU oracle included, so chaos runs need no chip)
 - ``exchange``        the distributed all_to_all shuffle (trace time)
 - ``io.read``         warehouse table reads (csv/parquet/raw); the
-                      call passes ``paths`` so ``corrupt`` can bite
+                      call passes ``paths`` so ``corrupt`` can bite.
+                      Also fires per STAGED CHUNK in the chunked
+                      engine's phase-A loops (engine/pipeline_io.py)
+                      — on the prefetch worker thread when depth > 0,
+                      with the submitting thread's context
+                      republished, so an injected fault surfaces at
+                      the consumer in chunk order with classification
+                      and retry semantics identical to the serial
+                      path
 - ``stream.query``    per-query dispatch in the stream loops (the
                       power loop fires it per ATTEMPT inside the retry
                       policy; the in-process throughput loop fires it

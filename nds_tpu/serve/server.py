@@ -113,6 +113,13 @@ class QueryServer:
         self.stats = {"submitted": 0, "completed": 0, "shed": 0,
                       "errors": 0, "batched": 0,
                       "max_inflight": 0}
+        # query-boundary pipelining (engine/pipeline_io.py; README
+        # "Pipelined execution"): with engine.prefetch.boundary on the
+        # engine thread dispatches request N+1 while request N's
+        # compactor output is still in flight D2H — the async handle's
+        # result() is the sync point. Off by default.
+        from nds_tpu.engine import pipeline_io
+        self._boundary = pipeline_io.boundary_enabled(self.config)
         self._build_engine()
 
     # ------------------------------------------------------- plumbing
@@ -239,15 +246,23 @@ class QueryServer:
     # ------------------------------------------------- engine thread
 
     def _engine_loop(self) -> None:
+        pending: "dict | None" = None
         while True:
             with self._cv:
-                while self._running and not self._queue:
+                while (self._running and not self._queue
+                       and pending is None):
                     self._cv.wait(timeout=0.1)
                 if not self._running:
-                    return
-                req = self._queue.popleft()
+                    break
+                req = (self._queue.popleft() if self._queue else None)
+            if req is None:
+                # queue drained: the overlapped request is the only
+                # work left — resolve it rather than idle on it
+                self._finalize_prev(pending)
+                pending = None
+                continue
             try:
-                self._serve_group(req)
+                pending = self._serve_group(req, pending)
             except Exception as exc:  # noqa: BLE001 - request-scoped
                 # an unexpected engine-loop failure bills THIS request
                 # and keeps serving (shed-not-crash applies to bugs too)
@@ -256,6 +271,8 @@ class QueryServer:
             with self._cv:
                 depth = len(self._queue)
             obs_metrics.gauge("server_queue_depth").set(depth)
+        # stop(): never strand an overlapped in-flight request
+        self._finalize_prev(pending)
 
     def _too_old(self, req: Request) -> bool:
         return (self.deadline_ms > 0
@@ -277,19 +294,45 @@ class QueryServer:
         # batching on it guarantees the group really shares a program
         return planned, (key[1] if key else None)
 
-    def _serve_group(self, req: Request) -> None:
+    def _finalize_prev(self, pending: "dict | None") -> None:
+        """Resolve an overlapped request's result (idempotent — the
+        engine loop's catch-all may race a group path that already
+        resolved it). A finalize-path failure (summary write on a full
+        disk) still answers the request: shed-not-crash applies to the
+        bookkeeping too, and a stranded future would hang its client
+        forever."""
+        if pending is None or pending.get("_finalized"):
+            return
+        pending["_finalized"] = True
+        try:
+            self._finalize_one(pending)
+        except Exception as exc:  # noqa: BLE001 - request-scoped
+            # _resolve is set-once, so if _finalize_one already
+            # answered before raising this is a counted no-op
+            self._finish_error(pending["req"],
+                               f"{type(exc).__name__}: {exc}")
+
+    def _serve_group(self, req: Request,
+                     pending: "dict | None" = None) -> "dict | None":
         """Serve one dequeued request, plus every queued request with
         the SAME parameterized plan digest (template batching: the
         group shares one compiled program and drains back-to-back
-        without re-entering the scheduler between strangers)."""
+        without re-entering the scheduler between strangers). With
+        boundary pipelining on, a single (unbatched) request dispatches
+        BEFORE the previous request's result is taken — its device
+        work and D2H overlap this plan+dispatch — and the new pending
+        record is returned to the engine loop; ``pending`` resolves at
+        the overlap point either way."""
         if self._too_old(req):
             self._finish_shed(req, "deadline")
-            return
+            self._finalize_prev(pending)
+            return None
         try:
             planned, digest = self._plan_for(req)
         except Exception as exc:  # noqa: BLE001 - plan errors answer
             self._finish_error(req, f"{type(exc).__name__}: {exc}")
-            return
+            self._finalize_prev(pending)
+            return None
         group = [req]
         if digest is not None:
             # EXTRACT same-digest peers (bounded) from the queue in
@@ -332,6 +375,16 @@ class QueryServer:
                     self.stats["batched"] += len(group) - 1
                 obs_metrics.counter("server_batched_total").inc(
                     len(group) - 1)
+        if self._boundary and len(group) == 1:
+            # overlap: dispatch this request first, THEN take the
+            # previous one's result while this one runs on device
+            pend = self._dispatch_one(req)
+            self._finalize_prev(pending)
+            return pend
+        # batched groups (and the boundary-off path) run sync: the
+        # group drains back-to-back against one compiled program, so
+        # the previous request resolves first
+        self._finalize_prev(pending)
         for member in group:
             try:
                 self._serve_one(member)
@@ -341,6 +394,7 @@ class QueryServer:
                 # loop's catch-all)
                 self._finish_error(member,
                                    f"{type(exc).__name__}: {exc}")
+        return None
 
     def _admission_shed_reason(self, suite: str,
                                planned) -> "str | None":
@@ -359,32 +413,62 @@ class QueryServer:
         return None
 
     def _serve_one(self, req: Request) -> None:
-        from nds_tpu.io.result_io import result_digest
+        pend = self._dispatch_one(req)
+        if pend is not None:
+            self._finalize_one(pend)
+
+    def _dispatch_one(self, req: Request) -> "dict | None":
+        """Admission + async dispatch of one request. Returns the
+        pending record ``_finalize_one`` resolves (possibly after the
+        NEXT request dispatched — the boundary overlap), or None when
+        the request already answered (shed, plan error)."""
         from nds_tpu.utils.report import BenchReport
         if self._too_old(req):
             self._finish_shed(req, "deadline")
-            return
+            return None
         s = self.sessions[req.suite]
         try:
             planned, _digest = self._plan_for(req)
         except Exception as exc:  # noqa: BLE001
             self._finish_error(req, f"{type(exc).__name__}: {exc}")
-            return
+            return None
         if not isinstance(planned, tuple):
             reason = self._admission_shed_reason(req.suite, planned)
             if reason:
                 self._finish_shed(req, reason)
-                return
+                return None
         report = BenchReport(req.qname, {"tenant": req.tenant,
                                          "suite": req.suite})
+        report.begin_async()
+        pend = {"req": req, "report": report,
+                "t0": time.monotonic()}
+        try:
+            # focus: an overlapped predecessor's collector is still
+            # registered — this dispatch's anomalies are THIS request's
+            with report.focus_failures():
+                pend["handle"] = s.sql_async(req.sql)
+        except Exception as exc:  # noqa: BLE001 - billed at finalize
+            pend["dispatch_error"] = exc
+        return pend
+
+    def _finalize_one(self, pend: dict) -> None:
+        """Blocking half of one dispatched request: the async handle's
+        result() is the sync point; everything downstream (summary,
+        digest, tenant metrics, future resolution) is unchanged from
+        the serial path."""
+        from nds_tpu.io.result_io import result_digest
+        req, report = pend["req"], pend["report"]
+        s = self.sessions[req.suite]
         hold: dict = {}
-
-        def _body():
-            hold["result"] = s.sql(req.sql)
-
-        t0 = time.monotonic()
-        summary = report.report_on(_body)
-        elapsed_ms = (time.monotonic() - t0) * 1000
+        err = pend.pop("dispatch_error", None)
+        if err is None:
+            try:
+                with report.focus_failures():
+                    hold["result"] = pend["handle"].result()
+            except Exception as exc:  # noqa: BLE001 - billed below
+                err = exc
+        summary = report.end_async(error=err)
+        elapsed_ms = (time.monotonic() - pend["t0"]) * 1000
         report.attach_tenant(req.tenant)
         from nds_tpu.resilience.retry import RetryStats
         ex = s._executor_factory(s.tables)

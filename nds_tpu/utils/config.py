@@ -65,6 +65,39 @@ Engine keys (the TPU analog of the spark.* / spark.rapids.* namespace):
                             engine.placement.floor=cpu (the one-shot
                             stream demotion it used to trigger is now
                             the ladder + sticky demotion)
+  engine.prefetch.enabled   on (default) / off: double-buffered
+                            phase-A prefetch in the chunked executor
+                            (engine/pipeline_io.py, README "Pipelined
+                            execution") — a worker thread slices,
+                            columnar-encodes, and device_puts chunk
+                            N+1 while the compiled program scans chunk
+                            N. ``off`` restores the byte-identical
+                            serial loops. Env: NDS_TPU_PREFETCH
+                            (depth, or "off").
+  engine.prefetch.depth     staged-chunks-ahead bound (default 2;
+                            0 = serial). MEMORY CONTRACT: the
+                            MemoryGovernor's admission projections
+                            count depth x one chunk's working set as
+                            in-flight prefetch bytes (staged buffers
+                            are live accounted bytes from device_put
+                            to consumption) and DEMOTE DEPTH before
+                            demoting placement — a budget that admits
+                            the serial chunked loop but not the
+                            staged overlap runs the same placement
+                            shallower, recorded as the summary's
+                            ``prefetch_depth`` +
+                            prefetch_depth_demotions_total.
+  engine.prefetch.boundary  on / off (default): additionally pipeline
+                            QUERY boundaries — the power loop and the
+                            serve engine thread dispatch query N+1
+                            while query N's compactor output is still
+                            in flight D2H (async-handle result() as
+                            the sync point). Per-query walls become
+                            dispatch->result brackets (the throughput
+                            loop's contract) and boundary metric
+                            deltas attribute the next dispatch to the
+                            previous window (totals stay exact). Env:
+                            NDS_TPU_PREFETCH_BOUNDARY.
 
 Columnar keys (compressed device-resident store, nds_tpu/columnar/ —
 README "Compressed columnar store"):

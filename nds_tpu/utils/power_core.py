@@ -552,131 +552,61 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
     failures = 0
     replayed_ms = 0.0
     power_start = time.perf_counter()
-    for qname, sql in queries.items():
-        watchdog.beat(unit, query=qname, phase="dispatch")
-        # preemption drain checkpoint: once a SIGTERM/SIGINT was seen,
-        # stop HERE — the finished queries are journaled, the process
-        # exits 75, and --resume picks up at this statement
-        drain.check_boundary()
-        if journal.done(qname):
-            # resumed incarnation: replay the journaled outcome (time
-            # log row + failure accounting) so the merged phase totals
-            # match an uninterrupted run — never re-execute
-            e = journal.entry(qname)
-            wall = float(e.get("wall_ms") or 0)
-            replayed_ms += wall
-            tlog.add(qname, int(wall))
-            if e.get("status") == "Failed":
-                failures += 1
-            progress["queries_completed"] += 1
-            print(f"====== Replay {qname} (journaled "
-                  f"{e.get('status')}, incarnation "
-                  f"{e.get('incarnation', 0)}) ======")
-            continue
-        if warmup and not qname.startswith(suite.warmup_skip_prefixes):
-            # span recording off during warmup: untimed passes would
-            # otherwise append orphan root trees to the Chrome trace,
-            # uncorrelated with any CSV row. Fault injection is
-            # suppressed too — warmup must not consume the timed
-            # query's fault budget
-            wtracer = get_tracer()
-            was_enabled = wtracer.enabled
-            wtracer.enabled = False
+    # query-boundary pipelining (engine/pipeline_io.py; README
+    # "Pipelined execution"): with ``engine.prefetch.boundary`` on,
+    # query N+1 dispatches while query N's compactor output is still
+    # in flight D2H — the async handle's result() is the sync point,
+    # and each query's bracket is its dispatch-start -> result-done
+    # window (the same dispatch->result wall contract the in-process
+    # throughput loop already bills pipelined queries under)
+    from nds_tpu.engine import pipeline_io
+    boundary = pipeline_io.boundary_enabled(config)
+    tracer = get_tracer()
+    pending: "dict | None" = None
+    # per-query metric windows partition at finalize boundaries in
+    # pipelined mode (query N's dispatch-side counters bill to N-1's
+    # window; the per-run totals stay exact — README "Pipelined
+    # execution"); None = fresh snapshot at the next dispatch
+    mbase: "dict | None" = None
+
+    def _resolve(p) -> None:
+        """Blocking half of one dispatched query: result() is the sync
+        point; failures bill to THIS query's bracket exactly as
+        report_on's except-clause did."""
+        err = p.pop("dispatch_error", None)
+        if err is None:
             try:
-                with faults.suppress():
-                    for _ in range(warmup):
-                        try:
-                            run_one_query(session, sql)
-                        except Exception:
-                            break
-            finally:
-                wtracer.enabled = was_enabled
-        progress["current_query"] = qname
-        # execution-start mark BEFORE dispatch: a kill -9 mid-query
-        # leaves a start with no completion — the journal evidence that
-        # exactly this one query was lost
-        journal.start(qname)
-        # fresh per-query memory window (obs/memwatch): the HWM is
-        # monotone within the query and resets here, so each summary's
-        # ``memory`` block reflects what was resident while IT ran
-        memwatch.reset_query()
-        report = BenchReport(qname, config.as_dict())
-        out_pref = output_prefix if primary else None
-        # a query that fails BEFORE reaching the executor (parse/plan
-        # errors) must not inherit the previous query's
-        # span/timings/stats into its summary — the pipeline's
-        # reset covers exactly that window
-        pre_ex = session._executor_factory(session.tables)
-        if hasattr(pre_ex, "reset_query"):
-            pre_ex.reset_query()
-        else:
-            pre_ex.last_query_span = None
-            pre_ex.last_timings = {}
-        # per-query root span: brackets EXACTLY what queryTimes/TimeLog
-        # brackets (fn inside report_on), so span totals and the CSV
-        # agree; the engine's parse/plan/compile/execute spans nest
-        # underneath and the whole tree lands in the JSON summary
-        tracer = get_tracer()
-        qhold: dict = {}
-        metrics_before = obs_metrics.snapshot()
+                with tracer.attach(p["span"]), \
+                        faults.context(query=p["qname"]), \
+                        p["report"].focus_failures():
+                    out = p["handle"].result()
+                p["result"] = out
+                if out is not None and p["out_pref"]:
+                    from nds_tpu.io.result_io import write_result
+                    write_result(out, os.path.join(p["out_pref"],
+                                                   p["qname"]))
+            except Exception as exc:  # noqa: BLE001 - billed below
+                err = exc
+        span = p["span"]
+        if span:
+            if err is not None:
+                span.set(error=f"{type(err).__name__}: {err}")
+            span.end()
+        p["summary"] = p["report"].end_async(error=err)
 
-        def traced_query(session, sql, _q=qname, _o=out_pref,
-                         _h=qhold, _ex=pre_ex):
-            # retry + the degradation ladder both live INSIDE the
-            # pipeline now and nest inside the query span (queryTimes /
-            # the TimeLog row bill retries, backoff, and reschedules to
-            # the query that needed them, exactly like a Spark task
-            # retry bills its stage); _front_door_retry covers only the
-            # pre-dispatch (parse/plan) window the pipeline cannot see
-            with tracer.span("query", query=_q, suite=suite.name,
-                             backend=backend) as sp:
-                _h["span"] = sp
-                with faults.context(query=_q):
-                    out = _front_door_retry(
-                        front_policy, _ex, unit, _q,
-                        lambda: run_one_query(session, sql, _q, _o))
-                    # result stashed for the journal's content digest
-                    # (io/result_io.result_digest); dropped right after
-                    _h["result"] = out
-                    return out
-
-        # per-query XLA capture when a trigger fires: a stall-reserved
-        # capture (the watchdog hook published the path in its stall
-        # report; the first post-stall query fills it — obs/profile.py
-        # explains why the capture cannot run on the watchdog thread),
-        # an explicitly listed query, or one whose previous run
-        # exceeded engine.profile.slow_query_ms
-        trigger = profiler.trigger_for(qname) if profiler else None
-        stall_path = profiler.take_pending() if profiler else None
-        if trigger or stall_path:
-            # a stall reservation drains into THIS query's capture —
-            # into the reserved path (the stall report already points
-            # there), under the query's own trigger when it has one
-            # (with mode=all every query is triggered; the reservation
-            # must not dangle forever)
-            cap_cm = profiler.capture(qname, trigger or "stall",
-                                      path=stall_path)
-        else:
-            cap_cm = nullcontext({})
-        # exports park during the bracket (even a ~ms inline write
-        # would skew span totals vs the TimeLog row) and flush after
-        tracer.defer_exports = True
-        try:
-            with cap_cm as cap_info:
-                if stream_prof:
-                    with obs_profile.annotate(qname):
-                        summary = report.report_on(traced_query,
-                                                   session, sql)
-                else:
-                    summary = report.report_on(traced_query, session,
-                                               sql)
-        finally:
-            tracer.defer_exports = False
-            tracer.flush_exports()
+    def _post(p) -> None:
+        """Everything that used to follow the report bracket: summary
+        attachments, metrics delta, flight/profiler bookkeeping, the
+        TimeLog row, the summary write, and the journal append."""
+        nonlocal failures, mbase
+        qname = p["qname"]
+        report, summary = p["report"], p["summary"]
         # engine-side perf accounting: compile vs execute vs
         # device->host materialization, fed by the query span tree
         # (obs.query_timings falls back to legacy last_timings; the
-        # CPU oracle has neither)
+        # CPU oracle has neither). The pipeline's async handles
+        # re-point the per-query obs surface at result(), so this
+        # reads THIS query's numbers even under boundary overlap
         executor = session._executor_factory(session.tables)
         timings = obs.query_timings(executor)
         if timings:
@@ -685,22 +615,23 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
             summary["engineTimings"] = {k: round(v, 3)
                                         for k, v in timings.items()
                                         if not k.startswith("__")}
-        qspan = qhold.get("span")
-        if qspan:
-            summary["spans"] = qspan.to_dict()
+        if p["span"]:
+            summary["spans"] = p["span"].to_dict()
         # the pipeline owns retry + scheduling accounting; a bare
         # executor factory (tests driving run_query_stream with a
         # custom session) degrades to empty stats
-        report.attach_retry(getattr(pre_ex, "last_stats", None)
+        report.attach_retry(getattr(executor, "last_stats", None)
                             or RetryStats())
-        report.attach_schedule(getattr(pre_ex, "last_schedule", None))
-        report.attach_memory(memwatch.high_water())
+        report.attach_schedule(getattr(executor, "last_schedule",
+                                       None))
+        report.attach_memory(p.get("hwm") if p.get("hwm") is not None
+                             else memwatch.high_water())
         # resume bookkeeping: which incarnation served this query, the
         # result's content digest (what the soak gate diffs against a
         # clean run), and any torn-state degradations this process saw
         report.attach_incarnation(journal.incarnation)
         from nds_tpu.io.result_io import result_digest
-        rdigest = result_digest(qhold.pop("result", None))
+        rdigest = result_digest(p.pop("result", None))
         report.attach_result_digest(rdigest)
         report.attach_degradations()
         elapsed_ms = summary["queryTimes"][-1]
@@ -710,8 +641,9 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         if not report.is_success():
             failures += 1
             obs_metrics.counter("query_failures_total").inc()
-        mdelta = obs_metrics.delta(metrics_before,
-                                   obs_metrics.snapshot())
+        before = (p["metrics_before"] if p["metrics_before"] is not None
+                  else mbase) or obs_metrics.snapshot()
+        mdelta = obs_metrics.delta(before, obs_metrics.snapshot())
         if mdelta:
             summary["metrics"] = mdelta
         # plan-cache activity for THIS query (hits/misses/bytes +
@@ -726,13 +658,13 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # XLA capture bookkeeping: the profile block when a trigger
         # fired, and the wall-clock observation arming the slow
         # trigger for this query's NEXT run
-        if cap_info:
-            report.attach_profile(cap_info)
-        elif stall_path and profiler:
+        if p.get("cap_info"):
+            report.attach_profile(p["cap_info"])
+        elif p.get("stall_path") and profiler:
             # the drained reservation's capture never started: put it
             # back so a later query can still fill the stall report's
             # forward pointer
-            profiler.requeue_pending(stall_path)
+            profiler.requeue_pending(p["stall_path"])
         if profiler:
             profiler.observe(qname, elapsed_ms)
         # flight recorder (obs/fleet.py): the ring holds the last N
@@ -740,7 +672,7 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # query's summary points at a post-mortem
         if flight:
             flight.record(qname, summary["queryStatus"][-1],
-                          qhold.get("span"), wall_ms=elapsed_ms,
+                          p.get("span"), wall_ms=elapsed_ms,
                           metrics_delta=mdelta)
             if summary["queryStatus"][-1] == "Failed":
                 fpath = flight.dump(f"query-failed:{qname}")
@@ -760,6 +692,178 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # is between this append and the previous instruction)
         journal.record(qname, elapsed_ms, summary["queryStatus"][-1],
                        result_digest=rdigest)
+        # exports parked during the bracket flush now; the metric
+        # window for the NEXT pipelined query starts here
+        tracer.flush_exports()
+        mbase = obs_metrics.snapshot()
+
+    def _finalize_pending() -> None:
+        nonlocal pending
+        if pending is None:
+            return
+        p, pending = pending, None
+        _resolve(p)
+        _post(p)
+
+    # exports park while query brackets are open (even a ~ms inline
+    # write would skew span totals vs the TimeLog row); _post flushes
+    # after each bracket closes
+    tracer.defer_exports = True
+    try:
+        for qname, sql in queries.items():
+            watchdog.beat(unit, query=qname, phase="dispatch")
+            # preemption drain checkpoint: once a SIGTERM/SIGINT was
+            # seen, stop HERE — the finished queries (the overlapped
+            # in-flight one resolves first, so the journal stays
+            # consistent) are journaled, the process exits 75, and
+            # --resume picks up at this statement
+            if drain.requested():
+                _finalize_pending()
+            drain.check_boundary()
+            if journal.done(qname):
+                # resumed incarnation: replay the journaled outcome
+                # (time log row + failure accounting) so the merged
+                # phase totals match an uninterrupted run — never
+                # re-execute
+                e = journal.entry(qname)
+                wall = float(e.get("wall_ms") or 0)
+                replayed_ms += wall
+                tlog.add(qname, int(wall))
+                if e.get("status") == "Failed":
+                    failures += 1
+                progress["queries_completed"] += 1
+                print(f"====== Replay {qname} (journaled "
+                      f"{e.get('status')}, incarnation "
+                      f"{e.get('incarnation', 0)}) ======")
+                continue
+            if warmup and not qname.startswith(
+                    suite.warmup_skip_prefixes):
+                # warmup executes synchronously through the session:
+                # resolve any overlapped query first. Span recording
+                # off during warmup: untimed passes would otherwise
+                # append orphan root trees to the Chrome trace,
+                # uncorrelated with any CSV row. Fault injection is
+                # suppressed too — warmup must not consume the timed
+                # query's fault budget
+                _finalize_pending()
+                wtracer = get_tracer()
+                was_enabled = wtracer.enabled
+                wtracer.enabled = False
+                try:
+                    with faults.suppress():
+                        for _ in range(warmup):
+                            try:
+                                run_one_query(session, sql)
+                            except Exception:
+                                break
+                finally:
+                    wtracer.enabled = was_enabled
+                mbase = None  # warmup counters are nobody's delta
+            progress["current_query"] = qname
+            # execution-start mark BEFORE dispatch: a kill -9 mid-query
+            # leaves a start with no completion — the journal evidence
+            # that exactly this one query was lost (under boundary
+            # overlap: at most the TWO in-flight queries)
+            journal.start(qname)
+            # per-query XLA capture triggers force the sync path: a
+            # capture bracket cannot span overlapped brackets
+            trigger = profiler.trigger_for(qname) if profiler else None
+            stall_path = profiler.take_pending() if profiler else None
+            run_sync = (not boundary or bool(trigger)
+                        or bool(stall_path) or bool(stream_prof))
+            if run_sync:
+                _finalize_pending()
+            # fresh per-query memory window (obs/memwatch): the HWM is
+            # monotone within the query and resets here; an overlapped
+            # predecessor's peak is snapshotted into its record first
+            if pending is not None:
+                pending["hwm"] = memwatch.high_water()
+            memwatch.reset_query()
+            report = BenchReport(qname, config.as_dict())
+            out_pref = output_prefix if primary else None
+            # a query that fails BEFORE reaching the executor
+            # (parse/plan errors) must not inherit the previous
+            # query's span/timings/stats into its summary — the
+            # pipeline's reset covers exactly that window (an
+            # overlapped predecessor's handle re-points the surface
+            # back at resolve time)
+            pre_ex = session._executor_factory(session.tables)
+            if hasattr(pre_ex, "reset_query"):
+                pre_ex.reset_query()
+            else:
+                pre_ex.last_query_span = None
+                pre_ex.last_timings = {}
+            # pipelined queries take their metric window from the
+            # previous finalize (partition — no double counting);
+            # sync queries snapshot here, exactly as before
+            metrics_before = (obs_metrics.snapshot()
+                              if run_sync or pending is None else None)
+            # per-query root span: brackets EXACTLY what queryTimes/
+            # TimeLog brackets (begin_async -> end_async), so span
+            # totals and the CSV agree; forced root — under overlap
+            # the next dispatch must not nest inside it
+            qspan = tracer.begin("query", parent=None, query=qname,
+                                 suite=suite.name, backend=backend)
+            p = {"qname": qname, "report": report, "span": qspan,
+                 "out_pref": out_pref, "metrics_before": metrics_before,
+                 "hwm": None, "stall_path": stall_path}
+            report.begin_async()
+
+            def _dispatch(_p=p, _sql=sql, _ex=pre_ex):
+                # retry + the degradation ladder live INSIDE the
+                # pipeline and surface at the handle (dispatch-time
+                # transients rerun there; result-time transients rerun
+                # at result()); _front_door_retry covers only the
+                # pre-dispatch (parse/plan) window the pipeline cannot
+                # see
+                try:
+                    with tracer.attach(_p["span"]), \
+                            faults.context(query=_p["qname"]), \
+                            _p["report"].focus_failures():
+                        _p["handle"] = _front_door_retry(
+                            front_policy, _ex, unit, _p["qname"],
+                            lambda: session.sql_async(_sql))
+                except Exception as exc:  # noqa: BLE001 - billed later
+                    _p["dispatch_error"] = exc
+
+            if run_sync:
+                if trigger or stall_path:
+                    # a stall reservation drains into THIS query's
+                    # capture — into the reserved path (the stall
+                    # report already points there), under the query's
+                    # own trigger when it has one
+                    cap_cm = profiler.capture(qname, trigger or "stall",
+                                              path=stall_path)
+                else:
+                    cap_cm = nullcontext({})
+                with cap_cm as cap_info:
+                    if stream_prof:
+                        with obs_profile.annotate(qname):
+                            _dispatch()
+                            _resolve(p)
+                    else:
+                        _dispatch()
+                        _resolve(p)
+                p["cap_info"] = cap_info
+                _post(p)
+            else:
+                # the overlap: dispatch THIS query, then resolve the
+                # previous one while this one's device work (and D2H)
+                # is in flight
+                _dispatch()
+                _finalize_pending()
+                pending = p
+        _finalize_pending()
+    finally:
+        tracer.defer_exports = False
+        if pending is not None:
+            # exceptional unwind with a query still in flight: resolve
+            # best-effort so neither the handle nor the journal strand
+            try:
+                _finalize_pending()
+            except BaseException:  # noqa: BLE001 - already unwinding
+                pending = None
+        tracer.flush_exports()
     obs_profile.end_stream_trace()
     # resumed incarnations bill the replayed queries' journaled walls
     # into the phase total: the merged Power Test Time approximates the

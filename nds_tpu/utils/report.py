@@ -28,8 +28,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import traceback
+from contextlib import contextmanager
 from typing import Callable
 
 from nds_tpu.analysis import locksan
@@ -63,6 +65,13 @@ class TaskFailureCollector:
     # class-level listener list and each listener's failure store must
     # not race (lost appends silently under-report anomalies)
     _lock = locksan.lock("utils.TaskFailureCollector._lock")
+    # per-thread focus stack: boundary pipelining (README "Pipelined
+    # execution") keeps TWO report brackets — and therefore two
+    # registered collectors — open at once on one thread; a focused
+    # collector receives that thread's notifications EXCLUSIVELY, so
+    # query N's recovered anomalies cannot cross-bill into query N+1's
+    # summary (and vice versa). Empty stack = the legacy broadcast.
+    _tls = threading.local()
 
     def __init__(self) -> None:
         # ordered UNIQUE reasons; repeats count in _counts so a noisy
@@ -88,6 +97,25 @@ class TaskFailureCollector:
                     f"{r} (x{self._counts[r]})" for r in self.failures]
 
     @classmethod
+    @contextmanager
+    def focused(cls, collector: "TaskFailureCollector | None"):
+        """Route the CALLING thread's notifications exclusively to one
+        collector for the block (no-op on None): the dispatch/resolve
+        halves of an overlapped query bracket each focus their own
+        report's collector."""
+        if collector is None:
+            yield
+            return
+        stack = getattr(cls._tls, "stack", None)
+        if stack is None:
+            stack = cls._tls.stack = []
+        stack.append(collector)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    @classmethod
     def notify(cls, reason: str) -> None:
         """Called by engine internals on recoverable task-level
         failures. Every notification also increments the
@@ -96,8 +124,9 @@ class TaskFailureCollector:
         registered (warmups, direct executor use)."""
         from nds_tpu.obs import metrics as obs_metrics
         obs_metrics.counter("task_failures_total").inc()
+        stack = getattr(cls._tls, "stack", None)
         with cls._lock:
-            for listener in cls._active:
+            for listener in (stack[-1],) if stack else cls._active:
                 if reason in listener._counts:
                     listener._counts[reason] += 1
                 else:
@@ -122,6 +151,7 @@ class BenchReport:
             "query": query_name,
         }
         self._engine_info = engine_info or {}
+        self._collector: "TaskFailureCollector | None" = None
 
     def capture_env(self) -> None:
         self.summary["env"]["envVars"] = redact_env(dict(os.environ))
@@ -195,6 +225,54 @@ class BenchReport:
         self.summary["queryTimes"].append(end_time - start_time)
         return self.summary
 
+    def begin_async(self) -> None:
+        """Open the report bracket without a body: the split form of
+        ``report_on`` the query-boundary pipelining uses (README
+        "Pipelined execution") — the dispatch half runs now, the
+        result() half may run after the NEXT query dispatched, and
+        ``end_async`` closes the bracket with the same status
+        vocabulary. The bracket endpoints are dispatch-start and
+        result-done, the same contract the throughput loop's
+        dispatch->result walls already use."""
+        self.capture_env()
+        self._collector = TaskFailureCollector()
+        self._collector.register()
+        self._t0 = int(time.time() * 1000)
+
+    def focus_failures(self):
+        """Context manager for the dispatch/resolve halves of an open
+        ``begin_async`` bracket: this thread's TaskFailureCollector
+        notifications go to THIS report only (under boundary
+        pipelining two brackets' collectors are registered at once —
+        broadcast would cross-bill one query's recovered anomalies
+        into the other's summary). No-op before begin_async."""
+        return TaskFailureCollector.focused(self._collector)
+
+    def end_async(self, error: "BaseException | None" = None):
+        """Close a ``begin_async`` bracket: status/exception/elapsed
+        recording identical to ``report_on``'s (Completed |
+        CompletedWithTaskFailures | Failed)."""
+        end_time = int(time.time() * 1000)
+        collector = self._collector
+        self._collector = None
+        collector.unregister()
+        if error is not None:
+            print("ERROR BEGIN")
+            traceback.print_exception(type(error), error,
+                                      error.__traceback__)
+            print("ERROR END")
+            self.summary["queryStatus"].append("Failed")
+            self.summary["exceptions"].append(str(error))
+        elif collector.failures:
+            self.summary["queryStatus"].append(
+                "CompletedWithTaskFailures")
+            self.summary["exceptions"].extend(collector.formatted())
+        else:
+            self.summary["queryStatus"].append("Completed")
+        self.summary["startTime"] = self._t0
+        self.summary["queryTimes"].append(end_time - self._t0)
+        return self.summary
+
     def attach_retry(self, stats) -> None:
         """Record a resilience.retry.RetryStats into the summary:
         ``retries`` always (0 is meaningful — the query needed no
@@ -230,6 +308,11 @@ class BenchReport:
             # the memory governor demoted/pre-shrank this query BEFORE
             # dispatch (engine/scheduler.MemoryGovernor)
             self.summary["governed"] = True
+        if sched.get("prefetch_depth") is not None:
+            # governor depth admission lowered the phase-A prefetch
+            # depth for this query (engine/pipeline_io.py; depth
+            # demotes before placement)
+            self.summary["prefetch_depth"] = int(sched["prefetch_depth"])
 
     def attach_cache(self, mdelta: dict | None,
                      timings: dict | None = None) -> None:

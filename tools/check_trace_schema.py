@@ -218,6 +218,13 @@ def validate_summary(obj: object) -> list[str]:
         # memory-governor pre-admission demotion
         # (engine/scheduler.MemoryGovernor)
         errs.append(f"bad governed {obj['governed']!r}")
+    if "prefetch_depth" in obj and (
+            not isinstance(obj["prefetch_depth"], int)
+            or isinstance(obj["prefetch_depth"], bool)
+            or obj["prefetch_depth"] < 0):
+        # governor depth admission lowered the phase-A prefetch depth
+        # for this query (engine/pipeline_io.py)
+        errs.append(f"bad prefetch_depth {obj['prefetch_depth']!r}")
     # resume fields (resilience/journal.QueryJournal; README
     # "Preemption & resume"): which incarnation served the query and
     # the result's content digest

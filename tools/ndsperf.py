@@ -35,6 +35,19 @@ is visible in isolation:
   enc.dictjoin  direct-address dict-code join probing raw int32
                 codes vs bit-packed codes unpacked into the gather
 
+Pipeline lanes (engine/pipeline_io.py; README "Pipelined execution") —
+an 8-chunk stream whose host half (bitpack encode + device_put) and
+device half (fused decode + filter count, compiled once) run the
+chunked engine's phase-A shape, serial vs double-buffered:
+
+  pipe.prefetch1  serial chunk loop vs prefetch depth 1
+  pipe.prefetch2  serial chunk loop vs prefetch depth 2
+
+These are LOOP lanes: the whole K-chunk pipeline is timed (the
+per-chunk readback is the sync), not one jitted call — the quantity
+under test is exactly the overlap, so the result cross-check compares
+the summed counts and the speedup column is the tracked overlap win.
+
 Timing protocol: each lane jit-compiles both paths, runs one warmup
 call (compile + first-touch excluded), then reports the BEST of
 ``--repeat`` timed calls with ``block_until_ready`` inside the clock —
@@ -378,6 +391,65 @@ def lane_enc_dictjoin(n: int, rng):
     return old, new, (bkey, bok, praw, pwords, pok), check
 
 
+def _lane_pipe(depth: int):
+    """Phase-A pipeline lane at one prefetch depth: an 8-chunk stream
+    where each chunk's HOST half (bitpack encode, pure numpy — releases
+    the GIL) and DEVICE half (fused decode + range-filter count over
+    the one compiled program, per-chunk readback as the sync point)
+    mirror the chunked engine's keep-mask loop. ``old`` runs the
+    serial loop (depth 0 = byte-identical staging inline), ``new`` the
+    double-buffered one — the speedup IS the measured overlap."""
+
+    def build(n: int, rng):
+        from nds_tpu.engine import device_exec  # noqa: F401 -- x64 on
+        import jax
+        import jax.numpy as jnp
+        from nds_tpu.columnar import device as cdev
+        from nds_tpu.columnar.encodings import EncSpec, encode_values
+        from nds_tpu.engine.pipeline_io import ChunkPrefetcher
+        K = 8
+        chunks = [rng.integers(10_000, 40_000, n).astype(np.int64)
+                  for _ in range(K)]
+        spec = EncSpec("bitpack", n, "int64", bits=16, lo=10_000)
+        lo_q, hi_q = 15_000, 25_000
+
+        def count(w):
+            dv, _ = cdev.decode(spec, {"k": w}, "k")
+            return jnp.sum((dv >= lo_q) & (dv < hi_q))
+
+        compiled = jax.jit(count)
+
+        def stage(i):
+            words = encode_values(spec, chunks[i])[""]
+            return jax.device_put(words), words.nbytes
+
+        def run_with(d: int) -> int:
+            total = 0
+            pf = ChunkPrefetcher(range(K), stage, d)
+            try:
+                for staged in pf:
+                    try:
+                        total += int(compiled(staged.payload))
+                    finally:
+                        staged.release()
+            finally:
+                pf.close()
+            return total
+
+        def old():
+            return run_with(0)
+
+        def new():
+            return run_with(depth)
+
+        def check(o, nw):
+            assert int(o) == int(nw), (int(o), int(nw))
+
+        return old, new, (), check
+
+    return build
+
+
 LANES = {
     "join.unique": lane_join_unique,
     "join.tiny": lane_join_tiny,
@@ -388,7 +460,13 @@ LANES = {
     "enc.bitpack": lane_enc_bitpack,
     "enc.rle": lane_enc_rle,
     "enc.dictjoin": lane_enc_dictjoin,
+    "pipe.prefetch1": _lane_pipe(1),
+    "pipe.prefetch2": _lane_pipe(2),
 }
+
+# lanes whose old/new callables run a whole chunk LOOP (syncing
+# internally): timed as-is, never wrapped in an outer jax.jit
+LOOP_LANES = {"pipe.prefetch1", "pipe.prefetch2"}
 
 
 def run(sizes, repeat: int, lanes=None) -> dict:
@@ -401,7 +479,10 @@ def run(sizes, repeat: int, lanes=None) -> dict:
             continue
         for n in sizes:
             old_fn, new_fn, args, check = build(int(n), rng)
-            jold, jnew = _jit(old_fn), _jit(new_fn)
+            if name in LOOP_LANES:
+                jold, jnew = old_fn, new_fn
+            else:
+                jold, jnew = _jit(old_fn), _jit(new_fn)
             try:
                 o, nw = jold(*args), jnew(*args)
                 jax.block_until_ready((o, nw))

@@ -84,6 +84,21 @@ the one to run locally before pushing:
                         per-column encoding specs and its mode-change
                         invalidation (nds_tpu/columnar/; README
                         "Compressed columnar store")
+ 10b. pipeline          pipelined-execution gate
+                        (tools/pipeline_check.py): a 3-query NDS-H
+                        power stream FORCED onto the chunked placement
+                        (8+ chunks per streamed table) runs serial vs
+                        prefetch depth 2 (engine/pipeline_io.py) —
+                        rows byte-identical, identical compile counts
+                        (the pipeline must not perturb chunkscan
+                        fingerprints), measured prefetch_hidden_s > 0,
+                        wall-clock no worse; the prefetch run's
+                        attribution keeps categories+residual ==
+                        wall-clock with the new prefetch_wait
+                        category; and an engine.prefetch.boundary=on
+                        run (query N+1 dispatched while N's result is
+                        in flight) stays byte-identical with
+                        schema-valid summaries + a complete journal
  11. serve              query-server smoke (tools/serve_check.py): a
                         warmed QueryServer (nds_tpu/serve/) handles a
                         mixed NDS+NDS-H literal-variant load at >=4
@@ -139,6 +154,7 @@ import ndsperf  # noqa: E402
 import ndsraces  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
+import pipeline_check  # noqa: E402
 import serve_check  # noqa: E402
 import soak_check  # noqa: E402
 
@@ -261,6 +277,7 @@ def main() -> int:
         ("fleet", fleet_check.main),
         ("soak", lambda: soak_check.main([])),
         ("compress", lambda: compress_check.main([])),
+        ("pipeline", lambda: pipeline_check.main([])),
         ("serve", lambda: serve_check.main([])),
         ("locksan", run_locksan_check),
     ]
