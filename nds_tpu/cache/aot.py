@@ -194,6 +194,11 @@ def load_cached(cache, fp: str, kind: str,
         timings["cache_load_ms"] = (
             timings.get("cache_load_ms", 0.0)
             + (time.perf_counter() - t0) * 1000)
+    # warm hits still bill compiler-truth costs: the persisted cost
+    # dict rides the payload, pinned here so dispatch-time extraction
+    # (obs/costs.record_program) is a dict read, not a re-analysis
+    from nds_tpu.obs import costs as obs_costs
+    obs_costs.attach(compiled, payload.get("cost"))
     return compiled, payload.get("extra", {})
 
 
@@ -227,11 +232,19 @@ def persist(cache, fp: str, kind: str, compiled,
                       f"serialize round-trip ({type(exc).__name__}); "
                       f"not persisting {kind} {fp[:12]}…")
             return False
-    return cache.put(fp, {"exec": blob, "in_tree": in_tree,
-                          "out_tree": out_tree,
-                          "extra": dict(extra or {})},
-                     meta={"kind": kind, "fp_version": fpmod.FP_VERSION,
-                           **platform_parts(), **(meta or {})})
+    # compiler cost/memory analyses persist alongside the executable
+    # (payload for the hit path, manifest meta for offline tooling) so
+    # warm runs carry program costs without a live re-analysis
+    from nds_tpu.obs import costs as obs_costs
+    cost = obs_costs.extract(compiled)
+    payload = {"exec": blob, "in_tree": in_tree, "out_tree": out_tree,
+               "extra": dict(extra or {})}
+    meta_out = {"kind": kind, "fp_version": fpmod.FP_VERSION,
+                **platform_parts(), **(meta or {})}
+    if cost is not None:
+        payload["cost"] = dict(cost)
+        meta_out["cost"] = dict(cost)
+    return cache.put(fp, payload, meta=meta_out)
 
 
 def cached_compile(cache, fp: "str | None", kind: str, build, args,

@@ -51,6 +51,7 @@ from nds_tpu.engine.types import (
     INT64, DecimalType, FloatType, Schema, StringType,
 )
 from nds_tpu.io.host_table import HostColumn, HostTable, encode_strings
+from nds_tpu.obs import costs as obs_costs
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
@@ -647,6 +648,11 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         )
                         overflow_policy = adaptive_policy(4)
                         for attempt in overflow_policy.attempts():
+                            # per-dispatch cost billing: each chunk
+                            # (and each overflow retry) bills its
+                            # program's compiler cost once
+                            obs_costs.record_program(
+                                type(ex).__name__, compiled)
                             row, outs, overflow = compiled(bufs)
                             # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the overflow verdict gates the slack-doubling retry, and the partials must land on host before the next chunk swaps buffers
                             row_h, outs_h, over_h = jax.device_get(
@@ -867,6 +873,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
                         compiled = self._keep_mask_compiled(
                             table, scans, need_cols, C, fn, bufs,
                             chunk_specs)
+                    obs_costs.record_program("chunkscan", compiled)
                     # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the keep mask IS phase A's product and must land on host before the survivor gather
                     keep_np[start:stop] = np.asarray(
                         compiled(bufs,

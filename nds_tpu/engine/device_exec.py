@@ -61,6 +61,7 @@ from nds_tpu.engine.types import (  # noqa: E402
     BoolType, DateType, DecimalType, DType, FloatType, IntType, StringType,
 )
 from nds_tpu.io.host_table import HostTable  # noqa: E402
+from nds_tpu.obs import costs as obs_costs  # noqa: E402
 from nds_tpu.obs import memwatch  # noqa: E402
 from nds_tpu.obs import metrics as obs_metrics  # noqa: E402
 from nds_tpu.obs.trace import get_tracer  # noqa: E402
@@ -176,7 +177,9 @@ _PEAK_MEM_GBPS = {"tpu v4": 1228.0, "tpu v5 lite": 819.0,
 
 def _peak_mem_gbps() -> float | None:
     """Roofline peak for the ACTIVE backend: env override first
-    (NDS_TPU_PEAK_GBPS, for measured numbers), then device-kind lookup.
+    (NDS_TPU_PEAK_GBPS, for measured numbers), then measured numbers
+    from ``ndsperf --calibrate`` (configs/platform_peaks.json, via
+    obs/costs), then the builtin device-kind lookup.
     Never initializes a backend (tunnel-down safety: utils/report.py)."""
     env = os.environ.get("NDS_TPU_PEAK_GBPS")
     if env:
@@ -191,6 +194,9 @@ def _peak_mem_gbps() -> float | None:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # noqa: BLE001
         return None
+    measured = obs_costs.calibrated_mem_gbps(kind)
+    if measured is not None:
+        return measured
     for prefix, gbps in sorted(_PEAK_MEM_GBPS.items(),
                                key=lambda kv: -len(kv[0])):
         if kind.startswith(prefix):
@@ -752,6 +758,11 @@ class DeviceExecutor:
             memwatch.add_live(timings["bytes_scanned"])
             timings["__live_bytes"] = timings["bytes_scanned"]
             memwatch.sample_device()
+            # compiler-truth cost billing (obs/costs): per dispatch,
+            # before the execute bracket opens so the memoized
+            # extraction never inflates device.run
+            obs_costs.record_program(type(self).__name__,
+                                     entry["compiled"])
             # ndslint: waive[NDS102] -- execute bracket opens here; _finish_traced closes it after device_get
             t1 = _time.perf_counter()
             row, outs, overflow = (entry["compiled"](bufs, pvals)
@@ -992,6 +1003,7 @@ class DeviceExecutor:
             cf = self._compactor(row_d, outs_d, timings)
             # first-use compactor compile must not count as execution
             t1 += timings.pop("__compact_compile_ms", 0.0) / 1000
+            obs_costs.record_program("compact", cf)
             cnt_d, row2, outs2 = cf(row_d, outs_d)
             cnt_h, overflow_h = jax.device_get((cnt_d, overflow_d))
             if int(overflow_h) == 0:

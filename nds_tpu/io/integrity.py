@@ -205,7 +205,13 @@ def verify_paths(paths: list[str] | str, name: str = "") -> None:
         return
     if isinstance(paths, str):
         paths = [paths]
+    from nds_tpu.resilience import watchdog
     for p in paths:
+        # per-file heartbeat: hashing a whole fact table's chunks on a
+        # loaded box (several concurrent streams, cold page cache) can
+        # out-wait a watchdog stall budget with no beat in between —
+        # verification is work, not a hang
+        watchdog.beat("engine", phase="io.read", table=name)
         found = _manifest_for(p)
         if found is None:
             continue

@@ -14,9 +14,15 @@ behind a trigger policy (``NDS_TPU_PROFILE``).  ``query_timings`` is
 the span-fed replacement for scraping ``executor.last_timings`` by
 hand.
 
-``analyze``/``snapshot``/``fleet``/``profile`` import lazily on
-attribute access — the hot engine path pays for spans and counters
-only.
+``nds_tpu.obs.costs`` holds the compiler-truth cost ledger (XLA
+``cost_analysis``/``memory_analysis`` per compiled program, billed per
+dispatch into the BenchReport ``cost`` block) and
+``nds_tpu.obs.telemetry`` the live device-memory sampler behind the
+``telemetry`` block and the Chrome-trace counter lanes.
+
+``analyze``/``snapshot``/``fleet``/``profile``/``costs``/``telemetry``
+import lazily on attribute access — the hot engine path pays for spans
+and counters only.
 """
 
 from __future__ import annotations
@@ -24,12 +30,14 @@ from __future__ import annotations
 from nds_tpu.obs import memwatch, metrics, trace
 from nds_tpu.obs.trace import get_tracer
 
-__all__ = ["analyze", "fleet", "memwatch", "metrics", "profile",
-           "snapshot", "trace", "get_tracer", "query_timings"]
+__all__ = ["analyze", "costs", "fleet", "memwatch", "metrics",
+           "profile", "snapshot", "telemetry", "trace", "get_tracer",
+           "query_timings"]
 
 
 def __getattr__(name: str):
-    if name in ("analyze", "snapshot", "fleet", "profile"):
+    if name in ("analyze", "snapshot", "fleet", "profile", "costs",
+                "telemetry"):
         import importlib
         return importlib.import_module(f"nds_tpu.obs.{name}")
     raise AttributeError(name)

@@ -181,6 +181,32 @@ def attribute_query(summary: dict) -> dict:
     if isinstance(prof, dict) and prof.get("path"):
         row["profile"] = {"trigger": str(prof.get("trigger", "query")),
                           "path": str(prof["path"])}
+    # compiler-truth cost ledger (obs/costs.py): the query's summed
+    # XLA flops/bytes, the roofline-model predicted time against the
+    # recorded platform's peaks, and the achieved fraction (predicted
+    # over measured execute — how close the run came to the model's
+    # ceiling). Absent on pre-cost run dirs, which keep analyzing
+    # byte-identically
+    cost = summary.get("cost")
+    if isinstance(cost, dict) and isinstance(cost.get("programs"),
+                                             dict):
+        row["cost"] = dict(cost)
+        from nds_tpu.obs import costs as _costs
+        pred = _costs.predicted_ms(cost)
+        if pred is not None:
+            row["predicted_ms"] = round(pred, 3)
+            measured = (cats["execute"] if cats["execute"] > 0
+                        else wall_ms - cats["compile"]
+                        - cats["retry_backoff"])
+            if measured > 0:
+                row["achieved_frac"] = round(pred / measured, 4)
+    # HBM occupancy telemetry (obs/telemetry.py): series shape summary
+    tl = summary.get("telemetry")
+    if isinstance(tl, dict) and tl.get("samples"):
+        row["telemetry_samples"] = int(tl["samples"])
+        hbm = tl.get("hbm") or {}
+        if isinstance(hbm.get("max_bytes"), (int, float)):
+            row["hbm_max_bytes"] = int(hbm["max_bytes"])
     return row
 
 
@@ -516,6 +542,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     has_bytes = any("bytes_scanned" in r for r in rows)
     has_profile = any("profile" in r for r in rows)
     has_occup = any("occupancy" in r for r in rows)
+    has_cost = any("cost" in r for r in rows)
     cols = list(CATEGORIES) + ["residual", "wall"]
     head = (f"{'query':<{w}} " + " ".join(
         f"{short.get(c, c):>9}" for c in cols)
@@ -524,6 +551,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         + ("   roofline" if has_roofline else "")
         + ("         bytes" if has_bytes else "")
         + ("  occup" if has_occup else "")
+        + ("  predicted  achieved" if has_cost else "")
         + ("  profile" if has_profile else "") + "  status")
     lines = [head, "-" * len(head)]
     for r in rows:
@@ -582,6 +610,18 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             occ = r.get("occupancy")
             occup_col = ("  {:>5}".format(
                 f"{occ * 100.0:.0f}%" if occ is not None else "-"))
+        cost_col = ""
+        if has_cost:
+            # compiler-truth roofline model: predicted execute time
+            # (flops/bytes against the platform's peaks) and the
+            # achieved fraction of that ceiling — a LOW fraction means
+            # the query left the modeled hardware idle (README "Cost
+            # ledger & telemetry")
+            pm = r.get("predicted_ms")
+            af = r.get("achieved_frac")
+            cost_col = ("  {:>9}  {:>8}".format(
+                f"{pm:.1f}ms" if pm is not None else "-",
+                f"{af * 100.0:.0f}%" if af is not None else "-"))
         prof_col = ""
         if has_profile:
             prof_col = ("  {:>7}".format(
@@ -590,7 +630,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
             + place + cache_col + roof_col + bytes_col + occup_col
-            + prof_col + f"  {r['status']}")
+            + cost_col + prof_col + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -631,8 +671,13 @@ def parse_gate(spec: str | None) -> dict:
     """``pct=10`` / ``pct=10,abs_ms=50`` -> thresholds dict.  A delta
     must exceed BOTH the relative and the absolute floor to count —
     that's the noise model (sub-threshold absolute wobble on fast
-    queries must not fail a gate)."""
-    gate = {"pct": 10.0, "abs_ms": 50.0}
+    queries must not fail a gate). ``cost_pct`` is the COST-DRIFT
+    threshold: compiler flops/bytes for an unchanged query moving by
+    more than this fails the gate even when wall-clock noise hides
+    the regression (compiler numbers are deterministic — their noise
+    floor is ~0, so the default can be generous and still be a
+    tripwire)."""
+    gate = {"pct": 10.0, "abs_ms": 50.0, "cost_pct": 25.0}
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
@@ -801,6 +846,61 @@ def pipeline_changes(base_rows: dict, cur_rows: dict) -> list:
     return out
 
 
+# absolute floor for the compiler-flops drift gate: a megaflop of
+# movement on a tiny query is a constant-folding wobble, not a plan
+# change worth failing CI over
+FLOPS_ABS_FLOOR = 1e6
+
+
+def cost_changes(base_rows: dict, cur_rows: dict,
+                 pct: float = 25.0) -> list:
+    """Per-query compiler-cost drift between two runs: entries for
+    queries whose ``cost`` block flops or bytes_accessed moved, with
+    ``drifted: True`` (gate failure) when either moved by BOTH >pct%
+    and >= the absolute floor in EITHER direction — compiler numbers
+    are deterministic for an unchanged query, so a swing either way
+    means the compiled program changed, even when wall-clock noise
+    hides it. Queries without the block on either side (pre-cost run
+    dirs) are skipped; a side MISSING it entirely is flagged but
+    never fails the gate (the kernel_changes / bytes_changes
+    feature-boundary precedent)."""
+    out = []
+    for name in sorted(set(base_rows) & set(cur_rows)):
+        b = base_rows[name].get("cost")
+        c = cur_rows[name].get("cost")
+        if b is None and c is None:
+            continue
+        moved = False
+        drifted = False
+        entry: dict = {"query": name}
+        for key, floor in (("flops", FLOPS_ABS_FLOOR),
+                           ("bytes_accessed", BYTES_ABS_FLOOR)):
+            bv = (b or {}).get(key)
+            cv = (c or {}).get(key)
+            if bv == cv:
+                continue
+            moved = True
+            entry[f"base_{key}"] = bv
+            entry[f"cur_{key}"] = cv
+            if (b is not None and c is not None
+                    and isinstance(bv, (int, float))
+                    and isinstance(cv, (int, float))
+                    and abs(cv - bv) >= floor
+                    and bv > 0
+                    and abs(cv - bv) / bv > pct / 100.0):
+                drifted = True
+        if b is None or c is None:
+            entry["missing"] = "base" if b is None else "cur"
+            out.append(entry)
+            continue
+        if not moved:
+            continue
+        if drifted:
+            entry["drifted"] = True
+        out.append(entry)
+    return out
+
+
 def cache_hit_rate(analysis: dict) -> "dict | None":
     """Run-level plan-cache summary from the per-query rows:
     ``{"hits", "misses", "rate"}`` (rate = hits / consults), or None
@@ -821,7 +921,7 @@ def cache_hit_rate(analysis: dict) -> "dict | None":
 
 
 def diff_runs(base: dict, cur: dict, pct: float = 10.0,
-              abs_ms: float = 50.0) -> dict:
+              abs_ms: float = 50.0, cost_pct: float = 25.0) -> dict:
     """Query-by-query diff of two ``analyze_run`` results, gated on
     STEADY-STATE time; compile-count and compile-time changes are
     reported in their own ``compile_changes`` list so a recompile
@@ -867,6 +967,12 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
     # with no pipeline evidence on either side emit nothing here
     pchanges = pipeline_changes(b_rows, c_rows)
     stalled = [e["query"] for e in pchanges if e.get("stalled")]
+    # compiler-cost drift (obs/costs.py): deterministic flops/bytes
+    # moving >cost_pct for an unchanged query is a plan/program change
+    # — COST-DRIFT fails the gate even when wall-clock noise hides it
+    cchanges = cost_changes(b_rows, c_rows, pct=cost_pct)
+    cost_drifted = [e["query"] for e in cchanges if e.get("drifted")]
+    d["gate"]["cost_pct"] = cost_pct
     d.update({
         "base_dir": base.get("run_dir"),
         "cur_dir": cur.get("run_dir"),
@@ -874,10 +980,12 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
         "kernel_changes": kchanges,
         "bytes_changes": bchanges,
         "pipeline_changes": pchanges,
+        "cost_changes": cchanges,
         "newly_failed": newly_failed,
         "passed": not d["regressions"] and not d["removed"]
                   and not newly_failed and not demoted
-                  and not bytes_regressed and not stalled,
+                  and not bytes_regressed and not stalled
+                  and not cost_drifted,
     })
     # plan-cache hit-rate per run, the compile-count-change flag's
     # natural companion: a run whose compile counts dropped to 0
@@ -948,6 +1056,27 @@ def format_diff(d: dict) -> str:
             f"  {label:<16} {e['query']:<14} "
             f"stall share {e['base_share'] * 100.0:.0f}% -> "
             f"{e['cur_share'] * 100.0:.0f}%")
+    for e in d.get("cost_changes", []):
+        # compiler-cost drift: deterministic flops/bytes moved for an
+        # unchanged query — the compiled program itself changed
+        label = "COST-DRIFT" if e.get("drifted") else "cost"
+        if e.get("missing"):
+            lines.append(f"  {label:<11} {e['query']:<14} "
+                         f"cost block missing on {e['missing']} side")
+            continue
+        parts = []
+        for key, fmt in (("flops", "{:.3g}"),
+                         ("bytes_accessed", None)):
+            if f"base_{key}" in e or f"cur_{key}" in e:
+                def _v(v, _fmt=fmt):
+                    if v is None:
+                        return "-"
+                    return (_fmt.format(v) if _fmt
+                            else _fmt_bytes(v))
+                parts.append(f"{key} {_v(e.get(f'base_{key}'))} -> "
+                             f"{_v(e.get(f'cur_{key}'))}")
+        lines.append(f"  {label:<11} {e['query']:<14} "
+                     + "; ".join(parts))
     chr_ = d.get("cache_hit_rate") or {}
     if any(chr_.get(k) for k in ("base", "cur")):
         def _rate(r):
@@ -1137,6 +1266,7 @@ def render_html(analysis: dict, diff: dict | None = None,
         "<th>breakdown</th><th>residual ms</th><th>compiles</th>"
         "<th>cache</th><th>retries</th><th>placement</th>"
         "<th>kernels</th><th>roofline</th><th>bytes</th>"
+        "<th>predicted</th><th>achieved</th>"
         "<th>straggler</th><th>profile</th>"
         "<th>mem HWM</th><th>status</th></tr>",
     ]
@@ -1179,6 +1309,12 @@ def render_html(analysis: dict, diff: dict | None = None,
             p = row["profile"]
             prof = (f"<span title='{_esc(p['path'])}'>"
                     f"{_esc(p['trigger'])}</span>")
+        # predicted-vs-measured (obs/costs roofline model): blank on
+        # pre-cost rows and on platforms without a peaks entry
+        pred = ("" if row.get("predicted_ms") is None
+                else f"{row['predicted_ms']:.1f} ms")
+        ach = ("" if row.get("achieved_frac") is None
+               else f"{row['achieved_frac'] * 100.0:.0f}%")
         out.append(
             f"<tr><td class='q'>{_esc(row['query'])}</td>"
             f"<td>{row['wall_ms']:.1f}</td><td>{_bar(row)}</td>"
@@ -1188,6 +1324,7 @@ def render_html(analysis: dict, diff: dict | None = None,
             f"<td>{place}</td>"
             f"<td class='q'>{kern}</td><td>{roof}</td>"
             f"<td>{bcell}</td>"
+            f"<td>{pred}</td><td>{ach}</td>"
             f"<td>{strag}</td><td>{prof}</td>"
             f"<td>{_fmt_bytes(row.get('hwm_bytes'))}</td>"
             f"<td>{_esc(row['status'])}</td></tr>")

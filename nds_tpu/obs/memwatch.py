@@ -51,7 +51,15 @@ def _device_bytes_in_use() -> int | None:
     """Sum of ``bytes_in_use`` across already-initialized jax devices,
     or None when stats are unavailable. NEVER initializes a backend
     (the utils/report.py rule: discovery can block forever on a dead
-    chip tunnel)."""
+    chip tunnel) — and never INITIATES the jax import either: the
+    telemetry sampler (obs/telemetry.py) calls this from a daemon
+    thread, and a thread-side ``import jax`` racing the main thread's
+    first import deadlock-breaks into partially-initialized modules."""
+    import sys
+    mod = sys.modules.get("jax")
+    if mod is None or getattr(getattr(mod, "__spec__", None),
+                              "_initializing", False):
+        return None
     try:
         import jax
         from jax._src import xla_bridge as _xb

@@ -202,6 +202,13 @@ class MetricsSnapshotter:
         hb = watchdog.snapshot_heartbeats()
         if hb:
             doc["heartbeats"] = hb
+        # live HBM occupancy (obs/telemetry.py): latest reading + ring
+        # depth; absent on no-stats backends so the snapshot keeps its
+        # pre-telemetry shape there
+        from nds_tpu.obs import telemetry
+        tl = telemetry.snapshot_block()
+        if tl:
+            doc["telemetry"] = tl
         try:
             # pid+thread-unique tmps (write_json_atomic, and the same
             # scheme for the OpenMetrics sibling): two processes

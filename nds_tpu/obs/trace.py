@@ -390,9 +390,9 @@ class Tracer:
 _EXPORT_FILES: dict = {}
 
 
-def export_chrome(root: Span, path: str) -> None:
-    """Append one JSONL line per span in ``root``'s tree to ``path``."""
-    events = root.to_events()
+def _append_events(events: list, path: str) -> None:
+    """JSONL-append pre-built trace events through the held-open
+    handle for ``path`` (shared by span trees and counter lanes)."""
     with _EXPORT_LOCK:
         f = _EXPORT_FILES.get(path)
         if f is None or f.closed:
@@ -403,6 +403,35 @@ def export_chrome(root: Span, path: str) -> None:
                     _EXPORT_FILES.pop(old).close()
         f.write("".join(json.dumps(ev) + "\n" for ev in events))
         f.flush()
+
+
+def export_chrome(root: Span, path: str) -> None:
+    """Append one JSONL line per span in ``root``'s tree to ``path``."""
+    _append_events(root.to_events(), path)
+
+
+def counter_event(name: str, values: dict, t: "float | None" = None,
+                  pid: "int | None" = None) -> dict:
+    """One Chrome-trace counter sample (``ph: "C"``): Perfetto renders
+    each numeric key in ``values`` as a stacked counter lane next to
+    the span tracks. ``t`` is a perf_counter timestamp (defaults to
+    now) — exported on the same calibrated epoch as spans so lanes
+    line up."""
+    if t is None:
+        t = time.perf_counter()
+    return {"name": name, "cat": "counter", "ph": "C",
+            "ts": (t + _EPOCH_OFFSET) * 1e6,
+            "pid": export_pid() if pid is None else int(pid),
+            "tid": 0,
+            "args": {str(k): float(v) for k, v in values.items()}}
+
+
+def export_counters(events: list, path: str) -> None:
+    """Append counter events (``counter_event``) to a trace file —
+    the device-memory telemetry lane rides the same JSONL stream as
+    the spans."""
+    if events:
+        _append_events(events, path)
 
 
 # timing keys the per-phase spans map onto (the legacy last_timings

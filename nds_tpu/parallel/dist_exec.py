@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P_
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
 from nds_tpu.io.host_table import HostTable
+from nds_tpu.obs import costs as obs_costs
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs.trace import get_tracer
@@ -494,6 +495,10 @@ class DistributedExecutor(dx.DeviceExecutor):
             memwatch.add_live(timings["bytes_scanned"])
             timings["__live_bytes"] = timings["bytes_scanned"]
             memwatch.sample_device()
+            # compiler-truth cost billing (obs/costs): per dispatch,
+            # outside the execute bracket
+            obs_costs.record_program(type(self).__name__,
+                                     state["jitted"])
             # ndslint: waive[NDS102] -- execute bracket start; closed below after device_get
             t1 = _time.perf_counter()
             row, outs, overflow, skew = state["jitted"](shard_bufs,

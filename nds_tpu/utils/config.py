@@ -152,6 +152,36 @@ Serving keys (the query server, nds_tpu/serve/ — README "Serving"):
                             p50/p99 like any run dir (unset = no
                             summaries)
 
+Observability keys (cost ledger + device telemetry, nds_tpu/obs/ —
+README "Cost ledger & telemetry"):
+
+  obs.costs.enabled         compiler cost ledger (obs/costs.py): every
+                            dispatched compiled program's XLA
+                            ``cost_analysis()`` /
+                            ``memory_analysis()`` is billed to the
+                            running query and lands in the BenchReport
+                            ``cost`` block (flops, bytes accessed,
+                            transcendentals, temp/argument/output
+                            bytes, per-kind program census). On by
+                            default — the readings come from the
+                            already-compiled executable, so the only
+                            cost is a dict copy per dispatch. ``off``
+                            drops the block entirely.
+  obs.telemetry.enabled     background device-memory sampler
+                            (obs/telemetry.py): a daemon thread polls
+                            per-device ``memory_stats()`` into a
+                            bounded ring; per-query HBM occupancy
+                            summaries land in the BenchReport
+                            ``telemetry`` block and the samples export
+                            as Chrome-trace counter lanes. Graceful
+                            no-op on backends without allocator stats
+                            (CPU). On by default. Env:
+                            NDS_TPU_TELEMETRY=0/1 wins over the
+                            config key.
+  obs.telemetry.interval_ms sampling period in milliseconds (default
+                            250). The ring is bounded, so long runs
+                            decimate rather than grow.
+
 Diagnostics env toggles (no config-file analog — they gate process
 instrumentation, not workload shape, and must be readable before any
 config loads):

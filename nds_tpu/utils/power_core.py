@@ -61,10 +61,13 @@ from dataclasses import dataclass, field
 
 from nds_tpu import obs
 from nds_tpu.engine.session import Session
+from nds_tpu.obs import costs as obs_costs
 from nds_tpu.obs import fleet as obs_fleet
 from nds_tpu.obs import memwatch
 from nds_tpu.obs import metrics as obs_metrics
 from nds_tpu.obs import profile as obs_profile
+from nds_tpu.obs import telemetry as obs_telemetry
+from nds_tpu.obs import trace as obs_trace
 from nds_tpu.obs.trace import get_tracer
 from nds_tpu.resilience import drain, faults, watchdog
 from nds_tpu.resilience.journal import QueryJournal, config_digest
@@ -348,6 +351,12 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
     snap = MetricsSnapshotter.from_env(progress)
     if snap:
         snap.start()
+    # live device-memory telemetry (obs/telemetry.py): a no-op sampler
+    # on backends without allocator stats; per-query readout happens in
+    # the query loop, counter lanes export next to the span trees
+    obs_telemetry.start_from_config(config)
+    # compiler cost ledger on/off (obs.costs.enabled, default on)
+    obs_costs.configure_from(config)
     # hang watchdog: stall reports land next to the run's artifacts
     run_dir = (json_summary_folder
                or os.path.dirname(time_log_path) or ".")
@@ -385,6 +394,7 @@ def run_query_stream(suite: Suite, data_dir: str, stream_path: str,
         # flight recorder / profiler against its own run dir
         obs_fleet.disarm_flight_recorder()
         obs_profile.teardown()
+        obs_telemetry.stop()
         if snap:
             progress["current_query"] = None
             snap.stop()
@@ -626,6 +636,17 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                                        None))
         report.attach_memory(p.get("hwm") if p.get("hwm") is not None
                              else memwatch.high_water())
+        # compiler-truth cost ledger + HBM-occupancy series (the
+        # overlapped path snapshotted both at the successor's reset;
+        # the sync path reads the live windows here), cross-checked
+        # against the hand-rolled ops_est roofline input
+        cost_block = (p.get("cost") if p.get("cost") is not None
+                      else obs_costs.query_block())
+        report.attach_cost(obs_costs.cross_check(
+            cost_block, (timings or {}).get("ops_est")))
+        report.attach_telemetry(
+            p.get("telemetry") if p.get("telemetry") is not None
+            else obs_telemetry.query_block())
         # resume bookkeeping: which incarnation served this query, the
         # result's content digest (what the soak gate diffs against a
         # clean run), and any torn-state degradations this process saw
@@ -695,6 +716,23 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
         # exports parked during the bracket flush now; the metric
         # window for the NEXT pipelined query starts here
         tracer.flush_exports()
+        # device-memory counter lanes ride the same trace stream as
+        # the spans: telemetry samples since the last drain, plus one
+        # per-query HWM point — Perfetto renders them as memory tracks
+        trace_path = os.environ.get(obs_trace.TRACE_ENV)
+        if trace_path:
+            events = [obs_trace.counter_event(
+                "device_memory_bytes", {"bytes_in_use": b}, t=t)
+                for t, b in obs_telemetry.drain_counter_events()]
+            hwm_bytes = (summary.get("memory")
+                         or {}).get("device_hwm_bytes")
+            if hwm_bytes:
+                events.append(obs_trace.counter_event(
+                    "device_hwm_bytes", {"hwm": hwm_bytes}))
+            try:
+                obs_trace.export_counters(events, trace_path)
+            except OSError:  # tracing must never fail the query
+                pass
         mbase = obs_metrics.snapshot()
 
     def _finalize_pending() -> None:
@@ -773,12 +811,19 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                         or bool(stall_path) or bool(stream_prof))
             if run_sync:
                 _finalize_pending()
-            # fresh per-query memory window (obs/memwatch): the HWM is
+            # fresh per-query memory/cost/telemetry windows: each is
             # monotone within the query and resets here; an overlapped
-            # predecessor's peak is snapshotted into its record first
+            # predecessor's readings snapshot into its record first
+            # (the reset precedes this query's dispatch AND the
+            # predecessor's _post, so dispatches land in the fresh
+            # window and _post reads the snapshot)
             if pending is not None:
                 pending["hwm"] = memwatch.high_water()
+                pending["cost"] = obs_costs.query_block()
+                pending["telemetry"] = obs_telemetry.query_block()
             memwatch.reset_query()
+            obs_costs.reset_query()
+            obs_telemetry.reset_query()
             report = BenchReport(qname, config.as_dict())
             out_pref = output_prefix if primary else None
             # a query that fails BEFORE reaching the executor
@@ -806,7 +851,8 @@ def _run_query_stream(suite, data_dir, stream_path, time_log_path,
                                  suite=suite.name, backend=backend)
             p = {"qname": qname, "report": report, "span": qspan,
                  "out_pref": out_pref, "metrics_before": metrics_before,
-                 "hwm": None, "stall_path": stall_path}
+                 "hwm": None, "cost": None, "telemetry": None,
+                 "stall_path": stall_path}
             report.begin_async()
 
             def _dispatch(_p=p, _sql=sql, _ex=pre_ex):

@@ -434,6 +434,24 @@ class BenchReport:
         if hwm:
             self.summary["memory"] = dict(hwm)
 
+    def attach_cost(self, block: dict | None) -> None:
+        """Record the compiler-truth cost ledger (obs/costs.py) as the
+        ``cost`` block: summed XLA cost_analysis (flops/bytes/
+        transcendentals), maxed memory_analysis sizes, the per-kind
+        program census, and the ops_est cross-check. Absent when the
+        query dispatched no compiled programs (CPU oracle, harness
+        paths) — pre-cost summaries keep their shape."""
+        if block:
+            self.summary["cost"] = dict(block)
+
+    def attach_telemetry(self, block: dict | None) -> None:
+        """Record the per-query HBM-occupancy time series summary
+        (obs/telemetry.py) as the ``telemetry`` block. Absent when the
+        sampler is off or the backend has no allocator stats — CPU
+        summaries stay byte-identical to pre-telemetry runs."""
+        if block:
+            self.summary["telemetry"] = dict(block)
+
     def write_summary(self, prefix: str = "",
                       out_dir: str | None = None) -> str:
         """Write '{prefix}-{query}-{startTime}.json' (reference filename

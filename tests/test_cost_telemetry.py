@@ -1,0 +1,424 @@
+"""Cost-ledger + telemetry tests (nds_tpu/obs/costs.py, telemetry.py;
+tools/ndsreport.py bank): cost extraction/normalization off fake
+compiled objects, per-dispatch ledger fold semantics (sums vs maxima),
+the ops_est cross-check corridor, platform-peaks precedence
+(calibrated file over datasheet builtins, longest-prefix match), the
+roofline predicted-time model, sampler lifecycle (start/stop
+idempotence, graceful no-op on stats-less backends, bounded ring,
+drain-once counter export, locksan-clean under a thread hammer), the
+COST-DRIFT gate in ndsreport diff, and bank's provenance record +
+stale refusal."""
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import pytest
+
+from nds_tpu.analysis import locksan
+from nds_tpu.obs import costs, telemetry
+from nds_tpu.obs.telemetry import TelemetrySampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_COST = os.path.join(REPO, "tests", "fixtures", "run_cost")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+# ----------------------------------------------------------- extraction
+
+class FakeMemStats:
+    temp_size_in_bytes = 4096
+    argument_size_in_bytes = 1024
+    output_size_in_bytes = 512
+
+
+class FakeCompiled:
+    """Duck-typed jax.stages.Compiled: list-of-dict cost_analysis (the
+    older-jax shape) + attribute-style memory_analysis."""
+
+    def __init__(self, flops=1e6, fail=False):
+        self._flops = flops
+        self._fail = fail
+
+    def cost_analysis(self):
+        if self._fail:
+            raise NotImplementedError("backend without analysis")
+        return [{"flops": self._flops, "bytes accessed": 2048.0,
+                 "transcendentals": 16.0, "utilization0{}": 3.0,
+                 "negative sentinel": -1.0}]
+
+    def memory_analysis(self):
+        if self._fail:
+            raise NotImplementedError("backend without analysis")
+        return FakeMemStats()
+
+
+def test_compute_cost_normalizes_keys():
+    c = costs.compute_cost(FakeCompiled())
+    assert c == {"flops": 1e6, "bytes_accessed": 2048.0,
+                 "transcendentals": 16.0, "temp_bytes": 4096,
+                 "argument_bytes": 1024, "output_bytes": 512}
+
+
+def test_compute_cost_none_when_backend_lacks_analyses():
+    assert costs.compute_cost(FakeCompiled(fail=True)) is None
+
+
+def test_extract_memoizes_via_attach():
+    fc = FakeCompiled(flops=7.0)
+    first = costs.extract(fc)
+    assert first["flops"] == 7.0
+    fc._flops = 999.0  # a recompute would see this
+    assert costs.extract(fc)["flops"] == 7.0  # memo wins
+    # a store-served dict (cache/aot.load_cached) also pins
+    other = FakeCompiled()
+    costs.attach(other, {"flops": 3.0})
+    assert costs.extract(other) == {"flops": 3.0}
+
+
+# --------------------------------------------------------------- ledger
+
+def test_ledger_sums_dispatches_and_maxes_memory():
+    led = costs.CostLedger()
+    led.record("chunkscan", {"flops": 10.0, "bytes_accessed": 100.0,
+                             "temp_bytes": 50})
+    led.record("chunkscan", {"flops": 10.0, "bytes_accessed": 100.0,
+                             "temp_bytes": 80})
+    led.record("DeviceExecutor", {"flops": 5.0, "temp_bytes": 30,
+                                  "output_bytes": 7})
+    b = led.query_block()
+    assert b["flops"] == 25.0
+    assert b["bytes_accessed"] == 200.0
+    assert b["transcendentals"] == 0.0
+    assert b["temp_bytes"] == 80          # max, not sum
+    assert b["output_bytes"] == 7
+    assert b["programs"] == {"chunkscan": 2, "DeviceExecutor": 1}
+    led.reset_query()
+    assert led.query_block() is None
+
+
+def test_ledger_disabled_records_nothing():
+    from nds_tpu.utils.config import EngineConfig
+    costs.LEDGER.reset_query()
+    try:
+        costs.configure_from(EngineConfig(
+            overrides={"obs.costs.enabled": "off"}))
+        assert not costs.enabled()
+        costs.record_program("DeviceExecutor", FakeCompiled())
+        assert costs.query_block() is None
+    finally:
+        costs.configure_from(None)
+    assert costs.enabled()
+    costs.record_program("DeviceExecutor", FakeCompiled())
+    assert costs.query_block()["programs"] == {"DeviceExecutor": 1}
+    costs.LEDGER.reset_query()
+
+
+def test_ledger_counts_costless_dispatches():
+    led = costs.CostLedger()
+    led.record("DeviceExecutor", None)  # backend without analyses
+    b = led.query_block()
+    assert b["programs"] == {"DeviceExecutor": 1}
+    assert b["flops"] == 0.0
+
+
+# ---------------------------------------------------------- cross-check
+
+def test_cross_check_in_corridor_and_drift():
+    ok = costs.cross_check({"flops": 1e6, "programs": {"x": 1}}, 1e4)
+    assert ok["ops_est"] == 1e4
+    assert ok["flops_per_op"] == 100.0
+    assert "ops_est_drift" not in ok
+    hi = costs.cross_check({"flops": 1e9, "programs": {"x": 1}}, 10.0)
+    assert hi["ops_est_drift"] is True
+    lo = costs.cross_check({"flops": 1.0, "programs": {"x": 1}}, 1e6)
+    assert lo["ops_est_drift"] is True
+    assert costs.cross_check(None, 1e4) is None
+    # absent/zero ops_est: no cross-check keys, never a drift flag
+    plain = costs.cross_check({"flops": 1e6, "programs": {"x": 1}},
+                              None)
+    assert "ops_est" not in plain and "ops_est_drift" not in plain
+
+
+# ------------------------------------------------------- platform peaks
+
+def test_platform_peaks_calibrated_overrides_builtin(tmp_path,
+                                                     monkeypatch):
+    p = tmp_path / "peaks.json"
+    p.write_text(json.dumps({"CPU": {"flops": 9e10, "mem_gbps": 12.0}}))
+    monkeypatch.setenv(costs.PEAKS_ENV, str(p))
+    peaks = costs.platform_peaks("cpu")
+    assert peaks == {"flops": 9e10, "mem_gbps": 12.0}  # file, not 5e10
+    assert costs.calibrated_mem_gbps("cpu") == 12.0
+    # rewrite -> mtime cache must pick up the new numbers
+    time.sleep(0.01)
+    p.write_text(json.dumps({"cpu": {"flops": 1e11, "mem_gbps": 30.0}}))
+    os.utime(p)
+    assert costs.platform_peaks("cpu")["mem_gbps"] == 30.0
+
+
+def test_platform_peaks_builtin_fallback_and_prefix(monkeypatch,
+                                                    tmp_path):
+    monkeypatch.setenv(costs.PEAKS_ENV,
+                       str(tmp_path / "absent.json"))
+    assert costs.platform_peaks("cpu") == {"flops": 5e10,
+                                           "mem_gbps": 25.0}
+    # longest prefix wins: a v5 lite chip must not read the v5p row
+    lite = costs.platform_peaks("TPU v5 lite")
+    assert lite["flops"] == 197e12
+    full = costs.platform_peaks("tpu v5p")
+    assert full["flops"] == 459e12
+    assert costs.platform_peaks("quantum abacus") is None
+    assert costs.platform_peaks(None) is None
+    assert costs.calibrated_mem_gbps("cpu") is None
+
+
+def test_predicted_ms_roofline(monkeypatch, tmp_path):
+    monkeypatch.setenv(costs.PEAKS_ENV, str(tmp_path / "absent.json"))
+    # cpu peaks: 5e10 flops, 25 GB/s -> flops-bound here
+    blk = {"platform": "cpu", "flops": 5e9, "bytes_accessed": 25e6}
+    assert costs.predicted_ms(blk) == pytest.approx(100.0)
+    # bytes-bound: 25e9 bytes / 25 GB/s = 1 s
+    blk = {"platform": "cpu", "flops": 1.0, "bytes_accessed": 25e9}
+    assert costs.predicted_ms(blk) == pytest.approx(1000.0)
+    assert costs.predicted_ms({"flops": 1e9}) is None  # no platform
+    assert costs.predicted_ms(None) is None
+
+
+# ---------------------------------------------------- sampler lifecycle
+
+def test_sampler_lifecycle_idempotent():
+    vals = iter(range(1000))
+    s = TelemetrySampler(interval_ms=5, capacity=64,
+                         read_fn=lambda: next(vals))
+    assert not s.running()
+    s.start()
+    s.start()  # second start: no second thread
+    assert s.running()
+    time.sleep(0.06)
+    s.stop()
+    s.stop()  # second stop: no-op
+    assert not s.running()
+    b = s.query_block()
+    assert b["samples"] >= 2
+    assert b["interval_ms"] == 5.0
+    hbm = b["hbm"]
+    assert hbm["min_bytes"] <= hbm["mean_bytes"] <= hbm["max_bytes"]
+    assert hbm["series"][0][0] == 0.0  # offsets start at the window
+
+
+def test_sampler_noop_backend_keeps_shapes_absent():
+    s = TelemetrySampler(interval_ms=5, read_fn=lambda: None)
+    s.start()
+    time.sleep(0.03)
+    s.stop()
+    assert s.query_block() is None
+    assert s.snapshot_block() is None
+    assert s.drain_counter_events() == []
+
+
+def test_sampler_ring_is_bounded_and_series_decimated():
+    s = TelemetrySampler(interval_ms=1, capacity=8,
+                         read_fn=lambda: 42)
+    for _ in range(50):
+        s.sample()
+    assert len(s._ring) == 8
+    big = TelemetrySampler(interval_ms=1, capacity=4096,
+                           read_fn=lambda: 1)
+    for _ in range(500):
+        big.sample()
+    blk = big.query_block()
+    assert blk["samples"] == 500
+    assert len(blk["hbm"]["series"]) == telemetry.SERIES_MAX_POINTS
+
+
+def test_sampler_drains_each_sample_once():
+    s = TelemetrySampler(interval_ms=1, read_fn=lambda: 7)
+    s.sample()
+    s.sample()
+    first = s.drain_counter_events()
+    assert len(first) == 2
+    assert s.drain_counter_events() == []
+    s.sample()
+    assert len(s.drain_counter_events()) == 1
+
+
+def test_sampler_reset_query_windows_the_block():
+    s = TelemetrySampler(interval_ms=1, read_fn=lambda: 9)
+    s.sample()
+    s.sample()
+    s.reset_query()
+    assert s.query_block() is None  # old samples fall out of window
+    s.sample()
+    assert s.query_block()["samples"] == 1
+
+
+def test_sampler_locksan_clean_under_hammer():
+    before = locksan.inversion_count()
+    s = TelemetrySampler(interval_ms=1, capacity=32,
+                         read_fn=lambda: 1)
+
+    def hammer():
+        for _ in range(50):
+            s.start()
+            s.sample()
+            s.query_block()
+            s.drain_counter_events()
+            s.snapshot_block()
+            s.reset_query()
+            s.stop()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s.stop()
+    assert not s.running()
+    assert locksan.inversion_count() == before
+
+
+def test_configured_interval_env_wins(monkeypatch):
+    monkeypatch.setenv(telemetry.TELEMETRY_ENV, "off")
+    assert telemetry.configured_interval_ms(None) is None
+    monkeypatch.setenv(telemetry.TELEMETRY_ENV, "125")
+    assert telemetry.configured_interval_ms(None) == 125.0
+    monkeypatch.delenv(telemetry.TELEMETRY_ENV)
+    assert telemetry.configured_interval_ms(None) == float(
+        telemetry.DEFAULT_INTERVAL_MS)
+
+
+# ------------------------------------------------------ cost drift gate
+
+def _rows(flops, nbytes):
+    return {"query1": {"query": "query1", "status": "Completed",
+                       "cost": {"flops": flops,
+                                "bytes_accessed": nbytes,
+                                "transcendentals": 0.0,
+                                "programs": {"DeviceExecutor": 1}}}}
+
+
+def test_cost_changes_flags_drift_both_directions():
+    from nds_tpu.obs import analyze
+    base = _rows(1e9, 1e8)
+    up = analyze.cost_changes(base, _rows(2e9, 1e8), pct=25.0)
+    assert up and up[0]["drifted"] is True
+    down = analyze.cost_changes(base, _rows(4e8, 1e8), pct=25.0)
+    assert down and down[0]["drifted"] is True
+    flat = analyze.cost_changes(base, _rows(1.1e9, 1e8), pct=25.0)
+    assert not any(e.get("drifted") for e in flat)
+
+
+def test_cost_changes_respects_abs_floor():
+    from nds_tpu.obs import analyze
+    # 10x but under the 1e6-flop floor: noise-sized programs never gate
+    tiny = analyze.cost_changes(_rows(100.0, 10.0),
+                                _rows(1000.0, 10.0), pct=25.0)
+    assert not any(e.get("drifted") for e in tiny)
+
+
+def test_cost_changes_missing_side_never_fails():
+    from nds_tpu.obs import analyze
+    base = _rows(1e9, 1e8)
+    cur = {"query1": {"query": "query1",
+                      "status": "Completed"}}  # cost dropped
+    out = analyze.cost_changes(base, cur, pct=25.0)
+    assert out and out[0].get("missing")
+    assert not any(e.get("drifted") for e in out)
+
+
+def test_parse_gate_accepts_cost_pct():
+    from nds_tpu.obs import analyze
+    g = analyze.parse_gate("pct=5,abs_ms=10,cost_pct=40")
+    assert g == {"pct": 5.0, "abs_ms": 10.0, "cost_pct": 40.0}
+    assert analyze.parse_gate(None)["cost_pct"] == 25.0
+
+
+def test_diff_gates_on_cost_drift_despite_identical_walls(tmp_path):
+    """Compiler flops doubling on an unchanged query fails the gate
+    even when wall-clock is byte-identical — the whole point of the
+    COST-DRIFT lane."""
+    from nds_tpu.obs import analyze
+    cur_dir = tmp_path / "cur"
+    shutil.copytree(RUN_COST, cur_dir)
+    name = "fixture-query1-1754100000000.json"
+    with open(cur_dir / name) as f:
+        doc = json.load(f)
+    doc["cost"]["flops"] *= 2.0
+    doc["cost"]["flops_per_op"] *= 2.0
+    with open(cur_dir / name, "w") as f:
+        json.dump(doc, f)
+    base = analyze.analyze_run(RUN_COST, with_trace=False)
+    cur = analyze.analyze_run(str(cur_dir), with_trace=False)
+    d = analyze.diff_runs(base, cur)
+    assert not d["passed"]
+    drifted = [e for e in d["cost_changes"] if e.get("drifted")]
+    assert [e["query"] for e in drifted] == ["query1"]
+    assert "COST-DRIFT" in analyze.format_diff(d)
+    # identity: the same cost blocks pass, and a looser pct waives it
+    ident = analyze.diff_runs(base, base)
+    assert ident["passed"]
+    loose = analyze.diff_runs(base, cur, cost_pct=150.0)
+    assert loose["passed"]
+
+
+def test_analyze_rows_carry_predicted_and_telemetry():
+    from nds_tpu.obs import analyze
+    a = analyze.analyze_run(RUN_COST, with_trace=False)
+    rows = {r["query"]: r for r in a["queries"]}
+    q1 = rows["query1"]
+    assert q1["cost"]["flops"] == 2.4e9
+    assert q1["predicted_ms"] > 0
+    assert 0 < q1["achieved_frac"] < 1
+    assert q1["telemetry_samples"] == 5
+    assert q1["hbm_max_bytes"] == 2097152
+    table = analyze.format_attribution(a)
+    assert "predicted" in table and "achieved" in table
+    html = analyze.render_html(a)
+    assert "predicted" in html
+
+
+# ------------------------------------------------------------- banking
+
+def test_bank_record_provenance_and_cost_totals():
+    import ndsreport
+    record, err = ndsreport.bank_record(RUN_COST)
+    assert err == ""
+    assert record["metric"] == "power_total"
+    assert record["value"] == pytest.approx(3.5)
+    assert record["queries_completed"] == 3
+    prov = record["provenance"]
+    assert prov["platform"] == "tpu v4"  # the cost blocks' stamp
+    assert prov["engine_version"] == "jax-0.4.36"
+    assert prov["config_digest"] and prov["code_epoch"]
+    totals = record["cost_totals"]
+    assert totals["flops"] == pytest.approx(12.5e9)
+    assert totals["queries_with_cost"] == 3
+
+
+def test_bank_refuses_stale_dir_with_exit_4(tmp_path, capsys):
+    import ndsreport
+    run = tmp_path / "run"
+    shutil.copytree(RUN_COST, run)
+    name = "fixture-query2-1754100000001.json"
+    with open(run / name) as f:
+        doc = json.load(f)
+    doc["stale_device_times"] = True
+    with open(run / name, "w") as f:
+        json.dump(doc, f)
+    out = tmp_path / "record.json"
+    rc = ndsreport.main(["bank", str(run), "--out", str(out)])
+    assert rc == ndsreport.EXIT_STALE_BANK == 4
+    assert "BANK REFUSED" in capsys.readouterr().out
+    assert not out.exists()
+
+
+def test_bank_refuses_empty_dir_with_exit_5(tmp_path):
+    import ndsreport
+    (tmp_path / "empty").mkdir()
+    rc = ndsreport.main(["bank", str(tmp_path / "empty")])
+    assert rc == ndsreport.EXIT_NO_METRIC == 5

@@ -210,9 +210,10 @@ class TestDiffGate:
         assert "roofline" in text and "1.25@40%" in text
 
     def test_parse_gate(self):
-        assert analyze.parse_gate(None) == {"pct": 10.0, "abs_ms": 50.0}
+        assert analyze.parse_gate(None) == {
+            "pct": 10.0, "abs_ms": 50.0, "cost_pct": 25.0}
         assert analyze.parse_gate("pct=5,abs_ms=1") == {
-            "pct": 5.0, "abs_ms": 1.0}
+            "pct": 5.0, "abs_ms": 1.0, "cost_pct": 25.0}
         with pytest.raises(ValueError):
             analyze.parse_gate("bogus=1")
 

@@ -470,9 +470,11 @@ def run_watchdog_stream(workdir: str) -> int:
     streams.generate_query_streams(sdir, 4, templates=[96, 7])
     paths = [os.path.join(sdir, f"query_{i}.sql") for i in range(4)]
     # generous budget: 4 concurrent children on a loaded CI box can see
-    # multi-second gaps between legitimate beats; the injected hang is
-    # 120 s, so detection headroom costs nothing
-    stall_s = 10.0
+    # multi-second gaps between legitimate beats (a single big-table
+    # parse is one C call — no beat can land mid-parse, and on a 1-core
+    # box four children serialize it to 4x the isolated time); the
+    # injected hang is 120 s, so detection headroom costs nothing
+    stall_s = 30.0
     before = obs_metrics.snapshot()
     saved = os.environ.get(faults.FAULTS_ENV)
     # the schedule reaches the CHILDREN via the environment; the scope

@@ -7,10 +7,13 @@ array-wrapping, ndsreport, or anything parsing the files directly —
 never meet a malformed record.
 
 Trace event schema (one event per line):
-  name: non-empty str      ph:  "X" (complete event)
+  name: non-empty str      ph:  "X" (complete) or "C" (counter)
   cat:  str                ts:  number >= 0 (microseconds)
-  dur:  number >= 0        pid: int        tid: int
+  pid:  int                tid: int
   args: object (optional)
+  "X" events additionally require dur: number >= 0; "C" counter
+  events (obs/trace.counter_event — the device-memory lanes) carry no
+  dur and require a non-empty all-numeric args object instead.
 
 BenchReport summary schema (``--summary``, README "Observability"):
   query/queryStatus/queryTimes/startTime/env required; optional blocks
@@ -27,9 +30,14 @@ BenchReport summary schema (``--summary``, README "Observability"):
   README "Plan cache"), the kernel-use block kernels (kernel
   name -> positive use count — engine/kernels.py; README "Kernels &
   roofline"), the XLA-capture block profile (path + trigger from the
-  obs/profile.py trigger vocabulary, optional bytes), and the
+  obs/profile.py trigger vocabulary, optional bytes), the
   flight-recorder pointer flight (path + optional reason/entries —
-  obs/fleet.py; README "Fleet & profiling").
+  obs/fleet.py; README "Fleet & profiling"), the compiler-cost block
+  cost (flops/bytes_accessed/transcendentals sums + a positive
+  programs census; optional memory maxima / platform / ops_est
+  cross-check — obs/costs.py; README "Cost ledger & telemetry"), and
+  the device-memory time-series block telemetry (samples/interval_ms
+  + the hbm min/max/mean/series summary — obs/telemetry.py).
 
 Exit 0 when every record validates; prints each offense otherwise.
 Run by tests/test_observability.py and tools/static_checks.py as a
@@ -46,14 +54,16 @@ REQUIRED = {
     "cat": str,
     "ph": str,
     "ts": (int, float),
-    "dur": (int, float),
     "pid": int,
     "tid": int,
 }
 
 
 def validate_event(obj: object) -> list[str]:
-    """Schema errors for one parsed event ([] = valid)."""
+    """Schema errors for one parsed event ([] = valid). Two phases
+    are legal: "X" complete events (non-negative dur required) and
+    "C" counter events (no dur; a non-empty all-numeric args object
+    is the payload — obs/trace.counter_event)."""
     errs = []
     if not isinstance(obj, dict):
         return [f"event is {type(obj).__name__}, not an object"]
@@ -65,12 +75,22 @@ def validate_event(obj: object) -> list[str]:
     if not errs:
         if not obj["name"]:
             errs.append("empty name")
-        if obj["ph"] != "X":
-            errs.append(f"ph {obj['ph']!r} != 'X'")
         if obj["ts"] < 0:
             errs.append("negative ts")
-        if obj["dur"] < 0:
-            errs.append("negative dur")
+        if obj["ph"] == "X":
+            dur = obj.get("dur")
+            if not _num(dur):
+                errs.append(f"bad dur {dur!r}")
+            elif dur < 0:
+                errs.append("negative dur")
+        elif obj["ph"] == "C":
+            cargs = obj.get("args")
+            if (not isinstance(cargs, dict) or not cargs
+                    or any(not _num(v) for v in cargs.values())):
+                errs.append(f"counter event needs non-empty numeric "
+                            f"args, got {cargs!r}")
+        else:
+            errs.append(f"ph {obj['ph']!r} not in ('X', 'C')")
     if "args" in obj and not isinstance(obj.get("args"), dict):
         errs.append("args is not an object")
     return errs
@@ -306,6 +326,62 @@ def validate_summary(obj: object) -> list[str]:
                     or flight["entries"] < 0):
                 errs.append(f"bad flight.entries "
                             f"{flight['entries']!r}")
+    # compiler-cost block (obs/costs.py; README "Cost ledger &
+    # telemetry"): the three per-dispatch sums always travel as
+    # non-negative numbers next to a positive programs census;
+    # memory maxima / platform / ops_est cross-check are optional
+    cost = obj.get("cost")
+    if cost is not None:
+        progs = cost.get("programs") if isinstance(cost, dict) else None
+        if (not isinstance(cost, dict)
+                or not isinstance(progs, dict) or not progs
+                or any(not isinstance(k, str) or not k
+                       or not isinstance(v, int)
+                       or isinstance(v, bool) or v <= 0
+                       for k, v in progs.items())
+                or any(not _num(cost.get(k)) or cost[k] < 0
+                       for k in ("flops", "bytes_accessed",
+                                 "transcendentals"))):
+            errs.append(f"bad cost block {cost!r}")
+        else:
+            for k in ("temp_bytes", "argument_bytes", "output_bytes",
+                      "ops_est", "flops_per_op"):
+                if k in cost and (not _num(cost[k]) or cost[k] < 0):
+                    errs.append(f"bad cost.{k} {cost[k]!r}")
+            if "platform" in cost and (
+                    not isinstance(cost["platform"], str)
+                    or not cost["platform"]):
+                errs.append(f"bad cost.platform "
+                            f"{cost.get('platform')!r}")
+            if "ops_est_drift" in cost and \
+                    cost["ops_est_drift"] is not True:
+                errs.append(f"bad cost.ops_est_drift "
+                            f"{cost['ops_est_drift']!r}")
+    # device-memory time-series block (obs/telemetry.py): sample
+    # count + interval, with the hbm min/max/mean and the decimated
+    # [t_offset_ms, bytes] series
+    tel = obj.get("telemetry")
+    if tel is not None:
+        if (not isinstance(tel, dict)
+                or not isinstance(tel.get("samples"), int)
+                or isinstance(tel.get("samples"), bool)
+                or tel["samples"] <= 0
+                or not _num(tel.get("interval_ms"))
+                or tel["interval_ms"] <= 0):
+            errs.append(f"bad telemetry block {tel!r}")
+        else:
+            hbm = tel.get("hbm")
+            if hbm is not None and (
+                    not isinstance(hbm, dict)
+                    or any(not _num(hbm.get(k)) or hbm[k] < 0
+                           for k in ("min_bytes", "max_bytes",
+                                     "mean_bytes"))
+                    or not isinstance(hbm.get("series"), list)
+                    or not hbm["series"]
+                    or any(not isinstance(p, list) or len(p) != 2
+                           or not _num(p[0]) or not _num(p[1])
+                           for p in hbm["series"])):
+                errs.append(f"bad telemetry.hbm block {hbm!r}")
     return errs
 
 

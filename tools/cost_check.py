@@ -1,0 +1,242 @@
+"""Compiler-cost-ledger gate: every program billed, cold and warm.
+
+tier-1 (via tools/static_checks.py) proves the cost ledger
+(nds_tpu/obs/costs.py; README "Cost ledger & telemetry") end-to-end on
+the CPU backend with a 3-query NDS-H power stream (q1/q3/q6) against a
+fresh AOT plan-cache directory:
+
+1. **cold compile** — every query's BenchReport carries a ``cost``
+   block with ``flops > 0`` and a non-empty ``programs`` census, the
+   run actually compiled (``compiles_total > 0``), and the plan cache
+   recorded misses — the dispatch-site hooks fire on freshly-built
+   executables.
+2. **warm cache hit** — the SAME stream against the SAME cache dir:
+   zero compiles (every program loads from the store), plan-cache hits
+   recorded, and STILL ``flops > 0`` on every query — the cost dicts
+   ride the cache payload/manifest (``cache/aot.py`` persists them),
+   so warm runs bill compiler-truth numbers they never recomputed.
+3. **attribution invariant** — categories + residual == wall-clock per
+   query over the warm run (the new cost/telemetry columns must not
+   perturb ndsreport's accounting), and on this no-stats backend the
+   summaries carry NO ``telemetry`` block (the sampler's graceful
+   no-op keeps pre-telemetry shapes byte-identical).
+4. **bank refusal** — ``ndsreport bank`` mints a provenance-stamped
+   record with positive ``cost_totals`` from the warm dir, and REFUSES
+   (exit 4) a copy whose summary is marked ``stale_device_times``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCALE = 0.01
+TEMPLATES = (1, 3, 6)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _write_stream(path: str) -> None:
+    from nds_tpu.nds_h import streams as hstreams
+    parts = [f"-- Template file: {qn}\n\n"
+             f"{hstreams.render_query(qn, None, stream=0)}\n"
+             for qn in TEMPLATES]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(parts))
+
+
+def _summaries(jsons: str) -> dict:
+    from nds_tpu.obs import analyze
+    out = {}
+    for name in os.listdir(jsons):
+        if not analyze.is_report_basename(name):
+            continue
+        with open(os.path.join(jsons, name)) as f:
+            s = json.load(f)
+        if isinstance(s, dict) and "query" in s and "queryStatus" in s:
+            out[s["query"]] = s
+    return out
+
+
+def _run_stream(workdir: str, raw: str, stream: str, label: str,
+                cache_dir: str) -> "dict | None":
+    from nds_tpu.nds_h.power import SUITE
+    from nds_tpu.utils import power_core
+    from nds_tpu.utils.config import EngineConfig
+    jsons = os.path.join(workdir, f"json_{label}")
+    out = os.path.join(workdir, f"rows_{label}")
+    cfg = EngineConfig(overrides={
+        "engine.backend": "tpu",  # tensorized engine on local CPU jax
+        "cache.dir": cache_dir,
+    })
+    failures = power_core.run_query_stream(
+        SUITE, raw, stream, os.path.join(workdir, f"{label}.csv"),
+        config=cfg, input_format="raw", json_summary_folder=jsons,
+        output_prefix=out)
+    if failures:
+        print(f"FAIL: {failures} query failure(s) in the {label} run")
+        return None
+    return {"summaries": _summaries(jsons), "jsons": jsons}
+
+
+def _compiles(summaries: dict) -> int:
+    total = 0
+    for s in summaries.values():
+        c = (s.get("metrics") or {}).get("counters", {})
+        total += int(c.get("compiles_total", 0)
+                     + c.get("recompiles_total", 0))
+    return total
+
+
+def _cache_counts(summaries: dict) -> "tuple[int, int]":
+    hits = misses = 0
+    for s in summaries.values():
+        cache = s.get("cache") or {}
+        hits += int(cache.get("hits", 0))
+        misses += int(cache.get("misses", 0))
+    return hits, misses
+
+
+def _check_costs(summaries: dict, label: str) -> "str | None":
+    """Every query billed compiler flops through a non-empty program
+    census, or the reason it didn't."""
+    want = {f"query{qn}" for qn in TEMPLATES}
+    if set(summaries) != want:
+        return f"{label}: summaries for {sorted(summaries)}, not " \
+               f"{sorted(want)}"
+    for q in sorted(want):
+        cost = summaries[q].get("cost")
+        if not isinstance(cost, dict):
+            return f"{label}: {q} has no cost block"
+        if not (isinstance(cost.get("flops"), (int, float))
+                and cost["flops"] > 0):
+            return f"{label}: {q} cost.flops = {cost.get('flops')!r}"
+        progs = cost.get("programs")
+        if not isinstance(progs, dict) or not progs:
+            return f"{label}: {q} cost.programs = {progs!r}"
+    return None
+
+
+def run_cold_warm(workdir: str) -> "tuple[int, dict | None]":
+    from nds_tpu.nds_h import gen_data
+    raw = os.path.join(workdir, "raw")
+    stream = os.path.join(workdir, "streams", "stream.sql")
+    cache_dir = os.path.join(workdir, "plan_cache")
+    gen_data.generate_data_local(SCALE, 2, raw, workers=2)
+    _write_stream(stream)
+    cold = _run_stream(workdir, raw, stream, "cold", cache_dir)
+    if cold is None:
+        return 1, None
+    bad = _check_costs(cold["summaries"], "cold")
+    if bad:
+        return _fail(bad), None
+    cc = _compiles(cold["summaries"])
+    if cc <= 0:
+        return _fail(f"cold run compiled nothing (compiles={cc}) — "
+                     f"this gate proved nothing"), None
+    _ch, cm = _cache_counts(cold["summaries"])
+    if cm <= 0:
+        return _fail("cold run recorded no plan-cache misses — is the "
+                     "cache dir wired?"), None
+    warm = _run_stream(workdir, raw, stream, "warm", cache_dir)
+    if warm is None:
+        return 1, None
+    bad = _check_costs(warm["summaries"], "warm")
+    if bad:
+        return _fail(bad), None
+    wc = _compiles(warm["summaries"])
+    if wc != 0:
+        return _fail(f"warm run compiled {wc} program(s) — cache "
+                     f"misses mean the cost blocks above prove "
+                     f"nothing about the manifest path"), None
+    wh, _wm = _cache_counts(warm["summaries"])
+    if wh <= 0:
+        return _fail("warm run recorded no plan-cache hits"), None
+    print(f"OK: cold/warm — flops billed on all {len(TEMPLATES)} "
+          f"queries both ways ({cc} cold compile(s), 0 warm, "
+          f"{wh} warm cache hit(s))")
+    return 0, warm
+
+
+def run_attribution(warm: dict) -> int:
+    from nds_tpu.obs import analyze
+    a = analyze.analyze_run(warm["jsons"], with_trace=False)
+    for row in a["queries"]:
+        total = sum(row["categories"].values()) + row["residual_ms"]
+        if abs(total - row["wall_ms"]) > 1e-6:
+            return _fail(f"{row['query']}: categories+residual "
+                         f"{total:.3f} != wall {row['wall_ms']:.3f}")
+    # CPU has no allocator stats: the sampler must leave no trace
+    with_tel = [q for q, s in warm["summaries"].items()
+                if "telemetry" in s]
+    if with_tel:
+        return _fail(f"no-stats backend grew telemetry blocks on "
+                     f"{with_tel}")
+    print("OK: attribution — invariant holds with cost blocks, "
+          "telemetry silent on no-stats backend")
+    return 0
+
+
+def run_bank(workdir: str, warm: dict) -> int:
+    import ndsreport
+    record, err = ndsreport.bank_record(warm["jsons"])
+    if record is None:
+        return _fail(f"bank refused a clean run dir: {err}")
+    totals = record.get("cost_totals") or {}
+    if not totals.get("flops", 0) > 0:
+        return _fail(f"banked record has no positive cost_totals "
+                     f"({totals!r})")
+    stale_dir = os.path.join(workdir, "json_stale")
+    shutil.copytree(warm["jsons"], stale_dir)
+    name = sorted(n for n in os.listdir(stale_dir)
+                  if n.endswith(".json") and "query" in n)[0]
+    spath = os.path.join(stale_dir, name)
+    with open(spath) as f:
+        doc = json.load(f)
+    doc["stale_device_times"] = True
+    with open(spath, "w") as f:
+        json.dump(doc, f)
+    rc = ndsreport.main(["bank", stale_dir,
+                         "--out", os.path.join(workdir, "nope.json")])
+    if rc != ndsreport.EXIT_STALE_BANK:
+        return _fail(f"bank exited {rc} (want "
+                     f"{ndsreport.EXIT_STALE_BANK}) on a stale-marked "
+                     f"dir")
+    if os.path.exists(os.path.join(workdir, "nope.json")):
+        return _fail("bank wrote a record while refusing")
+    print("OK: bank — provenance-stamped record with cost totals; "
+          "stale-marked dir refused with exit 4")
+    return 0
+
+
+def main(argv=None) -> int:
+    del argv
+    with tempfile.TemporaryDirectory(prefix="nds_cost_") as wd:
+        print("-- cost_check: cold/warm ledger --")
+        rc, warm = run_cold_warm(wd)
+        if rc:
+            return rc
+        print("-- cost_check: attribution --")
+        rc = run_attribution(warm)
+        if rc:
+            return rc
+        print("-- cost_check: bank --")
+        rc = run_bank(wd, warm)
+        if rc:
+            return rc
+    print("COST CHECK OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,13 @@ Exit 0 when every lane ran both paths and produced matching results
 (each lane cross-checks new vs old output before timing — a
 microbenchmark that races a wrong answer is worse than none); exit 1
 otherwise.
+
+``--calibrate`` skips the lanes and instead measures THIS backend's
+peak dense FLOP/s (f32 matmul) and memory bandwidth (elementwise
+stream), merge-writing them into ``configs/platform_peaks.json`` keyed
+by lowercased device_kind — the per-platform constants ``ndsreport
+analyze``'s predicted-time/roofline columns and the executors' scan
+roofline consult ahead of the datasheet builtins.
 """
 
 from __future__ import annotations
@@ -469,6 +476,40 @@ LANES = {
 LOOP_LANES = {"pipe.prefetch1", "pipe.prefetch2"}
 
 
+def calibrate(smoke: bool = False,
+              out_path: "str | None" = None) -> dict:
+    """Measure THIS backend's peak dense FLOP/s (f32 matmul, the MXU
+    saturator) and memory bandwidth (elementwise read+write stream),
+    and merge them into ``configs/platform_peaks.json`` keyed by
+    lowercased device_kind — the measured constants analyze's
+    predicted-time model and the executors' roofline denominator
+    consult ahead of the datasheet builtins (obs/costs.platform_peaks,
+    device_exec._peak_mem_gbps)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nds_tpu.obs import costs as obs_costs
+    n = 512 if smoke else 2048
+    reps = 2 if smoke else 5
+    a = jnp.ones((n, n), jnp.float32)
+    mm = _jit(lambda x: x @ x)
+    mm_ms = _best_ms(mm, (a,), reps)
+    flops = (2.0 * n ** 3) / (mm_ms / 1000.0)
+    m = (1 << 20) if smoke else (1 << 26)   # f32 elements streamed
+    v = jnp.ones((m,), jnp.float32)
+    stream = _jit(lambda x: x + 1.0)        # reads + writes the array
+    st_ms = _best_ms(stream, (v,), reps)
+    gbps = (2.0 * v.nbytes) / (st_ms / 1000.0) / 1e9
+    kind = str(jax.devices()[0].device_kind).lower()
+    path = out_path or obs_costs.peaks_path()
+    peaks = dict(obs_costs.calibrated_peaks())  # merge, don't clobber
+    peaks[kind] = {"flops": round(flops, 3), "mem_gbps": round(gbps, 3)}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from nds_tpu.io.integrity import write_json_atomic
+    write_json_atomic(path, peaks)
+    return {"device_kind": kind, "path": path, **peaks[kind]}
+
+
 def run(sizes, repeat: int, lanes=None) -> dict:
     import jax
     rng = np.random.default_rng(20260803)
@@ -521,7 +562,19 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes, 1 repeat: prove both paths run "
                          "(the static_checks tier-1 wiring)")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="measure this backend's peak FLOPs/bandwidth "
+                         "and write configs/platform_peaks.json "
+                         "(consumed by ndsreport analyze's "
+                         "predicted-time model); skips the lane runs")
     args = ap.parse_args(argv)
+    if args.calibrate:
+        cal = calibrate(smoke=args.smoke, out_path=args.out)
+        print(json.dumps(cal, indent=2))
+        print(f"CALIBRATED {cal['device_kind']}: "
+              f"{cal['flops'] / 1e12:.3f} TFLOP/s, "
+              f"{cal['mem_gbps']:.1f} GB/s -> {cal['path']}")
+        return 0
     sizes = (SMOKE_SIZES if args.smoke and not args.sizes
              else tuple(int(s) for s in
                         (args.sizes or

@@ -10,11 +10,22 @@ Chrome-trace ``*.jsonl``):
       ``analysis.json`` + self-contained ``report.html`` to --out
       (default: RUN_DIR).
 
-  python tools/ndsreport.py diff BASE_DIR CUR_DIR [--gate pct=10,abs_ms=50]
+  python tools/ndsreport.py diff BASE_DIR CUR_DIR [--gate pct=10,abs_ms=50,cost_pct=25]
       Query-by-query steady-state comparison with a noise-aware
-      regression gate. Exit 0 when the gate passes, 1 on regression /
+      regression gate (plus the COST-DRIFT gate over compiler
+      flops/bytes). Exit 0 when the gate passes, 1 on regression /
       removed query / newly-failed query — so CI and bench rounds can
       gate on it directly.
+
+  python tools/ndsreport.py bank RUN_DIR [--out PATH]
+      Mint a BENCH-record-shaped JSON mechanically from a run dir,
+      stamped with provenance (platform, engine version, config
+      digest, code_epoch, compiler cost totals) — BENCH_r06 is one
+      command, not hand-rolled numbers (the r04/r05 rot class).
+      REFUSES loudly when any summary carries ``stale_device_times``:
+      exit 4 (the bench.py EXIT_STALE_METRIC contract — a banked
+      number from banked inputs is exactly the rot this exists to
+      stop); exit 5 when the dir has no completed measurements.
 
 ``self_check()`` is the tier-1 entry (tools/static_checks.py section
 6): analyze + diff over the committed fixture run-dirs under
@@ -32,6 +43,106 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from nds_tpu.obs import analyze  # noqa: E402
+
+# bank refusal exit codes — the bench.py contract (EXIT_STALE_METRIC /
+# EXIT_NO_METRIC): a banked number must be a LOUD failure when its
+# inputs were stale or absent, never a quietly-zero record
+EXIT_STALE_BANK = 4
+EXIT_NO_METRIC = 5
+
+# engineConf keys that describe the live process, not the bench
+# configuration — excluded from the banked config digest so the same
+# config banks the same digest across hosts/device counts
+_VOLATILE_CONF_KEYS = ("backend", "device_count", "devices")
+
+
+def bank_record(run_dir: str) -> "tuple[dict | None, str]":
+    """(record, error) for a run dir — record is None exactly when the
+    dir must not bank (the error says why). Everything in the record
+    is derived mechanically from the summaries ALREADY on disk: no
+    live jax calls (the utils/report.py dead-tunnel rule — banking a
+    finished run must work from any host)."""
+    import time
+
+    from nds_tpu.cache.fingerprint import code_epoch
+    from nds_tpu.resilience.journal import config_digest
+    try:
+        a = analyze.analyze_run(run_dir, with_trace=False)
+    except ValueError as exc:
+        return None, str(exc)
+    if a.get("stale_device_times"):
+        names = ", ".join(a["stale_device_times"])
+        return None, (f"run dir carries banked/stale device times "
+                      f"({names}) — refusing to mint a BENCH record "
+                      f"from numbers nobody measured this run")
+    rows = [r for r in a["queries"] if r["status"] == "Completed"]
+    if not rows:
+        return None, "no completed query summaries to bank"
+    summaries = analyze.load_summaries(run_dir)
+    env = (summaries[0].get("env") or {}) if summaries else {}
+    conf = {k: v for k, v in (env.get("engineConf") or {}).items()
+            if k not in _VOLATILE_CONF_KEYS}
+    # platform: the cost blocks' device-kind stamp when the run
+    # carried the cost ledger, else the recorded backend
+    platforms = sorted({r["cost"]["platform"] for r in rows
+                       if isinstance(r.get("cost"), dict)
+                       and r["cost"].get("platform")})
+    provenance = {
+        "platform": (platforms[0] if len(platforms) == 1
+                     else (env.get("engineConf") or {}).get(
+                         "backend", "unknown")),
+        "engine_version": env.get("engineVersion") or "unknown",
+        "config_digest": config_digest(conf),
+        "code_epoch": code_epoch(),
+        "banked_at": int(time.time()),
+        "run_dir": a["run_dir"],
+    }
+    totals: dict = {}
+    programs = 0
+    with_cost = 0
+    for r in rows:
+        cost = r.get("cost")
+        if not isinstance(cost, dict):
+            continue
+        with_cost += 1
+        for k in ("flops", "bytes_accessed", "transcendentals"):
+            v = cost.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                totals[k] = totals.get(k, 0.0) + float(v)
+        programs += sum(int(n) for n in
+                        (cost.get("programs") or {}).values())
+    record = {
+        "metric": "power_total",
+        "value": round(sum(r["wall_ms"] for r in rows) / 1000.0, 4),
+        "unit": "s",
+        "queries_completed": len(rows),
+        "queries_total": len(a["queries"]),
+        "per_query": {r["query"]: round(r["wall_ms"] / 1000.0, 4)
+                      for r in rows},
+        "provenance": provenance,
+    }
+    if with_cost:
+        record["cost_totals"] = {**{k: totals[k] for k in sorted(totals)},
+                                 "programs": programs,
+                                 "queries_with_cost": with_cost}
+    if a.get("failed"):
+        record["failed"] = list(a["failed"])
+    return record, ""
+
+
+def cmd_bank(args) -> int:
+    import json
+    record, err = bank_record(args.run_dir)
+    if record is None:
+        stale = "stale" in err
+        print(f"BANK REFUSED: {err}")
+        return EXIT_STALE_BANK if stale else EXIT_NO_METRIC
+    out = args.out or os.path.join(args.run_dir, "bench_record.json")
+    from nds_tpu.io.integrity import write_json_atomic
+    write_json_atomic(out, record)
+    print(json.dumps(record))
+    print(f"wrote {out}")
+    return 0
 
 
 def cmd_analyze(args) -> int:
@@ -134,16 +245,25 @@ def main(argv: list[str] | None = None) -> int:
     pd.add_argument("base_dir")
     pd.add_argument("cur_dir")
     pd.add_argument("--gate", default=None,
-                    help="thresholds, e.g. pct=10,abs_ms=50")
+                    help="thresholds, e.g. pct=10,abs_ms=50,"
+                         "cost_pct=25")
     pd.add_argument("--out",
                     help="also write analysis.json/report.html with "
                          "the diff embedded")
+    pb = sub.add_parser(
+        "bank", help="mint a provenance-stamped BENCH record")
+    pb.add_argument("run_dir")
+    pb.add_argument("--out",
+                    help="record path (default: "
+                         "RUN_DIR/bench_record.json)")
     sub.add_parser("self-check", help="fixture-based CI self-check")
     args = p.parse_args(argv)
     if args.cmd == "analyze":
         return cmd_analyze(args)
     if args.cmd == "diff":
         return cmd_diff(args)
+    if args.cmd == "bank":
+        return cmd_bank(args)
     return self_check()
 
 

@@ -39,7 +39,7 @@ the one to run locally before pushing:
                         the load fast with CorruptArtifact, zero
                         retries) (tools/chaos_check.py)
   6. ndsreport          run-analysis self-check over the committed
-                        fixture run-dirs (tests/fixtures/run_a|b):
+                        fixture run-dirs (tests/fixtures/run_*):
                         attribution sums to wall-clock, the regression
                         pair fails the gate, the identity diff passes,
                         and every fixture BenchReport validates against
@@ -84,6 +84,17 @@ the one to run locally before pushing:
                         per-column encoding specs and its mode-change
                         invalidation (nds_tpu/columnar/; README
                         "Compressed columnar store")
+ 10c. cost             compiler-cost-ledger gate (tools/cost_check.py):
+                        a 3-query NDS-H power stream against a fresh
+                        plan-cache dir runs cold then warm — every
+                        query's BenchReport cost block carries
+                        flops > 0 on the cold compile AND on the warm
+                        cache hit (zero compiles: the cost dicts ride
+                        the AOT manifest), categories+residual ==
+                        wall-clock stays intact, the no-stats CPU
+                        backend grows no telemetry block, and
+                        ndsreport bank mints a provenance-stamped
+                        record yet refuses (exit 4) a stale-marked dir
  10b. pipeline          pipelined-execution gate
                         (tools/pipeline_check.py): a 3-query NDS-H
                         power stream FORCED onto the chunked placement
@@ -148,6 +159,7 @@ import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
 import check_trace_schema  # noqa: E402
 import compress_check  # noqa: E402
+import cost_check  # noqa: E402
 import fleet_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
@@ -278,6 +290,7 @@ def main() -> int:
         ("soak", lambda: soak_check.main([])),
         ("compress", lambda: compress_check.main([])),
         ("pipeline", lambda: pipeline_check.main([])),
+        ("cost", lambda: cost_check.main([])),
         ("serve", lambda: serve_check.main([])),
         ("locksan", run_locksan_check),
     ]
