@@ -1,0 +1,523 @@
+"""ndsjit rules: the JAX-specific recompile & transfer hazard classes.
+
+The engine's serving claim is "0 compiles warm" (README "Plan cache")
+and its perf claim is that dispatch never hides a host<->device sync
+inside the hot path. Both die silently: a traced value leaking into
+Python control flow retraces per distinct value, a closure capture the
+plan fingerprint doesn't cover mints unbounded cache entries, an
+``.item()`` in dispatch code stalls the pipeline, and a bare Python
+literal at a jit boundary weak-types into a fresh cache key. This
+module encodes each as an ast check over ``nds_tpu/`` (driven by
+``tools/ndsjit.py``; the runtime witness is ``analysis/jitsan.py``):
+
+- NDSJ301 traced-leak       ``if``/``while``/``assert`` on a value
+                            DERIVED from jnp/lax ops inside a traced
+                            function (one decorated/wrapped with
+                            ``jax.jit``/``donate_jit`` or built for
+                            the AOT cache): each branch on a traced
+                            value is a TracerBoolConversionError at
+                            trace time or — via static args — a
+                            retrace per distinct value. Branch on host
+                            config instead, or ``lax.cond``/``where``.
+- NDSJ302 fingerprint-blind-capture
+                            a traced builder in ``engine/`` /
+                            ``parallel/`` closes over an enclosing
+                            function's LOCAL variable that the plan
+                            fingerprint never folds in (not mentioned
+                            in a ``try_fingerprint``/
+                            ``_fingerprint_parts``/``fingerprint``
+                            site in the same file): two queries
+                            differing only in that capture would
+                            collide on one cache entry — or mint
+                            unbounded ones. Fold it into ``parts`` (or
+                            waive with why it cannot vary per query).
+- NDSJ303 implicit-transfer ``float()``/``int()``/``bool()``/
+                            ``np.asarray()``/``.item()``/``.tolist()``
+                            on a device-derived value in ``engine/`` /
+                            ``serve/`` / ``parallel/`` dispatch code:
+                            each is a blocking device->host sync the
+                            timing bills never see. Sync at sanctioned
+                            read-back points via ``jax.device_get``
+                            (which batches and is attributed), or
+                            waive the site as a sanctioned sync.
+                            In ``serve/``, additionally flags a
+                            blocking ``block_until_ready``/
+                            ``device_get`` reachable from an ``async
+                            def`` coroutine through same-module sync
+                            helpers — one stalled coroutine stalls
+                            every in-flight request.
+- NDSJ304 weak-literal-dispatch
+                            a bare Python numeric literal passed
+                            positionally to a compiled/jitted callable
+                            (``compiled(bufs, 5)``): weak-typed
+                            scalars re-promote per call site and each
+                            distinct literal can key a fresh
+                            executable — stage it
+                            (``jnp.int32(n)``/``device_put``) so the
+                            dtype is pinned and the transfer explicit.
+
+Waivers share lint_rules' grammar under the ``ndsjit`` marker —
+``ndsjit: waive[NDSJ3xx] -- why`` (note mandatory) or
+``ndsjit: disable=NDSJ3xx`` (lightweight form), as a line comment;
+malformed/stale markers report under NDSJ300. File roots come from ``[tool.ndsjit]``
+in pyproject.toml (tools/ndsjit.py loads it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nds_tpu.analysis.lint_rules import (
+    LintResult, LintViolation, Rule, _walk_funcs, lint_sources,
+)
+
+TOOL = "ndsjit"
+META_RULE = "NDSJ300"
+
+#: names a compiled/AOT executable commonly binds to in this tree —
+#: the jit-boundary callables NDSJ303/304 treat as device sources
+_COMPILED_NAMES = {"compiled", "jitted", "cf", "entry", "state"}
+
+#: module aliases whose calls produce device values
+_DEVICE_MODULES = {"jnp", "lax"}
+
+#: jit wrappers that mark a function argument as traced
+_JIT_WRAPPERS = {"jit", "donate_jit"}
+
+
+def _call_name(func: ast.AST) -> "str | None":
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    """A call whose result lives on device: ``jnp.*``/``lax.*`` ops,
+    ``jax.device_put``, or an invocation of a compiled executable
+    (``compiled(...)``, ``entry["compiled"](...)``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name) and v.id in _DEVICE_MODULES:
+            return True
+        if (isinstance(v, ast.Name) and v.id == "jax"
+                and f.attr == "device_put"):
+            return True
+    if isinstance(f, ast.Name) and f.id in ("compiled", "jitted", "cf"):
+        return True
+    if (isinstance(f, ast.Subscript)
+            and isinstance(f.slice, ast.Constant)
+            and f.slice.value in ("compiled", "jitted")):
+        return True
+    return False
+
+
+def _is_host_call(node: ast.AST) -> bool:
+    """A call whose result is host-resident even when fed device
+    values: ``jax.device_get`` / ``np.asarray`` (its OUTPUT is host —
+    the call itself is judged separately as a sink)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute)
+            and ((isinstance(f.value, ast.Name)
+                  and f.value.id == "jax" and f.attr == "device_get")
+                 or (isinstance(f.value, ast.Name)
+                     and f.value.id in ("np", "numpy")
+                     and f.attr == "asarray")))
+
+
+def _assigned_names(target: ast.AST):
+    """Flatten assignment targets: Name, tuple/list unpack, starred."""
+    stack = [target]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+
+
+def _device_taint(fn: ast.AST) -> set:
+    """Names in ``fn`` bound (directly or transitively through
+    assignments) to device-call results, minus names re-bound through
+    the host escapes (device_get/np.asarray outputs are host)."""
+    tainted: set = set()
+    host: set = set()
+    assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+    for _ in range(4):  # bounded fixpoint: chains are short
+        changed = False
+        for a in assigns:
+            rhs = a.value
+            is_host = _is_host_call(rhs) or (
+                isinstance(rhs, ast.Call)
+                and any(_is_host_call(x) for x in ast.walk(rhs.func)))
+            derives = any(_is_device_call(x) for x in ast.walk(rhs))
+            refs_taint = any(isinstance(x, ast.Name)
+                             and x.id in tainted
+                             for x in ast.walk(rhs))
+            for name in [n for t in a.targets
+                         for n in _assigned_names(t)]:
+                if is_host:
+                    if name not in host:
+                        host.add(name)
+                        changed = True
+                    tainted.discard(name)
+                elif (derives or refs_taint) and name not in tainted:
+                    tainted.add(name)
+                    changed = True
+        if not changed:
+            break
+    return tainted - host
+
+
+def _traced_functions(tree: ast.AST) -> "list[ast.AST]":
+    """Function defs that become XLA programs: decorated with a jit
+    wrapper, or passed by name/lambda into ``jax.jit``/``donate_jit``
+    anywhere in the module (the AOT builders' shape)."""
+    funcs = list(_walk_funcs(tree))
+    by_name = {f.name: f for f in funcs}
+    traced: list = []
+
+    def _add(f):
+        # identity (not ==) membership: ast nodes hash/compare by
+        # object, and the tree is small enough for the linear scan
+        if all(f is not g for g in traced):
+            traced.append(f)
+
+    for f in funcs:
+        for d in f.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            if _call_name(target) in _JIT_WRAPPERS:
+                _add(f)
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and _call_name(n.func) in _JIT_WRAPPERS):
+            continue
+        for arg in n.args:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                _add(by_name[arg.id])
+            elif isinstance(arg, ast.Lambda):
+                _add(arg)
+    return traced
+
+
+class TracedLeakRule(Rule):
+    """NDSJ301: Python control flow on a traced-derived value inside a
+    traced function. Only values DERIVED from jnp/lax calls within the
+    function taint — branching on captured host config is static at
+    trace time and legal."""
+
+    id = "NDSJ301"
+    name = "traced-leak"
+    paths = ("nds_tpu/",)
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _traced_functions(tree):
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas cannot contain statements
+            tainted = _device_taint(fn)
+
+            def _leaks(test: ast.AST) -> bool:
+                if any(isinstance(x, ast.Name) and x.id in tainted
+                       for x in ast.walk(test)):
+                    return True
+                return any(_is_device_call(x) for x in ast.walk(test))
+
+            for n in ast.walk(fn):
+                test = None
+                kind = None
+                if isinstance(n, ast.If):
+                    test, kind = n.test, "if"
+                elif isinstance(n, ast.While):
+                    test, kind = n.test, "while"
+                elif isinstance(n, ast.Assert):
+                    test, kind = n.test, "assert"
+                if test is None or not _leaks(test):
+                    continue
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    f"`{kind}` on a traced value inside traced "
+                    f"function {fn.name}(): a branch on device data "
+                    f"either fails at trace time or forces a host "
+                    f"sync + retrace per distinct value — use "
+                    f"lax.cond/jnp.where, or hoist the decision to "
+                    f"host config"))
+        return out
+
+
+class FingerprintBlindCaptureRule(Rule):
+    """NDSJ302: a traced builder closing over an enclosing function's
+    local that no fingerprint site in the file mentions. Module
+    globals, params, ALL_CAPS constants, and self-attributes are out
+    of scope — the hazard is the per-query-varying LOCAL the cache key
+    can't see."""
+
+    id = "NDSJ302"
+    name = "fingerprint-blind-capture"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+
+    _FP_MARKERS = ("try_fingerprint", "_fingerprint_parts",
+                   "fingerprint", "_plan_fingerprint")
+
+    @staticmethod
+    def _locals_of(fn: ast.AST) -> set:
+        names = set()
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not fn:
+                continue
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    names.update(_assigned_names(t))
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                names.update(_assigned_names(n.target))
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                names.update(_assigned_names(n.target))
+            elif isinstance(n, ast.withitem) and n.optional_vars:
+                names.update(_assigned_names(n.optional_vars))
+        a = getattr(fn, "args", None)
+        if a is not None:
+            for arg in (a.args + a.kwonlyargs + a.posonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                names.add(arg.arg)
+        return names
+
+    def _fp_covered(self, src: str) -> set:
+        """Names mentioned anywhere inside a fingerprint call's source
+        segment in this file — textual on purpose: the parts dict
+        spells captures as strings and expressions alike."""
+        covered: set = set()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            return covered
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and _call_name(n.func) in self._FP_MARKERS):
+                continue
+            for x in ast.walk(n):
+                if isinstance(x, ast.Name):
+                    covered.add(x.id)
+                elif (isinstance(x, ast.Constant)
+                      and isinstance(x.value, str)):
+                    covered.add(x.value)
+        # a `parts` dict assembled before the call covers its values
+        for n in ast.walk(tree):
+            if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name in ("_fingerprint_parts",
+                                   "_fingerprint_roots")):
+                for x in ast.walk(n):
+                    if isinstance(x, ast.Name):
+                        covered.add(x.id)
+                    elif isinstance(x, ast.Attribute):
+                        covered.add(x.attr)
+        return covered
+
+    def check(self, tree, src, path):
+        out = []
+        covered = self._fp_covered(src)
+        traced = _traced_functions(tree)
+        funcs = list(_walk_funcs(tree))
+        for outer in funcs:
+            outer_locals = self._locals_of(outer)
+            for inner in ast.walk(outer):
+                if inner is outer or all(inner is not f
+                                         for f in traced):
+                    continue
+                if not isinstance(inner, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                    continue
+                inner_bound = self._locals_of(inner)
+                loads = {x.id for x in ast.walk(inner)
+                         if isinstance(x, ast.Name)
+                         and isinstance(x.ctx, ast.Load)}
+                captures = (loads & outer_locals) - inner_bound
+                for name in sorted(captures):
+                    if name.isupper() or name == "self":
+                        continue
+                    if name in covered:
+                        continue
+                    out.append(LintViolation(
+                        self.id, path, inner.lineno,
+                        f"traced builder {inner.name}() captures "
+                        f"enclosing local {name!r} that no "
+                        f"fingerprint site in this file folds in: "
+                        f"a per-query-varying capture makes cache "
+                        f"entries collide or mint unboundedly — add "
+                        f"it to the fingerprint parts, or waive with "
+                        f"why it cannot vary"))
+        return out
+
+
+class ImplicitTransferRule(Rule):
+    """NDSJ303: blocking host syncs on device-derived values in the
+    dispatch layers, plus blocking calls reachable from serve
+    coroutines through same-module sync helpers."""
+
+    id = "NDSJ303"
+    name = "implicit-transfer"
+    paths = ("nds_tpu/engine/", "nds_tpu/serve/", "nds_tpu/parallel/")
+
+    _SCALARIZERS = {"float", "int", "bool"}
+    _METHOD_SINKS = {"item", "tolist"}
+    _BLOCKING = {"block_until_ready", "device_get"}
+
+    def _sink_hits(self, fn: ast.AST, path: str) -> list:
+        tainted = _device_taint(fn)
+        out = []
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            name = _call_name(f)
+            hit = None
+            if (isinstance(f, ast.Name) and name in self._SCALARIZERS
+                    and n.args):
+                a = n.args[0]
+                if (isinstance(a, ast.Name) and a.id in tainted) \
+                        or _is_device_call(a):
+                    hit = f"{name}() on a device value"
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in self._METHOD_SINKS):
+                v = f.value
+                if (isinstance(v, ast.Name) and v.id in tainted) \
+                        or _is_device_call(v):
+                    hit = f".{f.attr}() on a device value"
+            elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in ("np", "numpy") and n.args):
+                a = n.args[0]
+                if (isinstance(a, ast.Name) and a.id in tainted) \
+                        or _is_device_call(a):
+                    hit = "np.asarray() on a device value"
+            if hit is None:
+                continue
+            out.append(LintViolation(
+                self.id, path, n.lineno,
+                f"{hit} is a blocking implicit device->host sync in "
+                f"dispatch code — batch it through jax.device_get at "
+                f"a sanctioned read-back point, or waive with why "
+                f"this sync is the site's product"))
+        return out
+
+    def _serve_reachable(self, tree: ast.AST, path: str) -> list:
+        """serve/ only: a coroutine calling (transitively, same
+        module) a sync function containing block_until_ready /
+        device_get stalls the shared event loop."""
+        if "serve/" not in path.replace("\\", "/"):
+            return []
+        funcs = list(_walk_funcs(tree))
+        by_name = {f.name: f for f in funcs}
+
+        def blocking_sites(f):
+            return [n for n in ast.walk(f)
+                    if isinstance(n, ast.Call)
+                    and _call_name(n.func) in self._BLOCKING]
+
+        calls = {f.name: {_call_name(n.func) for n in ast.walk(f)
+                          if isinstance(n, ast.Call)} - {None}
+                 for f in funcs}
+        out = []
+        for f in funcs:
+            if not isinstance(f, ast.AsyncFunctionDef):
+                continue
+            seen, stack = set(), [f.name]
+            while stack:
+                cur = stack.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                target = by_name.get(cur)
+                if target is None:
+                    continue
+                if cur != f.name:
+                    for site in blocking_sites(target):
+                        out.append(LintViolation(
+                            self.id, path, site.lineno,
+                            f"{_call_name(site.func)}() reachable "
+                            f"from coroutine {f.name}() via "
+                            f"{target.name}(): a blocking device "
+                            f"sync on the event loop stalls every "
+                            f"in-flight request — hand it to the "
+                            f"engine thread"))
+                stack.extend(calls.get(cur, ()))
+        # dedupe: one site may be reachable from several coroutines
+        uniq = {}
+        for v in out:
+            uniq.setdefault(v.line, v)
+        return list(uniq.values())
+
+    def check(self, tree, src, path):
+        out = []
+        for fn in _walk_funcs(tree):
+            out.extend(self._sink_hits(fn, path))
+        out.extend(self._serve_reachable(tree, path))
+        return out
+
+
+class WeakLiteralDispatchRule(Rule):
+    """NDSJ304: a bare numeric literal passed positionally into a
+    compiled/jitted callable. Weak-typed scalars promote per call and
+    distinct literals can key distinct executables — the classic
+    cache-miss multiplier at serving time."""
+
+    id = "NDSJ304"
+    name = "weak-literal-dispatch"
+    paths = ("nds_tpu/engine/", "nds_tpu/parallel/")
+
+    @staticmethod
+    def _is_compiled_callee(f: ast.AST) -> bool:
+        if isinstance(f, ast.Name) and f.id in ("compiled", "jitted",
+                                                "cf"):
+            return True
+        return (isinstance(f, ast.Subscript)
+                and isinstance(f.slice, ast.Constant)
+                and f.slice.value in ("compiled", "jitted"))
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call)
+                    and self._is_compiled_callee(n.func)):
+                continue
+            for a in n.args:
+                lit = a
+                if (isinstance(lit, ast.UnaryOp)
+                        and isinstance(lit.op, ast.USub)):
+                    lit = lit.operand
+                if (isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, (int, float))
+                        and not isinstance(lit.value, bool)):
+                    seg = ast.get_source_segment(src, a) or "?"
+                    out.append(LintViolation(
+                        self.id, path, n.lineno,
+                        f"bare literal {seg} "
+                        f"passed positionally to a compiled callable: "
+                        f"weak-typed scalars re-key the executable "
+                        f"per distinct value — stage it explicitly "
+                        f"(jnp.int32(...)/device_put) so dtype and "
+                        f"transfer are pinned"))
+        return out
+
+
+def default_rules() -> "list[Rule]":
+    return [TracedLeakRule(), FingerprintBlindCaptureRule(),
+            ImplicitTransferRule(), WeakLiteralDispatchRule()]
+
+
+def scan_sources(sources: "dict[str, str]",
+                 enabled: "set[str] | None" = None) -> LintResult:
+    """Run the ndsjit catalog over {path: source} with the shared
+    waiver/disable semantics under the ``ndsjit`` marker."""
+    return lint_sources(sources, rules=default_rules(),
+                        enabled=enabled, tool=TOOL,
+                        meta_rule=META_RULE)

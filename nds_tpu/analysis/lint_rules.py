@@ -106,14 +106,20 @@ fails CI instead of waiting for a human audit:
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
 mandatory; a waiver without one, or one that matches no violation, is
-itself an error. The marker and file roots come from
-``[tool.ndslint]`` in pyproject.toml (tools/ndslint.py loads it).
+itself an error. The lightweight ``# ndslint: disable=NDS1xx`` form
+(note optional, same staleness rules) suppresses per rule at sites
+whose exemption is obvious in context — test helpers mainly; both
+forms are shared verbatim by ndsraces and ndsjit markers. The marker
+and file roots come from ``[tool.ndslint]`` in pyproject.toml
+(tools/ndslint.py loads it).
 """
 
 from __future__ import annotations
 
 import ast
+import io
 import re
+import tokenize
 from dataclasses import dataclass, field
 
 
@@ -140,15 +146,26 @@ class Waiver:
 
 # ------------------------------------------------------------- waivers
 
-# one waiver grammar, two tools: ndslint (this module's rules) and
-# ndsraces (nds_tpu/analysis/concurrency.py) share the marker syntax
-# differing only in the tool name, so the waiver-report and the
-# stale-waiver semantics stay identical across both gates
+# one waiver grammar, three tools: ndslint (this module's rules),
+# ndsraces (nds_tpu/analysis/concurrency.py), and ndsjit
+# (nds_tpu/analysis/jit_hazards.py) share the marker syntax differing
+# only in the tool name, so the waiver-report and the stale-waiver
+# semantics stay identical across all gates. Two per-line forms:
+#
+#   <line>  # <tool>: waive[NDS1xx] -- justification   (note mandatory)
+#   <line>  # <tool>: disable=NDS1xx[,NDSyyy]          (note optional)
+#
+# ``waive[...]`` is the audited form — the justification is part of
+# the record; ``disable=`` is the lightweight per-rule suppression for
+# sites whose exemption is obvious in context (test helpers,
+# fixtures). Both cover the next line when standalone, both go stale
+# (and fail the gate) when they match no live finding.
 WAIVER_RE = re.compile(
     r"#\s*ndslint:\s*waive\[(?P<rules>[A-Z0-9, ]+)\]"
     r"(?:\s*--\s*(?P<note>.*\S))?")
 
 _WAIVER_RES: dict = {"ndslint": WAIVER_RE}
+_DISABLE_RES: dict = {}
 
 
 def waiver_re(tool: str) -> "re.Pattern":
@@ -161,30 +178,77 @@ def waiver_re(tool: str) -> "re.Pattern":
     return pat
 
 
+def disable_re(tool: str) -> "re.Pattern":
+    pat = _DISABLE_RES.get(tool)
+    if pat is None:
+        pat = _DISABLE_RES[tool] = re.compile(
+            r"#\s*" + re.escape(tool)
+            + r":\s*disable=(?P<rules>[A-Z0-9]+(?:\s*,\s*[A-Z0-9]+)*)"
+            r"(?:\s*--\s*(?P<note>.*\S))?")
+    return pat
+
+
+def _comment_tokens(src: str):
+    """[(line, standalone, comment_text)] for each COMMENT token, or
+    None when the source does not tokenize (caller falls back to the
+    raw line scan — a best-effort net for broken sources the ast
+    parse will report anyway)."""
+    try:
+        out = []
+        for t in tokenize.generate_tokens(io.StringIO(src).readline):
+            if t.type != tokenize.COMMENT:
+                continue
+            row, col = t.start
+            line = t.line if t.line else ""
+            out.append((row, line[:col].strip() == "", t.string))
+        return out
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        return None
+
+
 def parse_waivers(src: str, tool: str = "ndslint",
                   meta_rule: str = "NDS100"
                   ) -> "tuple[dict, list[LintViolation]]":
-    """{covered_line: Waiver} plus violations for malformed waivers
-    (missing justification). A waiver on its own line covers the next
-    line; an end-of-line waiver covers its own. ``tool`` picks the
-    marker (``ndslint`` / ``ndsraces``); ``meta_rule`` is the rule id
-    malformed-waiver errors report under."""
+    """{covered_line: Waiver} plus violations for malformed waivers.
+    A marker on its own line covers the next line; an end-of-line
+    marker covers its own. ``tool`` picks the marker (``ndslint`` /
+    ``ndsraces`` / ``ndsjit``); ``meta_rule`` is the rule id
+    malformed-waiver errors report under. ``waive[...]`` requires a
+    ``-- justification``; ``disable=NDS1xx`` does not (its note is
+    optional) — both forms share staleness accounting."""
     waivers: dict[int, Waiver] = {}
     errors: list[LintViolation] = []
-    for lineno, text in enumerate(src.splitlines(), 1):
+    lines = src.splitlines()
+    # only genuine COMMENT tokens carry markers: a marker spelled
+    # inside a string literal (linter test fixtures embed whole
+    # sources, markers included) must not parse as a waiver of the
+    # embedding file — tokenize separates the two exactly. Sources
+    # that don't tokenize fall back to the raw per-line scan.
+    candidates = _comment_tokens(src)
+    if candidates is None:
+        candidates = [(i, None, text)
+                      for i, text in enumerate(lines, 1)]
+    for lineno, standalone, text in candidates:
         m = waiver_re(tool).search(text)
+        need_note = True
+        if not m:
+            m = disable_re(tool).search(text)
+            need_note = False
         if not m:
             continue
         rules = [r.strip() for r in m.group("rules").split(",")
                  if r.strip()]
         note = (m.group("note") or "").strip()
-        standalone = text[: m.start()].strip() == ""
+        if standalone is None:
+            standalone = text[: m.start()].strip() == ""
         covered = lineno + 1 if standalone else lineno
-        if not note:
+        if need_note and not note:
             errors.append(LintViolation(
                 meta_rule, "", lineno,
                 f"waiver without justification (use "
-                f"'# {tool}: waive[...] -- why')"))
+                f"'# {tool}: waive[...] -- why', or the per-rule "
+                f"'# {tool}: disable=NDS1xx' form)"))
             continue
         waivers[covered] = Waiver(covered, rules, note)
     return waivers, errors
@@ -1082,10 +1146,15 @@ class LintResult:
 
 def lint_sources(sources: "dict[str, str]",
                  rules: "list[Rule] | None" = None,
-                 enabled: "set[str] | None" = None) -> LintResult:
+                 enabled: "set[str] | None" = None,
+                 tool: str = "ndslint",
+                 meta_rule: str = "NDS100") -> LintResult:
     """Lint {path: source}. Rules needing a whole-tree read index (dead
     fields) see every file; violations and waiver bookkeeping are
-    per-file. ``enabled`` filters by rule id (None = all)."""
+    per-file. ``enabled`` filters by rule id (None = all). ``tool`` /
+    ``meta_rule`` select the waiver marker and the id malformed/stale
+    waivers report under — ndsjit (jit_hazards.py) drives this same
+    loop with its own catalog."""
     rules = default_rules() if rules is None else rules
     if enabled is not None:
         rules = [r for r in rules if r.id in enabled]
@@ -1103,7 +1172,8 @@ def lint_sources(sources: "dict[str, str]",
             r.build_read_index(list(trees.values()))
     for path, tree in trees.items():
         src = sources[path]
-        waivers, werrs = parse_waivers(src)
+        waivers, werrs = parse_waivers(src, tool=tool,
+                                       meta_rule=meta_rule)
         for w in werrs:
             w.path = path
             res.errors.append(w)
@@ -1122,7 +1192,7 @@ def lint_sources(sources: "dict[str, str]",
         for w in waivers.values():
             if not w.used:
                 res.errors.append(LintViolation(
-                    "NDS100", path, w.line,
+                    meta_rule, path, w.line,
                     f"waiver for {','.join(w.rules)} matches no "
                     f"violation — stale, remove it"))
     return res

@@ -102,7 +102,8 @@ def deserialize_compiled(payload: dict):
                                    payload["out_tree"])
 
 
-def lower_and_compile(jitted, *args, fresh: bool = False):
+def lower_and_compile(jitted, *args, fresh: bool = False,
+                      kind: str = "program"):
     """The engine's single ``.lower().compile()`` site.
 
     ``fresh=True`` — used for every compile destined for the plan
@@ -110,7 +111,15 @@ def lower_and_compile(jitted, *args, fresh: bool = False):
     compile only: an executable jax's cache serves back re-serializes
     into a blob that cannot reload, so a blob we intend to persist
     must come from a real compile regardless of the ambient
-    process-wide cache state (tests and mixed sessions flip it)."""
+    process-wide cache state (tests and mixed sessions flip it).
+
+    Being the single funnel is what makes the jitsan recompile claim
+    airtight: EVERY engine compile — counted or not — announces here
+    (``analysis/jitsan.on_compile``), so a compile inside an armed
+    post-warmup window is caught even when its call site forgot the
+    compiles_total/recompiles_total increment."""
+    from nds_tpu.analysis import jitsan
+    jitsan.on_compile(kind)
     import jax
     if not fresh or not jax.config.jax_enable_compilation_cache:
         return jitted.lower(*args).compile()
@@ -266,7 +275,7 @@ def cached_compile(cache, fp: "str | None", kind: str, build, args,
         if hit is not None:
             return hit[0], hit[1], True
     compiled = lower_and_compile(build(), *args,
-                                 fresh=fresh_for(cache, fp))
+                                 fresh=fresh_for(cache, fp), kind=kind)
     extra = extra_fn() if extra_fn is not None else {}
     if cache is not None and fp:
         persist(cache, fp, kind, compiled, extra, meta)

@@ -44,6 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nds_tpu.analysis import jitsan
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine import pipeline_io
 from nds_tpu.engine.device_exec import DCtx, DVal
@@ -653,7 +654,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
                             # program's compiler cost once
                             obs_costs.record_program(
                                 type(ex).__name__, compiled)
-                            row, outs, overflow = compiled(bufs)
+                            with jitsan.dispatch(type(ex).__name__):
+                                row, outs, overflow = compiled(bufs)
                             # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the overflow verdict gates the slack-doubling retry, and the partials must land on host before the next chunk swaps buffers
                             row_h, outs_h, over_h = jax.device_get(
                                 (row, outs, overflow))
@@ -678,8 +680,14 @@ class ChunkedExecutor(dx.DeviceExecutor):
                             from nds_tpu.cache import aot as cache_aot
                             jitted, side = ex._compile(planned_a,
                                                        slack)
+                            # ndsjit finding: this overflow recompile
+                            # was invisible to the cost ledger — a
+                            # warm run could recompile here and still
+                            # report compiles == 0
+                            obs_metrics.counter(
+                                "recompiles_total").inc()
                             compiled = cache_aot.lower_and_compile(
-                                jitted, bufs)
+                                jitted, bufs, kind="partial_agg_retry")
                     finally:
                         memwatch.sub_live(win)
                         staged.release()
@@ -764,7 +772,6 @@ class ChunkedExecutor(dx.DeviceExecutor):
         # reduced table serves all scans of it in phase B)
         if any(not s.filters for s in scans):
             return np.ones(n, dtype=bool)
-        live_scans = scans
 
         # encoded chunk scans (nds_tpu/columnar/): bitpack-only, with
         # bounds from the WHOLE table, so every chunk of a column
@@ -781,11 +788,12 @@ class ChunkedExecutor(dx.DeviceExecutor):
 
         skipped: list = []
 
+        # ndsjit: waive[NDSJ302] -- t is self.tables[table], content-stamped into the fingerprint via tables=; skipped is trace-time bookkeeping that never shapes the program (warm hits legitimately skip it, see _keep_mask_compiled)
         def fn(bufs, n_valid):
             from nds_tpu.columnar import device as columnar_dev
             base = jnp.arange(C, dtype=jnp.int32) < n_valid
             keep = jnp.zeros(C, dtype=bool)
-            for scan in live_scans:
+            for scan in scans:
                 tr = dx._Trace(self, bufs)
                 ctx = DCtx(C, base)
                 for name, _dt in scan.output:
@@ -874,10 +882,18 @@ class ChunkedExecutor(dx.DeviceExecutor):
                             table, scans, need_cols, C, fn, bufs,
                             chunk_specs)
                     obs_costs.record_program("chunkscan", compiled)
-                    # ndslint: waive[NDS117] -- sanctioned per-chunk sync point: the keep mask IS phase A's product and must land on host before the survivor gather
-                    keep_np[start:stop] = np.asarray(
-                        compiled(bufs,
-                                 jnp.int32(stop - start)))[:stop - start]
+                    # the chunk-length scalar stages BEFORE the
+                    # dispatch scope: its tiny h2d is control-plane,
+                    # not a buffer leaking into the guarded hot path
+                    nchunk = jnp.int32(stop - start)
+                    with jitsan.dispatch("chunkscan"):
+                        mask_d = compiled(bufs, nchunk)
+                    with jitsan.declared("keep-mask readback"):
+                        # sanctioned per-chunk sync point: the keep
+                        # mask IS phase A's product and must land on
+                        # host before the survivor gather
+                        keep_np[start:stop] = np.asarray(  # ndsjit: waive[NDSJ303] -- the declared() scope above attributes this sync; it is phase A's product, not a hidden stall
+                            mask_d)[:stop - start]
                 finally:
                     staged.release()
             if skipped:

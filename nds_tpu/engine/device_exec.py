@@ -55,6 +55,7 @@ if os.environ.get("NDS_TPU_PLATFORM"):
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from nds_tpu.analysis import jitsan  # noqa: E402
 from nds_tpu.engine import kernels as KX  # noqa: E402
 from nds_tpu.engine.cpu_exec import ResultTable, like_mask  # noqa: E402
 from nds_tpu.engine.types import (  # noqa: E402
@@ -765,9 +766,13 @@ class DeviceExecutor:
                                      entry["compiled"])
             # ndslint: waive[NDS102] -- execute bracket opens here; _finish_traced closes it after device_get
             t1 = _time.perf_counter()
-            row, outs, overflow = (entry["compiled"](bufs, pvals)
-                                   if pvals is not None
-                                   else entry["compiled"](bufs))
+            # jitsan dispatch scope (analysis/jitsan): armed windows
+            # count the crossing and forbid implicit h2d — bufs/pvals
+            # are device-resident by the staging above
+            with jitsan.dispatch(type(self).__name__):
+                row, outs, overflow = (entry["compiled"](bufs, pvals)
+                                       if pvals is not None
+                                       else entry["compiled"](bufs))
         return _AsyncResult(self, planned, key, entry, timings, t1,
                             (row, outs, overflow), qspan)
 
@@ -863,7 +868,8 @@ class DeviceExecutor:
             lower_args = ((bufs, pvals) if pvals is not None
                           else (bufs,))
             entry["compiled"] = cache_aot.lower_and_compile(
-                jitted, *lower_args, fresh=cache_aot.fresh_for(pc, fp))
+                jitted, *lower_args, fresh=cache_aot.fresh_for(pc, fp),
+                kind=type(self).__name__)
         entry["side"] = side
         timings["compile_ms"] += (
             # ndslint: waive[NDS102,NDS103] -- .compile() is synchronous; the execute bracket closes via device_get in _finish_traced
@@ -1004,7 +1010,8 @@ class DeviceExecutor:
             # first-use compactor compile must not count as execution
             t1 += timings.pop("__compact_compile_ms", 0.0) / 1000
             obs_costs.record_program("compact", cf)
-            cnt_d, row2, outs2 = cf(row_d, outs_d)
+            with jitsan.dispatch("compact"):
+                cnt_d, row2, outs2 = cf(row_d, outs_d)
             cnt_h, overflow_h = jax.device_get((cnt_d, overflow_d))
             if int(overflow_h) == 0:
                 C = 1

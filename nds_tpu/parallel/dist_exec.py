@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P_
 
+from nds_tpu.analysis import jitsan
 from nds_tpu.engine import device_exec as dx
 from nds_tpu.engine.device_exec import DCtx, DVal, DeviceExecError, _ok
 from nds_tpu.io.host_table import HostTable
@@ -471,7 +472,8 @@ class DistributedExecutor(dx.DeviceExecutor):
                             {k: bufs[k] for k in state["sk"]},
                             {k: bufs[k] for k in state["rk"]},
                             fresh=cache_aot.fresh_for(*state.get(
-                                "cache_handle", (None, None))))
+                                "cache_handle", (None, None))),
+                            kind=type(self).__name__)
                     state["slack"] = slack
                     timings["compile_ms"] += (
                         # ndslint: waive[NDS102] -- .compile() is synchronous; bracket ends when it returns
@@ -501,8 +503,9 @@ class DistributedExecutor(dx.DeviceExecutor):
                                      state["jitted"])
             # ndslint: waive[NDS102] -- execute bracket start; closed below after device_get
             t1 = _time.perf_counter()
-            row, outs, overflow, skew = state["jitted"](shard_bufs,
-                                                        repl_bufs)
+            with jitsan.dispatch(type(self).__name__):
+                row, outs, overflow, skew = state["jitted"](shard_bufs,
+                                                            repl_bufs)
             # one batched device->host round trip (see DeviceExecutor)
             row_h, outs_h, overflow_h, skew_h = jax.device_get(
                 (row, outs, overflow, skew))

@@ -1,0 +1,16 @@
+"""NDSJ302 negative: the same capture, folded into the fingerprint."""
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.cache import aot as cache_aot
+
+
+def build(table, tables, scale):
+    limit = scale * 2
+
+    def fn(bufs):  # capture covered: `limit` rides the fingerprint
+        return jnp.minimum(jnp.sum(bufs["a"]), limit)
+
+    pc, fp = cache_aot.try_fingerprint(
+        "kind", {"table": table, "limit": limit}, tables=tables)
+    return jax.jit(fn), pc, fp

@@ -1,0 +1,17 @@
+"""NDSJ302 positive: traced builder captures a local the plan
+fingerprint never folds in."""
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.cache import aot as cache_aot
+
+
+def build(table, tables, scale):
+    limit = scale * 2
+
+    def fn(bufs):  # NDSJ302: captures `limit`, fingerprint-blind
+        return jnp.minimum(jnp.sum(bufs["a"]), limit)
+
+    pc, fp = cache_aot.try_fingerprint("kind", {"table": table},
+                                       tables=tables)
+    return jax.jit(fn), pc, fp

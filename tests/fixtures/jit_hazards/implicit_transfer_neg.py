@@ -1,0 +1,9 @@
+"""NDSJ303 negative: the read-back batches through jax.device_get at
+one sanctioned boundary."""
+import jax
+
+
+def dispatch(compiled, bufs):
+    out = compiled(bufs)
+    host = jax.device_get(out)
+    return float(host[0])

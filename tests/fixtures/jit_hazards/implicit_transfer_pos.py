@@ -1,0 +1,11 @@
+"""NDSJ303 positive: hidden scalarizations on device values in
+dispatch code."""
+import numpy as np
+
+
+def dispatch(compiled, bufs):
+    out = compiled(bufs)
+    total = float(out)  # NDSJ303: blocking d2h sync
+    host = np.asarray(out)  # NDSJ303: blocking d2h sync
+    flag = out.item()  # NDSJ303: blocking d2h sync
+    return total, host, flag

@@ -1,0 +1,7 @@
+"""NDSJ303 negative (serve/): the coroutine awaits the engine thread;
+no blocking sync is reachable from the loop."""
+
+
+async def handle(req, engine):
+    res = await engine.submit(req)
+    return res

@@ -1,0 +1,12 @@
+"""NDSJ303 positive (serve/): a blocking device sync reachable from a
+coroutine through a same-module sync helper."""
+
+
+def _finish(res):
+    res.block_until_ready()  # NDSJ303: stalls the event loop via handle()
+    return res
+
+
+async def handle(req, engine):
+    res = engine.run(req)
+    return _finish(res)

@@ -1,0 +1,27 @@
+"""NDSJ301 negative: host-config branches and lax combinators only."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+WIDE = True
+
+
+@jax.jit
+def host_config_branch(x):
+    y = jnp.sum(x)
+    if WIDE:  # module-level host config: static at trace time
+        y = y * 2
+    return jnp.where(y > 0, y, -y)
+
+
+def combinator(x, enable):
+    z = jnp.cumsum(x)
+    return lax.cond(enable, lambda a: a, lambda a: -a, z)
+
+
+prog = jax.jit(combinator)
+
+
+def untraced_helper(n):
+    assert n > 0  # plain host function: asserts freely
+    return list(range(n))

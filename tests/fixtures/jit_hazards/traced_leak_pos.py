@@ -1,0 +1,22 @@
+"""NDSJ301 positive: traced values leak into Python control flow."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_sum(x):
+    y = jnp.sum(x)
+    if y > 0:  # NDSJ301: `if` on a traced value
+        return y
+    return -y
+
+
+def loop_on_scan(x):
+    t = jnp.cumsum(x)
+    while t[0] < 3:  # NDSJ301: `while` on a traced value
+        t = t + 1
+    assert jnp.all(t > 0)  # NDSJ301: `assert` on a traced value
+    return t
+
+
+prog = jax.jit(loop_on_scan)

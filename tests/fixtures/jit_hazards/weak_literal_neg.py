@@ -1,0 +1,7 @@
+"""NDSJ304 negative: the scalar stages explicitly before dispatch."""
+import jax.numpy as jnp
+
+
+def run(compiled, bufs, n):
+    nchunk = jnp.int32(n)
+    return compiled(bufs, nchunk)

@@ -1,0 +1,5 @@
+"""NDSJ304 positive: bare numeric literal at the jit boundary."""
+
+
+def run(compiled, bufs):
+    return compiled(bufs, 512)  # NDSJ304: weak-typed scalar re-keys

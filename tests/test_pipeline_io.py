@@ -513,7 +513,7 @@ class TestDrainMidPrefetch:
                         break
                 except (OSError, ValueError):
                     pass
-                time.sleep(0.02)
+                time.sleep(0.02)  # ndslint: disable=NDS108 -- deadline-bounded journal poll, not a retry loop
             time.sleep(0.2)
             os.kill(os.getpid(), signal.SIGTERM)
 

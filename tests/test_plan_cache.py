@@ -216,7 +216,6 @@ class TestAot:
 
         def build():
             calls.append(1)
-            # ndslint: waive[NDS111] -- test fixture building the traced callable for cache.aot
             return jax.jit(lambda a: jnp.cumsum(a) * 2)
 
         c1, extra1, hit1 = aot.cached_compile(
@@ -239,7 +238,6 @@ class TestAot:
         store = PlanCache(str(tmp_path / "c"))
         fp = "34" + "0" * 62
         x = np.arange(64, dtype=np.float32)
-        # ndslint: waive[NDS111] -- test fixture building the traced callable for cache.aot
         aot.cached_compile(store, fp, "T",
                            lambda: jax.jit(jnp.cumsum), (x,))
         y = np.arange(128, dtype=np.float64)

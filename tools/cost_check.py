@@ -148,9 +148,36 @@ def run_cold_warm(workdir: str) -> "tuple[int, dict | None]":
     if cm <= 0:
         return _fail("cold run recorded no plan-cache misses — is the "
                      "cache dir wired?"), None
-    warm = _run_stream(workdir, raw, stream, "warm", cache_dir)
+    # the warm stream runs under an armed jitsan window: the summaries
+    # below assert compiles == 0 from the ledger's point of view; the
+    # sanitizer asserts the same from the compile funnel's, plus that
+    # no undeclared implicit transfer hid in the dispatch path. No-op
+    # unless NDS_TPU_JITSAN=1 (static_checks forces it).
+    from nds_tpu.analysis import jitsan
+    jitsan_armed = jitsan.arm("cost_check.warm")
+    try:
+        warm = _run_stream(workdir, raw, stream, "warm", cache_dir)
+    finally:
+        verdict = jitsan.disarm()
     if warm is None:
         return 1, None
+    if jitsan_armed:
+        if verdict["compiles"]:
+            return _fail(
+                f"jitsan: warm run compiled "
+                f"{[c['kind'] for c in verdict['compiles']]} past the "
+                f"ledger"), None
+        if verdict["undeclared_transfers"]:
+            return _fail(
+                f"jitsan: warm run hid implicit transfer(s) "
+                f"{[t['what'] for t in verdict['undeclared_transfers']]}"
+            ), None
+        if verdict["dispatches"] == 0:
+            return _fail("jitsan: warm window saw zero dispatch "
+                         "crossings — guard not wired"), None
+        print(f"OK: jitsan warm window clean — 0 compiles, 0 "
+              f"undeclared transfers across {verdict['dispatches']} "
+              f"guarded dispatches")
     bad = _check_costs(warm["summaries"], "warm")
     if bad:
         return _fail(bad), None

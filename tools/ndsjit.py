@@ -1,0 +1,99 @@
+"""ndsjit: run the recompile & transfer hazard auditor over the tree.
+
+Drives ``nds_tpu/analysis/jit_hazards.py`` (rule catalog NDSJ301-304;
+NDSJ300 reports malformed/stale suppressions). The static half of the
+pair whose runtime half is ``nds_tpu/analysis/jitsan.py`` — ndsjit
+finds the hazard classes in source, jitsan witnesses them (or their
+absence) on live dispatch windows. Configuration comes from
+``[tool.ndsjit]`` in pyproject.toml (ndslint's shape):
+
+    roots   = ["nds_tpu"]      # directories to audit
+    exclude = []               # path substrings to skip
+    rules   = []               # rule-id allowlist ([] = all)
+
+Suppressions are per-line, shared grammar with ndslint/ndsraces:
+
+    keep_np[s:e] = np.asarray(mask_d)  # ndsjit: waive[NDSJ303] -- sanctioned sync: the mask IS the product
+    compiled(bufs, 0)                  # ndsjit: disable=NDSJ304
+
+Exit 0 when the tree is clean (waived findings print with notes under
+-v); exit 1 on any unwaived violation, malformed marker, or stale
+marker. ``--jitsan-selftest`` runs a private jitsan sanitizer through
+a real compile + guarded dispatch + hidden scalarization and exits 0
+only when every leg is caught — the tier-1 proof the runtime detector
+fires. Run by tools/static_checks.py as a tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import ndslint  # noqa: E402
+
+from nds_tpu.analysis import jit_hazards  # noqa: E402
+
+DEFAULT_CONFIG = {
+    "roots": ["nds_tpu"],
+    "exclude": [],
+    "rules": [],
+}
+
+
+def load_config(repo: pathlib.Path) -> dict:
+    """[tool.ndsjit] from pyproject.toml, through ndslint's parser
+    (one config grammar for all three gates)."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(ndslint.load_section(repo, "tool.ndsjit"))
+    return cfg
+
+
+def run(repo: pathlib.Path, verbose: bool = False,
+        cfg: "dict | None" = None) -> int:
+    cfg = load_config(repo) if cfg is None else cfg
+    sources = ndslint.collect_sources(repo, cfg)
+    enabled = set(cfg["rules"]) or None
+    res = jit_hazards.scan_sources(sources, enabled=enabled)
+    for v in res.violations + res.errors:
+        print(v)
+    if verbose:
+        for v in res.waived:
+            print(f"{v.path}:{v.line}: {v.rule} waived -- "
+                  f"{v.waiver_note}")
+    bad = len(res.violations) + len(res.errors)
+    print(f"{'FAIL' if bad else 'OK'}: {bad} violation(s), "
+          f"{len(res.waived)} waived, {len(sources)} file(s)")
+    return 1 if bad else 0
+
+
+def jitsan_selftest() -> int:
+    from nds_tpu.analysis import jitsan
+    ok = jitsan.selftest()
+    print(f"{'OK' if ok else 'FAIL'}: jitsan "
+          f"{'caught' if ok else 'MISSED'} the seeded compile, "
+          f"undeclared scalarization, and declared read-back")
+    return 0 if ok else 1
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print waived findings with their notes")
+    ap.add_argument("--jitsan-selftest", action="store_true",
+                    help="run the runtime sanitizer against a seeded "
+                         "compile + hidden transfer; exit 0 iff every "
+                         "leg is caught")
+    args = ap.parse_args(argv)
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    if args.jitsan_selftest:
+        return jitsan_selftest()
+    return run(repo, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

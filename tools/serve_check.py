@@ -27,7 +27,13 @@ acceptance contract:
    request still completes correctly, and the server keeps answering
    afterward (shed, never collapse);
 6. **wire** — the asyncio TCP JSON-lines front answers a short mixed
-   load (tools/ndsload.py --port against a live socket).
+   load (tools/ndsload.py --port against a live socket);
+7. **jitsan verdict** — phases 2-6 run inside an armed jit-sanitizer
+   window (nds_tpu/analysis/jitsan.py, live when NDS_TPU_JITSAN=1 as
+   static_checks forces): the gate fails on any post-warmup compile
+   through the AOT funnel, any undeclared implicit device->host
+   transfer, or a window that crossed zero guarded dispatch sites
+   (which would mean the guard is unwired, not that serving is clean).
 """
 
 from __future__ import annotations
@@ -130,6 +136,15 @@ def run_serve_gate(workdir: str) -> int:
         if entries_warm < len(NDS_H_TEMPLATES) + len(NDS_TEMPLATES):
             return _fail(f"warmup persisted only {entries_warm} "
                          f"plan-cache entries")
+
+        # everything after warmup runs under an armed jitsan window
+        # (analysis/jitsan.py): any post-warmup compile or undeclared
+        # implicit device->host transfer is recorded and fails the
+        # gate below — the runtime twin of the counter deltas phase 2
+        # already asserts. No-op (arm() returns False) unless
+        # NDS_TPU_JITSAN=1, so the standalone tool stays unchanged.
+        from nds_tpu.analysis import jitsan
+        jitsan_armed = jitsan.arm("serve_check.post_warmup")
 
         # -- 2: mixed literal-variant load, zero compiles/misses, no
         #       new cache entries (variants share one fingerprint)
@@ -262,8 +277,33 @@ def run_serve_gate(workdir: str) -> int:
             return _fail(f"TCP front failed requests: {ts}")
         print(f"OK: TCP front answered {len(tcp_resp)}/"
               f"{len(tcp_resp)} requests")
+
+        # -- 7: jitsan verdict over phases 2-6
+        if jitsan_armed:
+            v = jitsan.disarm()
+            if v["compiles"]:
+                return _fail(f"jitsan: {len(v['compiles'])} "
+                             f"post-warmup compile(s): "
+                             f"{[c['kind'] for c in v['compiles']]}")
+            if v["undeclared_transfers"]:
+                return _fail(
+                    f"jitsan: {len(v['undeclared_transfers'])} "
+                    f"undeclared implicit transfer(s): "
+                    f"{[t['what'] for t in v['undeclared_transfers']]}")
+            if v["dispatches"] == 0:
+                return _fail("jitsan: window saw zero dispatch "
+                             f"crossings — guard not wired: {v}")
+            print(f"OK: jitsan window clean — 0 post-warmup compiles, "
+                  f"0 undeclared transfers across {v['dispatches']} "
+                  f"guarded dispatches ({v['declared_transfers']} "
+                  f"declared read-backs)")
         return 0
     finally:
+        # a _fail() mid-gate must not leak an open window into later
+        # in-process sections (static_checks runs this in-process);
+        # disarm() on an already-closed window is a no-op
+        from nds_tpu.analysis import jitsan as _js
+        _js.disarm()
         srv.stop()
 
 

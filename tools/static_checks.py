@@ -17,6 +17,14 @@ the one to run locally before pushing:
                         rules NDSR201-204:
                         nds_tpu/analysis/concurrency.py) — zero
                         unwaived findings, stale waivers fail
+  3c. ndsjit            recompile & transfer hazard audit over
+                        nds_tpu/ (traced-value leaks into Python
+                        control flow, fingerprint-blind closure
+                        captures, implicit device->host syncs in
+                        dispatch code, weak-typed literals at jit
+                        boundaries; rules NDSJ301-304:
+                        nds_tpu/analysis/jit_hazards.py) — zero
+                        unwaived findings, stale waivers fail
   4. ndsverify          plan + verify all 103 NDS and 22 NDS-H
                         statements on CPU (invariants:
                         nds_tpu/analysis/plan_verify.py), each with a
@@ -133,6 +141,17 @@ the one to run locally before pushing:
                         process, and every child-process report swept
                         from NDS_TPU_LOCKSAN_REPORT must be
                         inversion-free too
+ 13. jitsan             runtime jit sanitizer verdict
+                        (nds_tpu/analysis/jitsan.py): a SEEDED
+                        post-warmup compile + hidden .item() on a
+                        private sanitizer must be caught, every
+                        measurement window armed by the cost/serve
+                        sections above — which ran with
+                        NDS_TPU_JITSAN=1 — must be free of post-warmup
+                        compiles and undeclared implicit transfers
+                        while crossing at least one guarded dispatch
+                        site, and every child report swept from
+                        NDS_TPU_JITSAN_REPORT must be clean too
 
 Exit 0 only when every section passes; each section prints its own
 verdict line so CI logs show exactly which gate broke.
@@ -154,6 +173,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # anything. FORCED, not setdefault: an ambient NDS_TPU_LOCKSAN=0 (the
 # pytest debugging opt-out) would make section 12's verdict vacuous.
 os.environ["NDS_TPU_LOCKSAN"] = "1"
+# same reasoning for the jit sanitizer: cost_check's warm stream and
+# serve_check's post-warmup phases arm measurement windows, and the
+# jitsan section's verdict over them is only meaningful if the env was
+# on for the whole process
+os.environ["NDS_TPU_JITSAN"] = "1"
 
 import chaos_check  # noqa: E402
 import check_headers  # noqa: E402
@@ -163,6 +187,7 @@ import cost_check  # noqa: E402
 import fleet_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
+import ndsjit  # noqa: E402
 import ndsraces  # noqa: E402
 import ndsreport  # noqa: E402
 import ndsverify  # noqa: E402
@@ -268,6 +293,69 @@ def run_locksan_check() -> int:
     return 1 if bad else 0
 
 
+def run_jitsan_check() -> int:
+    """Section 13: the jit sanitizer verdict. Three parts:
+    (1) a seeded post-warmup compile + hidden ``.item()`` on a private
+    sanitizer must be caught — the detector provably fires;
+    (2) every measurement window closed in this process (cost_check's
+    warm stream, serve_check's post-warmup phases, both armed because
+    NDS_TPU_JITSAN is forced above) must be violation-free AND at
+    least one must have crossed a guarded dispatch site — a clean
+    verdict over zero dispatches proves only that the guard is
+    unwired;
+    (3) child-process reports swept from NDS_TPU_JITSAN_REPORT must be
+    violation-free too."""
+    import glob
+    import json
+    from nds_tpu.analysis import jitsan
+    if not jitsan.enabled():
+        print(f"FAIL: {jitsan.ENV} is off — the cost/serve windows "
+              f"above ran unsanitized, so this verdict would be "
+              f"vacuous")
+        return 1
+    if not jitsan.selftest():
+        print("FAIL: jitsan missed the seeded compile/transfer")
+        return 1
+    wins = jitsan.windows()
+    inproc = jitsan.violation_count()
+    dispatches = sum(w.get("dispatches", 0) for w in wins)
+    if not wins or dispatches == 0:
+        print(f"FAIL: no armed window crossed a dispatch site "
+              f"({len(wins)} window(s)) — the cost/serve sections "
+              f"above did not measure anything")
+        return 1
+    child_bad = 0
+    reports = 0
+    report_dir = os.environ.get(jitsan.REPORT_ENV)
+    if report_dir:
+        for path in sorted(glob.glob(
+                os.path.join(report_dir, "jitsan-*.json"))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            reports += 1
+            for w in doc.get("windows", []):
+                for c in w.get("compiles", []):
+                    child_bad += 1
+                    print(f"  child compile "
+                          f"({os.path.basename(path)}): "
+                          f"{w.get('label')}: {c.get('kind')}")
+                for t in w.get("undeclared_transfers", []):
+                    child_bad += 1
+                    print(f"  child transfer "
+                          f"({os.path.basename(path)}): "
+                          f"{w.get('label')}: {t.get('what')}")
+    bad = inproc + child_bad
+    print(f"{'FAIL' if bad else 'OK'}: seeded compile+transfer "
+          f"caught; {inproc} in-process + {child_bad} child "
+          f"violation(s) across {len(wins)} window(s) "
+          f"({dispatches} guarded dispatches) and {reports} child "
+          f"report(s)")
+    return 1 if bad else 0
+
+
 def main() -> int:
     import pathlib
     repo = pathlib.Path(__file__).resolve().parent.parent
@@ -277,11 +365,15 @@ def main() -> int:
     os.environ.setdefault(
         "NDS_TPU_LOCKSAN_REPORT",
         tempfile.mkdtemp(prefix="nds_tpu_locksan_"))
+    os.environ.setdefault(
+        "NDS_TPU_JITSAN_REPORT",
+        tempfile.mkdtemp(prefix="nds_tpu_jitsan_"))
     sections = [
         ("headers", check_headers.main),
         ("trace-schema", run_trace_schema_check),
         ("ndslint", lambda: ndslint.run(repo)),
         ("ndsraces", lambda: ndsraces.run(repo)),
+        ("ndsjit", lambda: ndsjit.run(repo)),
         ("ndsverify", lambda: ndsverify.main([])),
         ("chaos", chaos_check.main),
         ("ndsreport", run_ndsreport_check),
@@ -293,6 +385,7 @@ def main() -> int:
         ("cost", lambda: cost_check.main([])),
         ("serve", lambda: serve_check.main([])),
         ("locksan", run_locksan_check),
+        ("jitsan", run_jitsan_check),
     ]
     failed = []
     for name, fn in sections:
