@@ -1124,6 +1124,87 @@ class BlockingTransferInStreamLoopRule(Rule):
         return out
 
 
+class UndeadlinedAwaitRule(Rule):
+    """NDS118: an ``await`` on a cross-process send/recv/drain inside
+    the serving layer (``nds_tpu/serve/``) without an enclosing
+    deadline. The fleet router and the TCP front await sockets owned
+    by OTHER processes — a replica that was SIGKILLed mid-response, a
+    client that stopped reading — and an unbounded ``await
+    reader.readline()`` / ``writer.drain()`` / ``wait_closed()`` /
+    ``asyncio.open_connection()`` pins a coroutine (and whatever
+    request it carries) on that dead peer forever. Every such await
+    must sit under ``asyncio.wait_for(...)`` or an enclosing ``async
+    with asyncio.timeout(...)`` block, so failover latency is a
+    config knob, not a hang."""
+
+    id = "NDS118"
+    name = "undeadlined-await"
+    paths = ("nds_tpu/serve/",)
+    _STREAM_ATTRS = {"readline", "readexactly", "readuntil", "read",
+                     "drain", "wait_closed"}
+
+    @classmethod
+    def _stream_call(cls, call: ast.Call) -> "str | None":
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in cls._STREAM_ATTRS:
+                return f".{f.attr}()"
+            if (f.attr == "open_connection"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "asyncio"):
+                return "asyncio.open_connection()"
+        return None
+
+    @staticmethod
+    def _under_timeout(node: ast.AST) -> bool:
+        """An enclosing ``async with asyncio.timeout(...)`` (or
+        ``timeout_at``) bounds every await in its body; the search
+        stops at the coroutine boundary — an outer function's timeout
+        does not cover a nested def that runs elsewhere."""
+        cur = getattr(node, "_nds118_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.AsyncWith):
+                for item in cur.items:
+                    c = item.context_expr
+                    if (isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr in ("timeout",
+                                                "timeout_at")
+                            and isinstance(c.func.value, ast.Name)
+                            and c.func.value.id == "asyncio"):
+                        return True
+            if isinstance(cur, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                break
+            cur = getattr(cur, "_nds118_parent", None)
+        return False
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            for ch in ast.iter_child_nodes(n):
+                ch._nds118_parent = n
+        for fn in _walk_funcs(tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for n in BlockingInAsyncRule._body_nodes(fn):
+                if (not isinstance(n, ast.Await)
+                        or not isinstance(n.value, ast.Call)):
+                    continue
+                what = self._stream_call(n.value)
+                if what is None or self._under_timeout(n):
+                    continue
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    f"await {what} without a deadline (in coroutine "
+                    f"{fn.name!r}): one dead peer must never hang "
+                    f"the serving front — wrap in "
+                    f"asyncio.wait_for(...) or an enclosing "
+                    f"asyncio.timeout(...) block, or waive with why "
+                    f"this await is bounded elsewhere"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
@@ -1132,7 +1213,8 @@ def default_rules() -> "list[Rule]":
             UncachedCompileRule(), Int64EmulationHazardRule(),
             DirectProfilerRule(), UnchainedSignalHandlerRule(),
             BlockingInAsyncRule(), EarlyMaterializationRule(),
-            BlockingTransferInStreamLoopRule()]
+            BlockingTransferInStreamLoopRule(),
+            UndeadlinedAwaitRule()]
 
 
 # -------------------------------------------------------------- driver

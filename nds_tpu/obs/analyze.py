@@ -501,6 +501,30 @@ def analyze_run(run_dir: str, with_trace: bool = True) -> dict:
                 **{f"{q}_ms": v
                    for q, v in _quantiles(walls).items()}}
             for t, walls in sorted(tenant_walls.items())}
+    # fleet serving runs (nds_tpu/serve/fleet.py): the same rollup
+    # keyed by replica, plus divergence flagging — one replica whose
+    # tail is far off the fleet's is a sick member (thermal, noisy
+    # neighbor, wedged cache), not a workload property
+    replica_walls: dict = {}
+    for s in summaries:
+        rep = s.get("replica")
+        if rep and s.get("queryTimes"):
+            replica_walls.setdefault(rep, []).append(
+                float(s["queryTimes"][-1]))
+    if replica_walls:
+        reps = {
+            rep: {"requests": len(walls),
+                  **{f"{q}_ms": v
+                     for q, v in _quantiles(walls).items()}}
+            for rep, walls in sorted(replica_walls.items())}
+        p99s = sorted(d["p99_ms"] for d in reps.values())
+        fleet_median_p99 = p99s[len(p99s) // 2]
+        for d in reps.values():
+            if fleet_median_p99 > 0 and (
+                    d["p99_ms"] > 2.0 * fleet_median_p99):
+                d["outlier"] = True
+        out["replicas"] = reps
+        out["fleet_median_p99_ms"] = fleet_median_p99
     # banked/stale metrics must never flow silently into analysis
     # consumers (ROADMAP item 2): surface the marker loudly; ndsreport
     # diff refuses to gate on it

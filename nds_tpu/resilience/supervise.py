@@ -106,6 +106,38 @@ class StreamSpec:
     env: dict | None = None
 
 
+def fold_child_snapshot(st: dict) -> None:
+    """Fold a child's latest metrics snapshot into its supervisor
+    state: absolute completed-query count and effective heartbeat age
+    ((now - file mtime) + youngest in-file age). Shared by the
+    throughput StreamSupervisor and the serve-fleet
+    ReplicaSupervisor — one liveness definition, two fleets."""
+    path = st["spec"].hb_path
+    try:
+        mtime = os.stat(path).st_mtime
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return  # not written yet / mid-rename: keep previous state
+    if mtime < st["launched_at"]:
+        # stale snapshot from a previous incarnation: trusting its
+        # ages would kill the fresh restart before its first write
+        return
+    prog = doc.get("progress") or {}
+    done_now = int(prog.get("queries_completed") or 0)
+    st["completed"] = st["base_completed"] + done_now
+    st["inc_total"] = prog.get("queries_total")
+    st["inc_completed"] = done_now
+    hbs = doc.get("heartbeats") or {}
+    if hbs:
+        st["saw_heartbeat"] = True
+        youngest = min(h.get("age_s", 0.0) for h in hbs.values())
+        st["hb_age"] = (time.time() - mtime) + youngest
+        st["current"] = next(
+            (h.get("query") for h in hbs.values()
+             if h.get("query")), None)
+
+
 class StreamSupervisor:
     """Launch, watch, kill, restart-once, summarize."""
 
@@ -152,32 +184,7 @@ class StreamSupervisor:
         st.pop("hb_age", None)
 
     def _read_hb(self, st: dict) -> None:
-        """Fold the child's latest snapshot into the stream state:
-        absolute completed-query count and effective heartbeat age."""
-        path = st["spec"].hb_path
-        try:
-            mtime = os.stat(path).st_mtime
-            with open(path) as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            return  # not written yet / mid-rename: keep previous state
-        if mtime < st["launched_at"]:
-            # stale snapshot from a previous incarnation: trusting its
-            # ages would kill the fresh restart before its first write
-            return
-        prog = doc.get("progress") or {}
-        done_now = int(prog.get("queries_completed") or 0)
-        st["completed"] = st["base_completed"] + done_now
-        st["inc_total"] = prog.get("queries_total")
-        st["inc_completed"] = done_now
-        hbs = doc.get("heartbeats") or {}
-        if hbs:
-            st["saw_heartbeat"] = True
-            youngest = min(h.get("age_s", 0.0) for h in hbs.values())
-            st["hb_age"] = (time.time() - mtime) + youngest
-            st["current"] = next(
-                (h.get("query") for h in hbs.values()
-                 if h.get("query")), None)
+        fold_child_snapshot(st)
 
     def _stalled(self, st: dict, now: float) -> str | None:
         if not self.stall_s:
@@ -364,6 +371,289 @@ def _signal_name(num: int) -> str:
         return signal.Signals(num).name
     except ValueError:
         return f"SIG{num}"
+
+
+@dataclass
+class ReplicaSpec:
+    """One supervised serve replica: ``make_cmd(incarnation)`` builds
+    the argv (typically ``python -m nds_tpu.serve.replica ...``);
+    ``hb_path`` is its metrics-snapshot liveness file, ``announce_path``
+    the endpoint file the router watches."""
+    name: str
+    make_cmd: Callable
+    hb_path: str
+    announce_path: str
+    env: dict | None = None
+
+
+class ReplicaSupervisor:
+    """Fleet mode of the supervisor: long-RUNNING children instead of
+    run-to-completion streams.
+
+    The throughput StreamSupervisor's ``run()`` blocks until every
+    child finishes; serve replicas never finish, so this variant polls
+    from a background thread and exposes a control surface instead:
+
+    - ``drain(name)`` — SIGTERM one replica; it drains under
+      ``engine.drain_s`` and exits 75, which relaunches WARM (shared
+      AOT store) without charging the restart budget (``max_resumes``
+      bounds a pathological preempt loop, exactly like stream resume).
+    - ``kill(name, sig)`` — chaos hook (ndsload ``--kill`` schedules):
+      a SIGKILLed replica restarts under ``max_restarts``.
+    - membership hooks — ``on_membership(up=..., down=...)``: the
+      router ejects on ``down(name, reason)`` and HEALTH-PROBES (not
+      trusts) on ``up(name, incarnation)`` before re-admitting.
+
+    Liveness is the same two-layer contract as streams: children armed
+    with ``NDS_TPU_WATCHDOG=stall_s:kill`` self-report + exit 86; the
+    parent backstop escalates past ``2 * stall_s`` of heartbeat
+    silence (``fold_child_snapshot`` ages). ``NDS_TPU_REPLICA`` carries
+    the replica id into the child so responses/summaries/metrics are
+    attributed; ``NDS_TPU_STREAM=<name>#rN`` keeps seeded chaos
+    schedules incarnation-scoped."""
+
+    def __init__(self, specs: "list[ReplicaSpec]", out_dir: str,
+                 stall_s: float | None = None, poll_s: float = 0.25,
+                 grace_s: float = 10.0, max_restarts: int = 2,
+                 max_resumes: int = 3,
+                 startup_grace_s: float | None = None):
+        self.specs = specs
+        self.out_dir = out_dir
+        self.stall_s = stall_s
+        self.poll_s = poll_s
+        self.grace_s = grace_s
+        self.max_restarts = max_restarts
+        self.max_resumes = max_resumes
+        self.startup_grace_s = (
+            startup_grace_s if startup_grace_s is not None
+            else max(30.0, 4.0 * (stall_s or 0.0)))
+        self._states: "dict[str, dict]" = {}
+        self._up_hooks: list = []
+        self._down_hooks: list = []
+        from nds_tpu.analysis import locksan
+        # the poll thread and the router's control calls
+        # (drain/kill/stop) mutate child state concurrently
+        self._lock = locksan.lock("resilience.ReplicaSupervisor._lock")
+        self._stop = None  # threading.Event once started
+        self._thread = None
+
+    # ---------------------------------------------------- membership
+
+    def on_membership(self, up=None, down=None) -> None:
+        """Register ``up(name, incarnation)`` / ``down(name, reason)``
+        callbacks (called from the poll thread; keep them quick)."""
+        if up is not None:
+            self._up_hooks.append(up)
+        if down is not None:
+            self._down_hooks.append(down)
+
+    def _emit(self, hooks: list, *a) -> None:
+        for fn in hooks:
+            try:
+                fn(*a)
+            except Exception as exc:  # noqa: BLE001 - never kill polls
+                print(f"[fleet] membership hook failed: "
+                      f"{type(exc).__name__}: {exc}")
+
+    # ----------------------------------------------------- lifecycle
+
+    def _launch(self, st: dict) -> None:
+        spec = st["spec"]
+        inc = st["incarnation"]
+        env = dict(spec.env if spec.env is not None else os.environ)
+        env[STREAM_ENV] = (spec.name if inc == 0
+                           else f"{spec.name}#r{inc}")
+        env["NDS_TPU_REPLICA"] = spec.name
+        if self.stall_s:
+            from nds_tpu.obs.snapshot import SNAP_ENV
+            interval = max(0.2, min(1.0, self.stall_s / 4.0))
+            env[SNAP_ENV] = f"{spec.hb_path}:{interval}"
+            env[WATCHDOG_ENV] = f"{self.stall_s}:kill"
+        st["proc"] = subprocess.Popen(spec.make_cmd(inc), env=env)
+        st["launched_at"] = time.time()
+        st["saw_heartbeat"] = False
+        st.pop("hb_age", None)
+
+    def start(self) -> "ReplicaSupervisor":
+        import threading
+        os.makedirs(self.out_dir, exist_ok=True)
+        with self._lock:
+            for spec in self.specs:
+                st = {"spec": spec, "incarnation": 0,
+                      "exit_codes": [], "signals": [], "stalls": [],
+                      "restarts": 0, "resumes": 0, "completed": 0,
+                      "base_completed": 0, "saw_heartbeat": False,
+                      "failed": False}
+                self._states[spec.name] = st
+                self._launch(st)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="nds-tpu-fleet-supervisor",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def add_replica(self, spec: ReplicaSpec) -> None:
+        """Scale-out: launch one more replica into a RUNNING fleet.
+        A late joiner warms from the shared AOT store (zero compiles
+        when the fleet already paid them) and is health-probed — not
+        trusted — by the router before taking traffic."""
+        with self._lock:
+            if spec.name in self._states:
+                raise ValueError(
+                    f"replica {spec.name!r} already in the fleet")
+            self.specs.append(spec)
+            st = {"spec": spec, "incarnation": 0,
+                  "exit_codes": [], "signals": [], "stalls": [],
+                  "restarts": 0, "resumes": 0, "completed": 0,
+                  "base_completed": 0, "saw_heartbeat": False,
+                  "failed": False}
+            self._states[spec.name] = st
+            self._launch(st)
+        self._emit(self._up_hooks, spec.name, 0)
+
+    def stop(self) -> dict:
+        """Terminate the fleet (SIGTERM → grace → SIGKILL) and return
+        the summary (also written to ``<out_dir>/fleet_summary.json``)."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            states = list(self._states.values())
+        for st in states:
+            proc = st.get("proc")
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        for st in states:
+            proc = st.get("proc")
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=self.grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        summary = self.summary()
+        write_json_atomic(
+            os.path.join(self.out_dir, "fleet_summary.json"), summary)
+        return summary
+
+    def drain(self, name: str) -> None:
+        """SIGTERM one replica: graceful drain → exit 75 → warm
+        resume (not charged to the restart budget)."""
+        self.kill(name, signal.SIGTERM)
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
+        """Chaos/control hook: deliver ``sig`` to a replica's current
+        incarnation (no-op if it is already down)."""
+        with self._lock:
+            st = self._states.get(name)
+            proc = st.get("proc") if st else None
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(sig)
+
+    # -------------------------------------------------------- polling
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = time.time()
+            with self._lock:
+                states = list(self._states.values())
+            for st in states:
+                try:
+                    self._poll_one(st, now)
+                except Exception as exc:  # noqa: BLE001 - keep polling
+                    print(f"[fleet] poll({st['spec'].name}) failed: "
+                          f"{type(exc).__name__}: {exc}")
+
+    def _poll_one(self, st: dict, now: float) -> None:
+        from nds_tpu.obs import metrics as obs_metrics
+        if st["failed"]:
+            return
+        fold_child_snapshot(st)
+        rc = st["proc"].poll()
+        if rc is None:
+            reason = self._stalled(st, now)
+            if reason is None:
+                return
+            # parent backstop for a fully wedged child: the child's
+            # own kill-action watchdog had its stall_s window first
+            self._emit(self._down_hooks, st["spec"].name,
+                       f"stall: {reason}")
+            obs_metrics.counter("fleet_replica_stalls_total").inc()
+            st["stalls"].append({"reason": reason, "ts": now})
+            proc = st["proc"]
+            proc.terminate()
+            try:
+                proc.wait(timeout=self.grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            rc = proc.returncode
+        else:
+            self._emit(self._down_hooks, st["spec"].name,
+                       f"exit {rc}")
+        st["exit_codes"].append(rc)
+        if rc is not None and rc < 0:
+            st["signals"].append(-rc)
+        if rc == EXIT_STALLED:
+            obs_metrics.counter("fleet_replica_stalls_total").inc()
+            st["stalls"].append({"reason": "child watchdog exit",
+                                 "ts": now})
+        if rc == 0:
+            # operator stop (SIGINT drain): intended departure, no
+            # relaunch
+            st["failed"] = True
+            return
+        resumable = (rc == EXIT_RESUMABLE
+                     and st["resumes"] < self.max_resumes)
+        if not resumable and st["restarts"] >= self.max_restarts:
+            st["failed"] = True
+            print(f"[fleet] {st['spec'].name} gave up (rc={rc}, "
+                  f"restarts={st['restarts']})")
+            return
+        if resumable:
+            obs_metrics.counter("fleet_replica_resumes_total").inc()
+            st["resumes"] += 1
+        else:
+            obs_metrics.counter("fleet_replica_restarts_total").inc()
+            st["restarts"] += 1
+        st["incarnation"] += 1
+        print(f"[fleet] relaunching {st['spec'].name} (rc={rc}) "
+              f"as incarnation {st['incarnation']}")
+        self._launch(st)
+        self._emit(self._up_hooks, st["spec"].name,
+                   st["incarnation"])
+
+    def _stalled(self, st: dict, now: float) -> "str | None":
+        if not self.stall_s:
+            return None
+        if st["saw_heartbeat"]:
+            age = st.get("hb_age")
+            if age is not None and age > 2.0 * self.stall_s:
+                return f"heartbeat silent {age:.1f}s"
+            return None
+        if now - st["launched_at"] > self.startup_grace_s:
+            return (f"no heartbeat within "
+                    f"{self.startup_grace_s:.0f}s of launch")
+        return None
+
+    # -------------------------------------------------------- readout
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"replicas": {
+                name: {"incarnation": st["incarnation"],
+                       "exit_codes": list(st["exit_codes"]),
+                       "signals": list(st["signals"]),
+                       "restarts": st["restarts"],
+                       "resumes": st["resumes"],
+                       "stalls": list(st["stalls"]),
+                       "completed": st["completed"],
+                       "failed": st["failed"]}
+                for name, st in self._states.items()}}
 
 
 def describe_summary(summary: dict) -> str:

@@ -1,4 +1,4 @@
-"""Async query serving layer (README "Serving").
+"""Async query serving layer (README "Serving", "Serve fleet").
 
 Wraps the unified ExecutionPipeline + parameterized-plan machinery in a
 persistent session server: concurrent NDS + NDS-H requests against one
@@ -7,6 +7,13 @@ pre-dispatch projections, queue-depth/deadline brownout (shed, never
 collapse), per-tenant metrics on the snapshot/OpenMetrics emitter, and
 per-request BenchReport-compatible summaries `ndsreport analyze` can
 read. ``server.QueryServer`` is the in-process core; ``net`` adds the
-newline-delimited-JSON asyncio TCP front."""
+newline-delimited-JSON asyncio TCP front; ``replica`` wraps one server
+in the supervised-fleet contract (announce/heartbeat/drain-to-75);
+``fleet.FleetRouter`` routes by plan digest across N replicas with
+health gating and journaled zero-loss failover."""
 
+from nds_tpu.serve.fleet import (  # noqa: F401
+    FleetRouter, ReplicaClient, RequestJournal, launch_fleet,
+    scale_out,
+)
 from nds_tpu.serve.server import QueryServer, Request, Response  # noqa: F401

@@ -65,7 +65,7 @@ async def _serve(server, host: str, port: int) -> None:
     await stop.wait()
     print("[serve] draining", flush=True)
     tcp.close()
-    await tcp.wait_closed()
+    await asyncio.wait_for(tcp.wait_closed(), timeout=30.0)
 
 
 def main(argv=None) -> int:

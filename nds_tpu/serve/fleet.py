@@ -1,0 +1,715 @@
+"""Replicated serve fleet: health-gated routing with zero-loss failover.
+
+Topology (README "Serve fleet"): N ``serve/replica.py`` processes —
+each PR 11's QueryServer engine loop behind the JSON-lines TCP front —
+supervised by ``resilience/supervise.ReplicaSupervisor``, routed by
+the asyncio :class:`FleetRouter` in this module. The control-plane
+design follows the execution-templates blueprint (PAPERS.md): every
+expensive decision is cached — plans in each replica's session cache,
+compiled programs in the SHARED disk AOT store (``cache.dir``), and
+routing affinity on the parameterized plan digest — so per-request
+work is cheap and replica membership changes are routine:
+
+- **Affinity routing** — requests hash by their parameterized plan
+  digest (``sql/params.plan_key`` via a planning-only session when the
+  router has one, else a literal-stripped template hash): same-template
+  traffic lands on the same replica and batches fat there (the
+  server's template batching groups by the same digest). Rendezvous
+  hashing keeps the map stable under membership churn — one replica's
+  departure remaps only its own keys.
+
+- **Health gating** — a replica is admitted only while BOTH health
+  sources agree: the app-level ``op: ping`` (answered off-queue by the
+  TCP handler: engine-thread liveness, queue depth, draining flag) and
+  the watchdog-heartbeat ages embedded in its metrics-snapshot file
+  (``fold_child_snapshot`` semantics — a wedged engine with a live
+  event loop still answers pings, but its heartbeat ages give it
+  away). ``serve.fleet.ping_misses`` consecutive misses eject it from
+  the ring; a clean probe of the relaunched incarnation (fresh
+  announce file, new port) re-admits it.
+
+- **Zero-loss / zero-double** — every accepted request is journaled
+  (id + tenant + digest, atomic ``integrity.write_json_atomic``)
+  BEFORE dispatch. A dead replica's in-flight requests fail over:
+  the connection loss rejects their client futures and the router
+  redelivers to healthy peers (read-only queries are safely
+  re-executable), duplicate-suppressed by request id — the journal's
+  first FINAL outcome per id wins, later arrivals are counted, never
+  re-answered. Departure notices (``server-stopping`` sheds from a
+  draining replica, connection-deadline sheds) are redelivered, not
+  answered. ``RequestJournal.verify()`` proves the invariant: zero
+  accepted-but-unanswered, zero double-answered.
+
+- **Router-level shedding** — admission projects fleet capacity
+  (healthy replicas x ``serve.max_queue``, or the explicit
+  ``serve.fleet.max_pending``): past it the router sheds with
+  ``router-admission`` BEFORE any replica browns out, and with
+  ``no-healthy-replica`` when the ring is empty past the bounded
+  member wait.
+
+Config: ``serve.fleet.*`` keys (utils/config.py). Chaos drills:
+``tools/ndsload.py --fleet N --kill replica=1@2.0,KILL``. Gate:
+``tools/fleet_serve_check.py`` (tier-1 via static_checks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import re
+import time
+
+from nds_tpu.analysis import locksan
+from nds_tpu.obs import metrics as obs_metrics
+
+DEFAULT_PING_INTERVAL_S = 0.5
+DEFAULT_PING_TIMEOUT_S = 5.0
+DEFAULT_PING_MISSES = 3
+DEFAULT_REQUEST_TIMEOUT_S = 600.0
+DEFAULT_REDELIVER_MAX = 4
+DEFAULT_MEMBER_WAIT_S = 30.0
+DEFAULT_HB_STALE_S = 0.0  # 0 = snapshot-liveness gating off
+
+# write/connect deadline inside the client (NDS118: every
+# cross-process await in this package is bounded)
+_IO_TIMEOUT_S = 30.0
+# the reader parks on readline between responses; re-arm the deadline
+# instead of holding one forever
+_READ_PARK_S = 120.0
+
+_LITERAL_RE = re.compile(r"'(?:[^']|'')*'|\b\d+(?:\.\d+)?\b")
+
+
+def template_digest(suite: str, sql: str) -> str:
+    """Literal-stripped template fingerprint — the plannerless routing
+    fallback. Same-template literal variants collapse to one digest
+    (affinity only: the replica's own ``plan_key`` still decides
+    batching and compilation)."""
+    stripped = _LITERAL_RE.sub("?", sql)
+    return hashlib.sha256(
+        f"{suite}|{stripped}".encode()).hexdigest()[:16]
+
+
+def make_planner(sessions: dict):
+    """True ``plan_key``-digest planner over planning-only sessions
+    ({suite: engine.session.Session} with the warehouse registered).
+    Call it through the router's single planning thread — session plan
+    caches are not re-entrant."""
+    def planner(suite: str, sql: str) -> "str | None":
+        s = sessions.get(suite)
+        if s is None:
+            return None
+        from nds_tpu.sql import params as sqlparams
+        planned = s._planned_for((sql, s._views_signature()), sql)
+        if isinstance(planned, tuple):
+            return None
+        key = sqlparams.plan_key(planned)
+        return key[1] if key else None
+    return planner
+
+
+class RequestJournal:
+    """Accepted-request ledger with first-final-outcome-wins
+    duplicate suppression, persisted atomically on every mutation so
+    a router crash loses no accounting."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = locksan.lock("serve.RequestJournal._lock")
+        self.accepted: dict = {}
+        self.outcomes: dict = {}
+
+    def _persist(self, accepted: dict, outcomes: dict) -> None:
+        # ledger dicts arrive as parameters so every read of the
+        # shared state stays lexically under the caller's ``with
+        # self._lock`` block (NDSR201 guard inference)
+        from nds_tpu.io.integrity import write_json_atomic
+        write_json_atomic(self.path, {
+            "accepted": accepted,
+            "outcomes": {rid: {k: v for k, v in o.items()
+                               if k != "response"}
+                         for rid, o in outcomes.items()}})
+
+    def accept(self, rid: str, tenant: str, suite: str, qname: str,
+               digest: "str | None") -> None:
+        with self._lock:
+            self.accepted[rid] = {"tenant": tenant, "suite": suite,
+                                  "qname": qname, "digest": digest,
+                                  "assignments": [], "ts": time.time()}
+            self._persist(self.accepted, self.outcomes)
+
+    def assign(self, rid: str, replica: str) -> None:
+        with self._lock:
+            rec = self.accepted.get(rid)
+            if rec is not None:
+                rec["assignments"].append(replica)
+                self._persist(self.accepted, self.outcomes)
+
+    def settle(self, rid: str, resp: dict) -> dict:
+        """Record a FINAL outcome for ``rid``; returns the canonical
+        response — the first one recorded. A duplicate (a drained
+        replica's late answer racing the redelivered one) is counted,
+        never re-answered."""
+        with self._lock:
+            prev = self.outcomes.get(rid)
+            if prev is not None:
+                prev["duplicates"] = prev.get("duplicates", 0) + 1
+                self._persist(self.accepted, self.outcomes)
+                obs_metrics.counter(
+                    "fleet_duplicate_answers_total").inc()
+                return dict(prev["response"])
+            self.outcomes[rid] = {
+                "status": resp.get("status"),
+                "digest": resp.get("digest"),
+                "replica": resp.get("replica"),
+                "qname": resp.get("qname"),
+                "response": dict(resp), "ts": time.time()}
+            self._persist(self.accepted, self.outcomes)
+            return resp
+
+    def verify(self) -> dict:
+        """The fleet gate's proof obligation: every accepted request
+        has exactly one final outcome."""
+        with self._lock:
+            lost = [rid for rid in self.accepted
+                    if rid not in self.outcomes]
+            double = [rid for rid, o in self.outcomes.items()
+                      if o.get("duplicates")]
+            return {"accepted": len(self.accepted),
+                    "settled": len(self.outcomes),
+                    "lost": lost, "double": double}
+
+
+class ReplicaClient:
+    """One multiplexed JSON-lines connection to a replica: requests
+    tagged by id, a reader task dispatches responses to per-request
+    futures. Connection loss rejects every pending future — the
+    router's redelivery loop is the recovery."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 incarnation: int = 0):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.incarnation = incarnation
+        self._reader = None
+        self._writer = None
+        self._reader_task = None
+        self._wlock = asyncio.Lock()
+        self._pending: "dict[str, asyncio.Future]" = {}
+        self._seq = 0
+
+    @property
+    def connected(self) -> bool:
+        return (self._writer is not None
+                and not self._writer.is_closing())
+
+    async def connect(self, timeout: float = _IO_TIMEOUT_S) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port),
+            timeout=timeout)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                try:
+                    line = await asyncio.wait_for(
+                        self._reader.readline(), timeout=_READ_PARK_S)
+                except asyncio.TimeoutError:
+                    continue  # idle between responses: re-arm
+                if not line:
+                    break
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                fut = self._pending.pop(doc.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(doc)
+        except (OSError, asyncio.CancelledError):
+            pass
+        finally:
+            w, self._writer = self._writer, None
+            if w is not None:
+                w.close()
+            self._fail_pending(ConnectionError(
+                f"replica {self.name} connection lost"))
+
+    def _fail_pending(self, exc: Exception) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def request(self, doc: dict, timeout: float) -> dict:
+        """Send one id-tagged request and await its response (raises
+        ConnectionError / asyncio.TimeoutError on a dead or silent
+        replica — redelivery is the caller's move)."""
+        if not self.connected:
+            raise ConnectionError(f"replica {self.name} not connected")
+        rid = doc["id"]
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            payload = (json.dumps(doc) + "\n").encode()
+            async with self._wlock:
+                self._writer.write(payload)
+                await asyncio.wait_for(self._writer.drain(),
+                                       timeout=_IO_TIMEOUT_S)
+            return await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    async def ping(self, timeout: float) -> dict:
+        self._seq += 1
+        return await self.request(
+            {"op": "ping", "id": f"_ping-{self.name}-{self._seq}"},
+            timeout)
+
+    async def close(self) -> None:
+        task, self._reader_task = self._reader_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(task, return_exceptions=True),
+                    timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+        w, self._writer = self._writer, None
+        if w is not None:
+            w.close()
+        self._fail_pending(ConnectionError(
+            f"replica {self.name} client closed"))
+
+
+class FleetRouter:
+    """Digest-affinity router over the replica ring (module
+    docstring). Drive it from inside a running event loop:
+    ``await router.start()``, ``await router.submit(doc)``,
+    ``await router.stop()``."""
+
+    def __init__(self, fleet_dir: str, config=None, supervisor=None,
+                 planner=None):
+        from nds_tpu.utils.config import EngineConfig
+        self.config = config or EngineConfig()
+        self.fleet_dir = fleet_dir
+        os.makedirs(fleet_dir, exist_ok=True)
+        self.journal = RequestJournal(
+            os.path.join(fleet_dir, "fleet_journal.json"))
+        self.supervisor = supervisor
+        self.planner = planner
+        self.ping_interval = self._cfg_float(
+            "serve.fleet.ping_interval_s", DEFAULT_PING_INTERVAL_S)
+        self.ping_timeout = self._cfg_float(
+            "serve.fleet.ping_timeout_s", DEFAULT_PING_TIMEOUT_S)
+        self.ping_misses = int(self._cfg_float(
+            "serve.fleet.ping_misses", DEFAULT_PING_MISSES))
+        self.request_timeout = self._cfg_float(
+            "serve.fleet.request_timeout_s", DEFAULT_REQUEST_TIMEOUT_S)
+        self.redeliver_max = int(self._cfg_float(
+            "serve.fleet.redeliver_max", DEFAULT_REDELIVER_MAX))
+        self.max_pending = int(self._cfg_float(
+            "serve.fleet.max_pending", 0))
+        self.member_wait = self._cfg_float(
+            "serve.fleet.member_wait_s", DEFAULT_MEMBER_WAIT_S)
+        self.hb_stale_s = self._cfg_float(
+            "serve.fleet.hb_stale_s", DEFAULT_HB_STALE_S)
+        self._members: "dict[str, dict]" = {}
+        self._pending = 0
+        self._seq = 0
+        self._loop = None
+        self._health_task = None
+        self._plan_pool = None
+        if supervisor is not None:
+            supervisor.on_membership(up=self._on_up,
+                                     down=self._on_down)
+
+    def _cfg_float(self, key: str, default: float) -> float:
+        try:
+            return float(self.config.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    # ----------------------------------------------------- membership
+
+    def add_replica(self, name: str, announce_path: str,
+                    hb_path: "str | None" = None) -> None:
+        """Register a ring member (before OR after start(): a replica
+        added mid-run is probed and admitted by the health loop —
+        late joiners are routine, not special)."""
+        self._members[name] = {
+            "name": name, "announce": announce_path,
+            "hb_path": hb_path, "client": None, "healthy": False,
+            "draining": False, "misses": 0, "queue_depth": 0}
+
+    def _on_down(self, name: str, reason: str) -> None:
+        # called from the supervisor's poll THREAD: marshal onto the
+        # loop — member state is loop-confined
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(self._mark_down, name, reason)
+
+    def _on_up(self, name: str, incarnation: int) -> None:
+        # relaunch is a fact, health is not: the health loop probes
+        # the new incarnation's announce before re-admitting
+        print(f"[fleet] {name} relaunched as incarnation "
+              f"{incarnation}; awaiting health probe", flush=True)
+
+    def _mark_down(self, name: str, reason: str) -> None:
+        m = self._members.get(name)
+        if m is None:
+            return
+        if m["healthy"]:
+            obs_metrics.counter("fleet_ejections_total").inc()
+            print(f"[fleet] ejecting {name}: {reason}", flush=True)
+        m["healthy"] = False
+        m["misses"] = self.ping_misses
+
+    def healthy_replicas(self) -> "list[str]":
+        return [m["name"] for m in self._members.values()
+                if m["healthy"] and not m["draining"]]
+
+    # ------------------------------------------------------ lifecycle
+
+    async def start(self) -> "FleetRouter":
+        import concurrent.futures
+        self._loop = asyncio.get_running_loop()
+        # single planning thread: session plan caches are not
+        # re-entrant, and one thread keeps the cache hot in order
+        self._plan_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="fleet-planner")
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return self
+
+    async def stop(self) -> None:
+        task, self._health_task = self._health_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(task, return_exceptions=True),
+                    timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+        for m in self._members.values():
+            if m["client"] is not None:
+                await m["client"].close()
+                m["client"] = None
+            m["healthy"] = False
+        if self._plan_pool is not None:
+            self._plan_pool.shutdown(wait=False)
+
+    async def wait_admitted(self, n: int, timeout: float = 60.0
+                            ) -> bool:
+        """Block until ``n`` ring members are healthy (gate/test
+        rendezvous)."""
+        deadline = self._loop.time() + timeout
+        while self._loop.time() < deadline:
+            if len(self.healthy_replicas()) >= n:
+                return True
+            await asyncio.sleep(self.ping_interval / 2)
+        return False
+
+    # --------------------------------------------------------- health
+
+    async def _health_loop(self) -> None:
+        while True:
+            for m in list(self._members.values()):
+                try:
+                    await self._probe(m)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 - keep probing
+                    self._miss(m, f"{type(exc).__name__}: {exc}")
+            await asyncio.sleep(self.ping_interval)
+
+    @staticmethod
+    def _read_json(path: "str | None") -> "dict | None":
+        if not path:
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _hb_age(path: "str | None") -> "float | None":
+        """Effective heartbeat age of a replica's snapshot file:
+        (now - mtime) + youngest in-file age — the supervisor's
+        liveness definition, read from the router's side."""
+        if not path:
+            return None
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        hbs = doc.get("heartbeats") or {}
+        if not hbs:
+            return None
+        youngest = min(h.get("age_s", 0.0) for h in hbs.values())
+        return (time.time() - mtime) + youngest
+
+    async def _probe(self, m: dict) -> None:
+        # announce file read off-loop (NDS115: no blocking IO in
+        # coroutines)
+        ann = await self._loop.run_in_executor(
+            None, self._read_json, m["announce"])
+        if ann is None and m["client"] is None:
+            self._miss(m, "no announce file")
+            return
+        cl = m["client"]
+        if ann is not None and (
+                cl is None or not cl.connected
+                or int(ann.get("port", -1)) != cl.port
+                or int(ann.get("incarnation", 0)) != cl.incarnation):
+            # new endpoint (first sight, reconnect, or a resumed
+            # incarnation's fresh port): swap the client
+            fresh = ReplicaClient(
+                m["name"], str(ann.get("host", "127.0.0.1")),
+                int(ann["port"]), int(ann.get("incarnation", 0)))
+            try:
+                await fresh.connect(self.ping_timeout)
+            except (OSError, asyncio.TimeoutError):
+                self._miss(m, "connect failed")
+                return
+            if cl is not None:
+                await cl.close()
+            m["client"] = cl = fresh
+        if cl is None or not cl.connected:
+            self._miss(m, "not connected")
+            return
+        try:
+            pong = await cl.ping(self.ping_timeout)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            self._miss(m, "ping miss")
+            return
+        if not pong.get("engine_alive"):
+            self._miss(m, "engine thread dead")
+            return
+        if self.hb_stale_s > 0:
+            age = await self._loop.run_in_executor(
+                None, self._hb_age, m["hb_path"])
+            if age is not None and age > self.hb_stale_s:
+                # the engine answers pings but nothing beats: wedged
+                self._miss(m, f"stale heartbeats ({age:.1f}s)")
+                return
+        m["draining"] = bool(pong.get("draining"))
+        m["queue_depth"] = int(pong.get("queue_depth") or 0)
+        m["misses"] = 0
+        if not m["healthy"] and not m["draining"]:
+            m["healthy"] = True
+            obs_metrics.counter("fleet_admissions_total").inc()
+            print(f"[fleet] admitted {m['name']} "
+                  f"(incarnation {cl.incarnation}, port {cl.port})",
+                  flush=True)
+
+    def _miss(self, m: dict, why: str) -> None:
+        m["misses"] += 1
+        if m["misses"] >= self.ping_misses and m["healthy"]:
+            m["healthy"] = False
+            obs_metrics.counter("fleet_ejections_total").inc()
+            print(f"[fleet] ejecting {m['name']}: {why} "
+                  f"({m['misses']} consecutive misses)", flush=True)
+
+    # -------------------------------------------------------- routing
+
+    def _ring(self, exclude: "set | None" = None) -> list:
+        return [m for m in self._members.values()
+                if m["healthy"] and not m["draining"]
+                and m["client"] is not None and m["client"].connected
+                and m["name"] not in (exclude or ())]
+
+    def _pick(self, digest: "str | None",
+              exclude: "set | None" = None) -> "dict | None":
+        """Rendezvous (highest-random-weight) hash: each digest ranks
+        every member independently, so one departure remaps only the
+        keys it owned."""
+        ring = self._ring(exclude)
+        if not ring:
+            return None
+        key = digest or "_none"
+        return max(ring, key=lambda m: hashlib.sha256(
+            f"{key}|{m['name']}".encode()).digest())
+
+    def _capacity(self) -> int:
+        if self.max_pending > 0:
+            return self.max_pending
+        from nds_tpu.serve.server import DEFAULT_MAX_QUEUE
+        try:
+            per = int(self.config.get_int("serve.max_queue",
+                                          DEFAULT_MAX_QUEUE))
+        except (TypeError, ValueError):
+            per = DEFAULT_MAX_QUEUE
+        return max(1, len(self._ring())) * max(1, per)
+
+    @staticmethod
+    def _is_departure(resp: dict) -> bool:
+        """A shed that means "this replica is leaving", not "this
+        request was answered": redeliver, don't settle."""
+        if resp.get("status") != "shed":
+            return False
+        reason = str(resp.get("shed_reason") or "")
+        return (reason.startswith("server-stopping")
+                or reason.startswith("conn-read-timeout"))
+
+    async def _digest(self, doc: dict) -> "str | None":
+        if self.planner is not None:
+            try:
+                return await self._loop.run_in_executor(
+                    self._plan_pool, self.planner,
+                    str(doc.get("suite", "")), str(doc["sql"]))
+            except Exception:  # noqa: BLE001 - affinity only
+                pass
+        return template_digest(str(doc.get("suite", "")),
+                               str(doc["sql"]))
+
+    async def _acquire(self, digest: "str | None",
+                       tried: set) -> "dict | None":
+        """A healthy member for ``digest``, preferring ones this
+        request has not failed on; waits (bounded) through membership
+        gaps — a mid-failover lull is a wait, not a shed."""
+        deadline = self._loop.time() + self.member_wait
+        while True:
+            m = self._pick(digest, tried)
+            if m is None and tried:
+                # every healthy member already failed this request
+                # once: allow another lap rather than shedding while
+                # capacity exists
+                m = self._pick(digest, None)
+            if m is not None:
+                return m
+            if self._loop.time() >= deadline:
+                return None
+            await asyncio.sleep(self.ping_interval / 2)
+
+    def _shed(self, doc: dict, rid: str, reason: str) -> dict:
+        obs_metrics.counter("fleet_shed_total").inc()
+        return {"status": "shed", "qname": str(doc.get("qname", "")),
+                "tenant": str(doc.get("tenant", "")),
+                "shed_reason": reason, "id": rid}
+
+    async def submit(self, doc: dict) -> dict:
+        """Route one request dict (the TCP front's request shape)
+        through the ring; returns the response dict. Exactly one
+        final answer per request id, journal-enforced."""
+        self._seq += 1
+        rid = str(doc.get("id") or f"fr-{self._seq}")
+        doc = dict(doc)
+        doc["id"] = rid
+        cap = self._capacity()
+        if self._pending >= cap:
+            # shed BEFORE accept: admission control is not a lost
+            # request, the client was answered synchronously
+            return self._shed(
+                doc, rid, f"router-admission:pending:{self._pending}"
+                          f">=cap:{cap}")
+        self._pending += 1
+        obs_metrics.gauge("fleet_pending").set(self._pending)
+        try:
+            digest = await self._digest(doc)
+            await self._loop.run_in_executor(
+                None, self.journal.accept, rid,
+                str(doc.get("tenant", "")), str(doc.get("suite", "")),
+                str(doc.get("qname", "")), digest)
+            tried: set = set()
+            for _attempt in range(self.redeliver_max + 1):
+                m = await self._acquire(digest, tried)
+                if m is None:
+                    break
+                name = m["name"]
+                await self._loop.run_in_executor(
+                    None, self.journal.assign, rid, name)
+                try:
+                    resp = await m["client"].request(
+                        doc, self.request_timeout)
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError):
+                    # dead or silent replica: the journal knows this
+                    # request; redeliver to a healthy peer
+                    obs_metrics.counter(
+                        "fleet_redelivered_total").inc()
+                    tried.add(name)
+                    continue
+                if self._is_departure(resp):
+                    obs_metrics.counter(
+                        "fleet_redelivered_total").inc()
+                    tried.add(name)
+                    continue
+                final = await self._loop.run_in_executor(
+                    None, self.journal.settle, rid, resp)
+                obs_metrics.counter(obs_metrics.labeled(
+                    "fleet_requests_total",
+                    replica=str(final.get("replica") or name))).inc()
+                return final
+            resp = self._shed(doc, rid,
+                              f"redeliver-exhausted:"
+                              f"{self.redeliver_max + 1}")
+            return await self._loop.run_in_executor(
+                None, self.journal.settle, rid, resp)
+        finally:
+            self._pending -= 1
+            obs_metrics.gauge("fleet_pending").set(self._pending)
+
+
+def launch_fleet(fleet_dir: str, names: "list[str]",
+                 replica_argv, config=None, supervisor=None,
+                 planner=None, stall_s: "float | None" = 10.0,
+                 max_restarts: int = 2, max_resumes: int = 3,
+                 startup_grace_s: "float | None" = None):
+    """Wire a supervised fleet: specs + ReplicaSupervisor +
+    FleetRouter, announce/hb lanes under ``fleet_dir``. Returns
+    ``(supervisor, router)`` — caller starts both
+    (``supervisor.start()``; ``await router.start()``).
+
+    ``replica_argv(name, announce_path, incarnation)`` builds each
+    child's argv (``python -m nds_tpu.serve.replica ...``)."""
+    from nds_tpu.resilience.supervise import (
+        ReplicaSpec, ReplicaSupervisor,
+    )
+    ann_dir = os.path.join(fleet_dir, "announce")
+    hb_dir = os.path.join(fleet_dir, "hb")
+    os.makedirs(ann_dir, exist_ok=True)
+    os.makedirs(hb_dir, exist_ok=True)
+    specs = []
+    for name in names:
+        ann = os.path.join(ann_dir, f"{name}.json")
+        specs.append(ReplicaSpec(
+            name=name,
+            make_cmd=(lambda inc, n=name, a=ann:
+                      replica_argv(n, a, inc)),
+            hb_path=os.path.join(hb_dir, f"{name}.json"),
+            announce_path=ann))
+    sup = supervisor or ReplicaSupervisor(
+        specs, fleet_dir, stall_s=stall_s, max_restarts=max_restarts,
+        max_resumes=max_resumes, startup_grace_s=startup_grace_s)
+    router = FleetRouter(fleet_dir, config=config, supervisor=sup,
+                         planner=planner)
+    for spec in specs:
+        router.add_replica(spec.name, spec.announce_path,
+                           hb_path=spec.hb_path)
+    return sup, router
+
+
+def scale_out(sup, router, fleet_dir: str, name: str,
+              replica_argv) -> None:
+    """Add one replica to a RUNNING ``launch_fleet`` fleet: same
+    announce/hb lanes, same argv factory. The joiner warms from the
+    shared AOT store (the fleet already paid every compile) and the
+    router health-probes it before routing traffic its way."""
+    from nds_tpu.resilience.supervise import ReplicaSpec
+    ann = os.path.join(fleet_dir, "announce", f"{name}.json")
+    spec = ReplicaSpec(
+        name=name,
+        make_cmd=(lambda inc, n=name, a=ann: replica_argv(n, a, inc)),
+        hb_path=os.path.join(fleet_dir, "hb", f"{name}.json"),
+        announce_path=ann)
+    router.add_replica(spec.name, spec.announce_path,
+                       hb_path=spec.hb_path)
+    sup.add_replica(spec)

@@ -3,16 +3,42 @@
 Protocol (one JSON object per line, UTF-8):
 
   request:  {"tenant": "t0", "suite": "nds_h", "sql": "select ...",
-             "qname": "query5#3"}
+             "qname": "query5#3", "id": "r-17"}
   response: {"status": "ok"|"shed"|"error", "qname", "tenant",
-             "elapsed_ms", "rows", "digest", "error"?, "shed_reason"?}
+             "elapsed_ms", "rows", "digest", "error"?, "shed_reason"?,
+             "id"?}
+  control:  {"op": "ping", "id"?} ->
+            {"op": "ping", "status": "ok", "engine_alive": true,
+             "queue_depth": N, "inflight": N, "completed": N,
+             "replica"?, "id"?}
 
-The coroutines here never touch the engine: ``QueryServer.submit``
-enqueues onto the engine thread and returns a concurrent Future the
-handler awaits via ``asyncio.wrap_future`` — no blocking calls inside
-the event loop (ndslint NDS115 enforces that for this package).  One
-malformed line answers with a status "error" object instead of killing
-the connection; EOF closes it.
+The ``id`` field is the fleet router's redelivery handle: a response
+echoes its request's ``id`` verbatim, and requests carrying ids are
+PIPELINED — the handler submits every parsed line immediately and
+writes each response as its future resolves, so many requests ride one
+connection concurrently (responses may reorder across ids; requests
+without ids keep strict one-in-flight FIFO semantics on the client
+side, which is what ``request_many`` does). ``op: ping`` is the
+app-level health probe: answered from the handler with the engine
+thread's liveness, never queued behind traffic, so a router can
+distinguish "engine wedged" from "engine busy".
+
+Hostile/stalled clients cannot pin resources: each connection has a
+read deadline (``serve.net.read_timeout_s``) after which the reader
+coroutine sheds with an explicit status and closes (counted in
+``server_conn_timeouts_total``), and a max line length
+(``serve.net.max_line_bytes``, enforced via the StreamReader limit) so
+an endless unterminated line can never buffer unbounded bytes
+(``server_conn_overruns_total``; the connection closes — a mid-line
+stream cannot be resynced safely). In-flight responses still deliver
+before the close. Every cross-process await here sits under an
+``asyncio.wait_for`` deadline (ndslint NDS118 enforces that for this
+package): the front must never be able to hang on one dead peer.
+
+The coroutines never touch the engine: ``QueryServer.submit`` enqueues
+onto the engine thread and returns a concurrent Future the handler
+awaits via ``asyncio.wrap_future`` — no blocking calls inside the
+event loop (ndslint NDS115).
 """
 
 from __future__ import annotations
@@ -21,54 +47,169 @@ import asyncio
 import dataclasses
 import json
 
-from nds_tpu.serve.server import QueryServer, Response
+from nds_tpu.obs import metrics as obs_metrics
+from nds_tpu.serve.server import ERROR, SHED, QueryServer, Response
+
+DEFAULT_READ_TIMEOUT_S = 300.0
+DEFAULT_MAX_LINE_BYTES = 1 << 20
+# bounded write/drain: a peer that stops reading must not pin a writer
+WRITE_TIMEOUT_S = 60.0
+# how long a closing connection waits for already-admitted requests'
+# responses to deliver before dropping them
+CLOSE_LINGER_S = 600.0
 
 
-def _encode(resp: Response) -> bytes:
+def net_limits(config=None) -> tuple[float, int]:
+    """(read_timeout_s, max_line_bytes) from ``serve.net.*`` config
+    keys (0/negative read timeout = no deadline)."""
+    timeout, max_line = DEFAULT_READ_TIMEOUT_S, DEFAULT_MAX_LINE_BYTES
+    if config is not None:
+        try:
+            timeout = float(config.get("serve.net.read_timeout_s",
+                                       timeout))
+        except (TypeError, ValueError):
+            pass
+        try:
+            max_line = int(config.get("serve.net.max_line_bytes",
+                                      max_line))
+        except (TypeError, ValueError):
+            pass
+    return timeout, max(1024, max_line)
+
+
+def _doc_bytes(doc: dict) -> bytes:
+    return (json.dumps(doc) + "\n").encode()
+
+
+def _encode(resp: Response, rid=None) -> bytes:
     doc = {k: v for k, v in dataclasses.asdict(resp).items()
            if v is not None}
-    return (json.dumps(doc) + "\n").encode()
+    if rid is not None:
+        doc["id"] = rid
+    return _doc_bytes(doc)
 
 
 async def handle_connection(server: QueryServer,
                             reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
+    read_timeout, _ = net_limits(getattr(server, "config", None))
+    wlock = asyncio.Lock()
+    tasks: set = set()
+
+    async def _write(payload: bytes) -> None:
+        async with wlock:
+            writer.write(payload)
+            await asyncio.wait_for(writer.drain(),
+                                   timeout=WRITE_TIMEOUT_S)
+
+    async def _answer(fut, rid) -> None:
+        resp = await asyncio.wrap_future(fut)
+        try:
+            await _write(_encode(resp, rid))
+        except (OSError, asyncio.TimeoutError):
+            # connection died while answering: the requester is gone;
+            # the fleet router's journal/redelivery is the recovery
+            obs_metrics.counter("server_conn_lost_responses_total").inc()
+
     try:
         while True:
-            line = await reader.readline()
+            try:
+                if read_timeout > 0:
+                    line = await asyncio.wait_for(
+                        reader.readline(), timeout=read_timeout)
+                else:
+                    line = await asyncio.wait_for(
+                        reader.readline(), timeout=CLOSE_LINGER_S)
+            except asyncio.TimeoutError:
+                # stalled client: shed the CONNECTION with an explicit
+                # status — a silent close would look like a crash
+                obs_metrics.counter("server_conn_timeouts_total").inc()
+                try:
+                    await _write(_doc_bytes(
+                        {"status": SHED,
+                         "shed_reason": f"conn-read-timeout:"
+                                        f"{read_timeout:g}s"}))
+                except (OSError, asyncio.TimeoutError):
+                    pass
+                break
+            except ValueError:
+                # line exceeded the StreamReader limit (max_line_bytes
+                # set in start_tcp): the stream is mid-line and cannot
+                # be resynced — answer and close
+                obs_metrics.counter("server_conn_overruns_total").inc()
+                try:
+                    await _write(_doc_bytes(
+                        {"status": SHED,
+                         "shed_reason": "line-too-long"}))
+                except (OSError, asyncio.TimeoutError):
+                    pass
+                break
             if not line:
                 break
             try:
                 doc = json.loads(line)
+            except ValueError as exc:
+                await _write(_doc_bytes(
+                    {"status": ERROR, "error": f"bad request: {exc}"}))
+                continue
+            rid = doc.get("id")
+            if isinstance(doc, dict) and doc.get("op") == "ping":
+                pong = {"op": "ping", "status": "ok"}
+                ping = getattr(server, "ping", None)
+                if callable(ping):
+                    pong.update(ping())
+                if rid is not None:
+                    pong["id"] = rid
+                await _write(_doc_bytes(pong))
+                continue
+            try:
                 fut = server.submit(str(doc.get("tenant", "anon")),
                                     str(doc.get("suite", "nds_h")),
                                     str(doc["sql"]),
                                     str(doc.get("qname", "")))
             except Exception as exc:  # noqa: BLE001 - bad line answers
-                writer.write(_encode(Response(
-                    "error", error=f"bad request: {exc}")))
-                await writer.drain()
+                await _write(_doc_bytes(
+                    {"status": ERROR, "error": f"bad request: {exc}",
+                     **({"id": rid} if rid is not None else {})}))
                 continue
-            resp = await asyncio.wrap_future(fut)
-            writer.write(_encode(resp))
-            await writer.drain()
+            # pipelined: submit now, answer when the engine resolves —
+            # the queue (not the connection) is where requests wait
+            t = asyncio.ensure_future(_answer(fut, rid))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
     finally:
+        if tasks:
+            # admitted requests still get their answers before the
+            # close (bounded: the engine's shed-not-crash contract
+            # resolves every future, but a wedged engine must not pin
+            # this coroutine forever)
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*list(tasks), return_exceptions=True),
+                    timeout=CLOSE_LINGER_S)
+            except asyncio.TimeoutError:
+                for t in list(tasks):
+                    t.cancel()
         writer.close()
 
 
 async def start_tcp(server: QueryServer, host: str = "127.0.0.1",
                     port: int = 0) -> asyncio.AbstractServer:
     """Bind and return the asyncio server (``port=0`` picks a free
-    port; read it from ``srv.sockets[0].getsockname()``)."""
+    port; read it from ``srv.sockets[0].getsockname()``). The
+    StreamReader limit is ``serve.net.max_line_bytes``."""
+    _, max_line = net_limits(getattr(server, "config", None))
 
     async def _handler(reader, writer):
         await handle_connection(server, reader, writer)
 
-    return await asyncio.start_server(_handler, host, port)
+    return await asyncio.start_server(_handler, host, port,
+                                      limit=max_line)
 
 
 async def request_many(host: str, port: int, docs: list,
-                       concurrency: int = 8) -> list:
+                       concurrency: int = 8,
+                       timeout_s: float = 600.0) -> list:
     """Client helper (tools/ndsload.py): fire ``docs`` with up to
     ``concurrency`` connections, one in-flight request per connection,
     preserving per-doc response pairing. Returns response dicts in
@@ -77,13 +218,16 @@ async def request_many(host: str, port: int, docs: list,
     idx = iter(range(len(docs)))
 
     async def worker():
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout=timeout_s)
         try:
             for i in idx:
                 try:
                     writer.write((json.dumps(docs[i]) + "\n").encode())
-                    await writer.drain()
-                    line = await reader.readline()
+                    await asyncio.wait_for(writer.drain(),
+                                           timeout=timeout_s)
+                    line = await asyncio.wait_for(reader.readline(),
+                                                  timeout=timeout_s)
                 except Exception as exc:  # noqa: BLE001 - per-doc
                     out[i] = {"status": "error",
                               "error": f"{type(exc).__name__}: {exc}"}

@@ -71,6 +71,7 @@ class Response:
     digest: "str | None" = None
     error: "str | None" = None
     shed_reason: "str | None" = None
+    replica: "str | None" = None
 
 
 def _tenant_counter(name: str, tenant: str):
@@ -113,6 +114,13 @@ class QueryServer:
         self.stats = {"submitted": 0, "completed": 0, "shed": 0,
                       "errors": 0, "batched": 0,
                       "max_inflight": 0}
+        # fleet identity: set by serve/replica.py (or the
+        # NDS_TPU_REPLICA env the supervisor arms) so every response,
+        # summary, and labeled metric names which ring member answered
+        self.replica_id = (os.environ.get("NDS_TPU_REPLICA")
+                           or str(self.config.get("serve.replica_id",
+                                                  "") or "")
+                           or None)
         # query-boundary pipelining (engine/pipeline_io.py; README
         # "Pipelined execution"): with engine.prefetch.boundary on the
         # engine thread dispatches request N+1 while request N's
@@ -197,6 +205,38 @@ class QueryServer:
                     break
                 req = self._queue.popleft()
             self._finish_shed(req, "server-stopping")
+
+    def begin_drain(self) -> None:
+        """Stop ADMITTING without stopping SERVING: new submits shed
+        ``server-stopping`` (the fleet router redelivers those — they
+        are departure notices, not answers) while the engine thread
+        keeps draining the backlog. The drain sequence is
+        ``begin_drain()`` → wait for in-flight to reach zero (bounded
+        by ``engine.drain_s``) → ``stop()``; serve/replica.py runs it
+        on SIGTERM before exiting 75 (resumable)."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def ping(self) -> dict:
+        """App-level health probe payload (``op: ping`` on the TCP
+        front; never routed through the request queue, so a saturated
+        queue reads as BUSY — deep queue, live engine — while a wedged
+        or dead engine thread reads as UNHEALTHY)."""
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._cv:
+            depth = len(self._queue)
+            draining = self._stopped and self._running
+        with self._lock:
+            inflight = self._inflight
+            completed = self.stats["completed"]
+        doc = {"engine_alive": alive, "queue_depth": depth,
+               "inflight": inflight, "completed": completed}
+        if draining:
+            doc["draining"] = True
+        if self.replica_id:
+            doc["replica"] = self.replica_id
+        return doc
 
     # ------------------------------------------------------ admission
 
@@ -470,6 +510,7 @@ class QueryServer:
         summary = report.end_async(error=err)
         elapsed_ms = (time.monotonic() - pend["t0"]) * 1000
         report.attach_tenant(req.tenant)
+        report.attach_replica(self.replica_id)
         from nds_tpu.resilience.retry import RetryStats
         ex = s._executor_factory(s.tables)
         report.attach_retry(getattr(ex, "last_stats", None)
@@ -495,7 +536,8 @@ class QueryServer:
         if not self._resolve(req, Response(
                 OK, qname=req.qname, tenant=req.tenant,
                 elapsed_ms=round(elapsed_ms, 3),
-                rows=getattr(res, "nrows", 0), digest=digest)):
+                rows=getattr(res, "nrows", 0), digest=digest,
+                replica=self.replica_id)):
             return
         with self._lock:
             self.stats["completed"] += 1
@@ -521,7 +563,7 @@ class QueryServer:
     def _finish_shed(self, req: Request, reason: str) -> None:
         if not self._resolve(req, Response(
                 SHED, qname=req.qname, tenant=req.tenant,
-                shed_reason=reason)):
+                shed_reason=reason, replica=self.replica_id)):
             return
         with self._lock:
             self.stats["shed"] += 1
@@ -532,7 +574,7 @@ class QueryServer:
     def _finish_error(self, req: Request, error: str) -> None:
         if not self._resolve(req, Response(
                 ERROR, qname=req.qname, tenant=req.tenant,
-                error=error)):
+                error=error, replica=self.replica_id)):
             return
         with self._lock:
             self.stats["errors"] += 1

@@ -151,6 +151,55 @@ Serving keys (the query server, nds_tpu/serve/ — README "Serving"):
                             ``ndsreport analyze`` reports serving
                             p50/p99 like any run dir (unset = no
                             summaries)
+  serve.replica_id          fleet identity stamped on responses,
+                            summaries, and tenant metrics (usually
+                            injected by the supervisor via
+                            NDS_TPU_REPLICA, which wins over this
+                            key; unset = single-server mode)
+
+Serve-fleet keys (router + replicas, nds_tpu/serve/fleet.py — README
+"Serve fleet"):
+
+  serve.net.read_timeout_s  per-connection read deadline on the TCP
+                            front: a peer silent this long is cut
+                            (shed notice "conn-read-timeout:<t>s",
+                            server_conn_timeouts_total; default 300,
+                            0/negative = no deadline)
+  serve.net.max_line_bytes  JSON-lines frame bound: a longer line
+                            sheds "line-too-long" and closes the
+                            connection (server_conn_overruns_total;
+                            default 1 MiB, floor 1024)
+  serve.fleet.ping_interval_s
+                            router health-probe cadence per replica
+                            (announce re-read + op:ping; default 0.5)
+  serve.fleet.ping_timeout_s
+                            deadline on one probe round-trip
+                            (default 5)
+  serve.fleet.ping_misses   consecutive probe misses before the
+                            router ejects a replica from the healthy
+                            ring (default 3; supervisor membership
+                            "down" events eject immediately)
+  serve.fleet.hb_stale_s    optional heartbeat-file staleness bound:
+                            effective age = (now - snapshot mtime) +
+                            youngest in-file heartbeat age; older
+                            than this counts as a probe miss (0 =
+                            off, default — the app-level ping is the
+                            primary signal)
+  serve.fleet.request_timeout_s
+                            end-to-end deadline the router puts on
+                            one dispatched request (default 600);
+                            expiry triggers redelivery, not an error
+  serve.fleet.redeliver_max how many times one request may be
+                            redelivered after connection loss or a
+                            departure notice before the router
+                            answers "redeliver-exhausted" (default 4)
+  serve.fleet.max_pending   router admission bound: submits beyond
+                            this many in-flight requests shed
+                            "router-admission" (default 0 = derive
+                            healthy-ring-size x serve.max_queue)
+  serve.fleet.member_wait_s how long one dispatch attempt waits for
+                            ANY healthy replica before falling back /
+                            shedding (default 30)
 
 Observability keys (cost ledger + device telemetry, nds_tpu/obs/ —
 README "Cost ledger & telemetry"):

@@ -394,6 +394,14 @@ class BenchReport:
         if tenant:
             self.summary["tenant"] = str(tenant)
 
+    def attach_replica(self, replica: str | None) -> None:
+        """Fleet attribution (nds_tpu/serve/fleet.py): which engine
+        replica answered the request this summary bills. Absent on
+        single-process serving; ndsreport analyze rolls per-replica
+        latency quantiles over it and flags divergent replicas."""
+        if replica:
+            self.summary["replica"] = str(replica)
+
     def attach_incarnation(self, incarnation: int | None) -> None:
         """Record which resume incarnation produced this summary
         (resilience/journal.QueryJournal). 0 = the original process;

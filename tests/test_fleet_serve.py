@@ -1,0 +1,387 @@
+"""Replicated serve fleet (nds_tpu/serve/fleet.py + replica.py):
+
+- template_digest affinity keys: literal variants of one template
+  share a digest, templates/suites split;
+- RequestJournal accounting: accept/assign/settle, first-final-wins
+  duplicate suppression, lost/double detection, atomic persistence;
+- ndsload chaos schedule parsing + replica incarnation parsing +
+  serve.net limit config;
+- NDS118 ``undeadlined-await`` lint rule (fixtures + the real serve
+  tree must be clean);
+- the live-fleet contract (subprocess replicas): SIGTERM drain under
+  ``engine.prefetch.boundary=on`` finishes every in-flight request,
+  exits 75, resumes warm, and is re-admitted — with the router's
+  journal clean throughout (zero lost, zero double-answered).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from nds_tpu.serve.fleet import RequestJournal, template_digest
+
+
+# ---------------------------------------------------- affinity digest
+
+class TestTemplateDigest:
+    def test_literal_variants_share(self):
+        a = template_digest(
+            "nds_h", "select * from t where a > 42 and b = 'x'")
+        b = template_digest(
+            "nds_h", "select * from t where a > 7 and b = 'other'")
+        assert a == b
+
+    def test_templates_and_suites_split(self):
+        base = template_digest("nds", "select a from t")
+        assert template_digest("nds", "select b from t") != base
+        assert template_digest("nds_h", "select a from t") != base
+
+    def test_quoted_quote_stays_one_literal(self):
+        a = template_digest("nds", "select * from t where x = 'a''b'")
+        b = template_digest("nds", "select * from t where x = 'c'")
+        assert a == b
+
+
+# ----------------------------------------------------- request journal
+
+class TestRequestJournal:
+    def _mk(self, tmp_path):
+        return RequestJournal(str(tmp_path / "journal.json"))
+
+    def test_accept_settle_verify_clean(self, tmp_path):
+        j = self._mk(tmp_path)
+        j.accept("r-1", "tenant0", "nds", "q1", "abc")
+        j.assign("r-1", "r0")
+        out = j.settle("r-1", {"status": "ok", "digest": "d"})
+        assert out["status"] == "ok"
+        v = j.verify()
+        assert v["accepted"] == 1 and v["settled"] == 1
+        assert v["lost"] == [] and v["double"] == []
+
+    def test_unsettled_is_lost(self, tmp_path):
+        j = self._mk(tmp_path)
+        j.accept("r-1", "t", "nds", "q1", None)
+        j.accept("r-2", "t", "nds", "q2", None)
+        j.settle("r-2", {"status": "ok"})
+        assert j.verify()["lost"] == ["r-1"]
+
+    def test_duplicate_settle_keeps_canonical(self, tmp_path):
+        j = self._mk(tmp_path)
+        j.accept("r-1", "t", "nds", "q1", None)
+        first = j.settle("r-1", {"status": "ok", "digest": "first"})
+        again = j.settle("r-1", {"status": "ok", "digest": "second"})
+        # first final answer wins; the duplicate is returned AS the
+        # canonical response, never surfaced to the caller
+        assert first["digest"] == "first"
+        assert again["digest"] == "first"
+        assert j.verify()["double"] == ["r-1"]
+
+    def test_late_settle_clears_lost(self, tmp_path):
+        j = self._mk(tmp_path)
+        j.accept("r-1", "t", "nds", "q1", None)
+        assert j.verify()["lost"] == ["r-1"]
+        j.settle("r-1", {"status": "ok"})
+        assert j.verify()["lost"] == []
+
+    def test_persists_across_reopen(self, tmp_path):
+        path = str(tmp_path / "journal.json")
+        j = RequestJournal(path)
+        j.accept("r-1", "tenant0", "nds", "q1", "abc")
+        j.assign("r-1", "r0")
+        j.settle("r-1", {"status": "ok", "digest": "d"})
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["accepted"]["r-1"]["assignments"] == ["r0"]
+        assert doc["outcomes"]["r-1"]["status"] == "ok"
+        # the full response body is NOT persisted (journal stays
+        # small); the accounting fields are
+        assert "response" not in doc["outcomes"]["r-1"]
+
+
+# ------------------------------------------- chaos + replica parsing
+
+class TestFleetParsing:
+    def test_kill_schedule(self):
+        import signal as sg
+
+        import ndsload
+        evs = ndsload.parse_kill_schedule(
+            ["replica=1@2.5,TERM", "replica=r0@0.5"])
+        assert [e["t"] for e in evs] == [0.5, 2.5]
+        assert evs[0]["signal"] == int(sg.SIGKILL)
+        assert evs[1]["signal"] == int(sg.SIGTERM)
+        assert evs[1]["replica"] == "1"
+
+    def test_kill_schedule_rejects_garbage(self):
+        import ndsload
+        with pytest.raises(ValueError):
+            ndsload.parse_kill_schedule(["replica=r0"])
+        with pytest.raises(ValueError):
+            ndsload.parse_kill_schedule(["replica=r0@1,NOPE"])
+
+    def test_parse_incarnation(self):
+        from nds_tpu.serve.replica import parse_incarnation
+        assert parse_incarnation(None) == 0
+        assert parse_incarnation("r0") == 0
+        assert parse_incarnation("r0#r3") == 3
+        assert parse_incarnation("r0#rx") == 0
+
+    def test_net_limits(self):
+        from nds_tpu.serve.net import (
+            DEFAULT_MAX_LINE_BYTES, DEFAULT_READ_TIMEOUT_S, net_limits,
+        )
+        from nds_tpu.utils.config import EngineConfig
+        assert net_limits(None) == (DEFAULT_READ_TIMEOUT_S,
+                                    DEFAULT_MAX_LINE_BYTES)
+        cfg = EngineConfig(overrides={
+            "serve.net.read_timeout_s": "5.5",
+            "serve.net.max_line_bytes": "10",
+        })
+        t, n = net_limits(cfg)
+        assert t == 5.5
+        assert n == 1024  # floor: a limit below one frame is a DoS
+
+
+# --------------------------------------------------------- NDS118 rule
+
+class TestUndeadlinedAwaitRule:
+    def _lint(self, src, path="nds_tpu/serve/mod.py"):
+        from nds_tpu.analysis.lint_rules import lint_sources
+        return lint_sources({path: src}, enabled={"NDS118"})
+
+    def test_flags_bare_stream_awaits(self):
+        src = ("import asyncio\n"
+               "async def h(reader, writer):\n"
+               "    line = await reader.readline()\n"
+               "    await writer.drain()\n"
+               "    r, w = await asyncio.open_connection('h', 1)\n"
+               "    return line, r, w\n")
+        res = self._lint(src)
+        assert {v.line for v in res.violations} == {3, 4, 5}
+
+    def test_wait_for_wrapped_is_clean(self):
+        src = ("import asyncio\n"
+               "async def h(reader, writer):\n"
+               "    line = await asyncio.wait_for(\n"
+               "        reader.readline(), timeout=5)\n"
+               "    await asyncio.wait_for(writer.drain(), 2)\n"
+               "    return line\n")
+        assert self._lint(src).violations == []
+
+    def test_timeout_block_is_clean(self):
+        src = ("import asyncio\n"
+               "async def h(reader):\n"
+               "    async with asyncio.timeout(3):\n"
+               "        return await reader.readline()\n")
+        assert self._lint(src).violations == []
+
+    def test_nested_coroutine_not_covered_by_outer_timeout(self):
+        # the nested coroutine RUNS wherever it is awaited — the
+        # enclosing block's deadline does not travel with it
+        src = ("import asyncio\n"
+               "async def outer(reader):\n"
+               "    async with asyncio.timeout(3):\n"
+               "        async def inner():\n"
+               "            return await reader.readline()\n"
+               "        return inner\n")
+        res = self._lint(src)
+        assert [v.line for v in res.violations] == [5]
+
+    def test_non_stream_awaits_are_clean(self):
+        src = ("import asyncio\n"
+               "async def h(fut):\n"
+               "    await asyncio.sleep(1)\n"
+               "    return await fut\n")
+        assert self._lint(src).violations == []
+
+    def test_scoped_to_serve_package(self):
+        src = ("async def h(reader):\n"
+               "    return await reader.readline()\n")
+        res = self._lint(src, path="nds_tpu/engine/x.py")
+        assert res.violations == []
+
+    def test_waiver_honored(self):
+        src = ("async def h(reader):\n"
+               "    return await reader.readline()  "
+               "# ndslint: waive[NDS118] -- test fixture\n")
+        res = self._lint(src)
+        assert res.violations == [] and len(res.waived) == 1
+
+    def test_serve_tree_is_clean(self):
+        from nds_tpu.analysis.lint_rules import lint_sources
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        srcs = {}
+        sdir = os.path.join(root, "nds_tpu", "serve")
+        for f in os.listdir(sdir):
+            if f.endswith(".py"):
+                rel = f"nds_tpu/serve/{f}"
+                srcs[rel] = open(os.path.join(sdir, f)).read()
+        res = lint_sources(srcs, enabled={"NDS118"})
+        assert res.violations == []
+
+    def test_in_default_rules(self):
+        from nds_tpu.analysis.lint_rules import default_rules
+        assert any(r.id == "NDS118" for r in default_rules())
+
+
+# ------------------------------------- single-replica boundary drain
+
+class TestSingleReplicaDrain:
+    """One replica, NO router: SIGTERM lands while requests are in
+    flight on a live connection. Because there is no redelivery to
+    mask a drop, every answer that arrives after the signal proves
+    the drain FINISHED the in-flight work (including the
+    boundary-overlapped request under engine.prefetch.boundary=on)
+    before exiting 75."""
+
+    def test_drain_finishes_inflight_then_exit_75(self, tmp_path):
+        import json as _json
+        import signal
+        import subprocess
+
+        import ndsload
+        wd = str(tmp_path)
+        argv = ndsload.fleet_replica_argv(wd, 0.01, max_queue=32,
+                                          boundary="on")
+        ann = os.path.join(wd, "announce.json")
+        proc = subprocess.Popen(argv("solo", ann, 0))
+        try:
+            deadline = time.time() + 300
+            while time.time() < deadline and not os.path.exists(ann):
+                time.sleep(0.1)
+            assert os.path.exists(ann), "replica never announced"
+            with open(ann) as f:
+                port = _json.load(f)["port"]
+            rc = asyncio.run(self._drive(proc, port, signal.SIGTERM))
+            assert rc == 75, f"drain exited {rc}, want 75"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    async def _drive(self, proc, port, sig):
+        import ndsload
+        from nds_tpu.serve.fleet import ReplicaClient
+        client = ReplicaClient("solo", "127.0.0.1", port)
+        await client.connect()
+        try:
+            warm = dict(ndsload.warmup_docs(3, (), (96,))[0],
+                        id="warm-0")
+            w = await client.request(warm, timeout=300)
+            assert w.get("status") == "ok", w
+
+            docs = [dict(d, id=f"t-{i}") for i, d in enumerate(
+                ndsload.build_requests(4, 5, tenants=1,
+                                       nds_h_templates=(),
+                                       nds_templates=(96,)))]
+            tasks = [asyncio.ensure_future(
+                client.request(d, timeout=120)) for d in docs]
+            # let them reach the engine queue, then signal mid-flight
+            await asyncio.sleep(0.3)
+            proc.send_signal(sig)
+            resp = await asyncio.gather(*tasks)
+            for r in resp:
+                assert r.get("status") == "ok", r
+        finally:
+            await client.close()
+        return await asyncio.get_running_loop().run_in_executor(
+            None, proc.wait)
+
+
+# ------------------------------------------- live fleet drain/resume
+
+class TestFleetDrainResume:
+    """One real 2-replica fleet, replicas running with
+    ``engine.prefetch.boundary=on``: a SIGTERM drain mid-load must
+    finish every accepted request (including the boundary-overlapped
+    one), exit 75, resume warm, and pass the health probe back into
+    the ring — journal clean throughout."""
+
+    def test_drain_resume_readmission(self, tmp_path):
+        import ndsload
+        from nds_tpu.serve.fleet import launch_fleet
+        from nds_tpu.utils.config import EngineConfig
+
+        wd = str(tmp_path)
+        cfg = EngineConfig(overrides={
+            "serve.max_queue": "32",
+            "serve.fleet.max_pending": "128",
+            "serve.fleet.ping_interval_s": "0.25",
+            "serve.fleet.ping_timeout_s": "3",
+        })
+        sup, router = launch_fleet(
+            os.path.join(wd, "fleet"), ["r0", "r1"],
+            ndsload.fleet_replica_argv(wd, 0.01, max_queue=32,
+                                       boundary="on"),
+            config=cfg, stall_s=10.0)
+        sup.start()
+        try:
+            summary = asyncio.run(self._drive(sup, router))
+        finally:
+            sup.stop()
+        r0 = summary["replicas"]["r0"]
+        assert 75 in r0["exit_codes"], r0
+        assert r0["resumes"] == 1 and r0["restarts"] == 0, r0
+
+    async def _drive(self, sup, router):
+        import ndsload
+        await router.start()
+        try:
+            assert await router.wait_admitted(2, 300), \
+                f"never admitted: {router.healthy_replicas()}"
+            warm = await ndsload.run_router(
+                router, ndsload.warmup_docs(3, (1,), (96,)), 1)
+            ws = ndsload.summarize(warm)
+            assert ws["status"].get("ok") == len(warm), ws
+
+            docs = ndsload.build_requests(
+                10, 5, tenants=2, nds_h_templates=(1,),
+                nds_templates=(96,))
+            done = {"n": 0}
+
+            async def one(doc):
+                resp = await router.submit(doc)
+                done["n"] += 1
+                return resp
+
+            async def drain_mid_load():
+                while done["n"] < 2:
+                    await asyncio.sleep(0.05)
+                sup.drain("r0")
+
+            results = await asyncio.gather(
+                drain_mid_load(), *[one(d) for d in docs])
+            resp = results[1:]
+            ls = ndsload.summarize(resp)
+            # the drain sheds nothing to the CALLER: departures are
+            # redelivered by the router, in-flight work finishes on
+            # the draining replica
+            assert ls["status"].get("ok") == len(docs), ls
+            v = router.journal.verify()
+            assert not v["lost"] and not v["double"], v
+
+            # exit 75 -> warm resume -> health probe -> re-admission
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if "r0" in router.healthy_replicas():
+                    break
+                await asyncio.sleep(0.25)
+            assert "r0" in router.healthy_replicas(), \
+                router.healthy_replicas()
+            post = await ndsload.run_router(
+                router, ndsload.build_requests(
+                    4, 9, tenants=1, nds_h_templates=(1,),
+                    nds_templates=(96,)), 2)
+            ps = ndsload.summarize(post)
+            assert ps["status"].get("ok") == len(post), ps
+        finally:
+            await router.stop()
+        return sup.summary()

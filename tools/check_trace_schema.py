@@ -203,6 +203,10 @@ def validate_summary(obj: object) -> list[str]:
     if "tenant" in obj and (not isinstance(obj["tenant"], str)
                             or not obj["tenant"]):
         errs.append(f"bad tenant {obj.get('tenant')!r}")
+    # fleet serving (nds_tpu/serve/fleet.py): which replica answered
+    if "replica" in obj and (not isinstance(obj["replica"], str)
+                             or not obj["replica"]):
+        errs.append(f"bad replica {obj.get('replica')!r}")
     if "stale_device_times" in obj and obj["stale_device_times"] \
             is not True:
         errs.append(f"bad stale_device_times "

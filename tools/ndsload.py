@@ -24,6 +24,18 @@ CLI (standalone, against a running TCP server):
 
   python tools/ndsload.py --host 127.0.0.1 --port 9321 \
       --requests 64 --concurrency 8 --tenants 4 --seed 7
+
+Fleet mode (README "Serve fleet") spins a supervised replica fleet up
+in-process and drives it through the FleetRouter, with an optional
+SEEDED chaos schedule — kill/drain specific replicas at specific
+offsets into the load phase, reproducibly, from the CLI:
+
+  python tools/ndsload.py --fleet 3 --requests 64 --concurrency 16 \
+      --kill replica=1@2.0,KILL --kill replica=2@3.5,TERM
+
+The final report gains a per-replica breakdown (request counts,
+status mix, latency quantiles per ring member) plus the router
+journal's zero-loss/zero-double verification.
 """
 
 from __future__ import annotations
@@ -33,6 +45,8 @@ import asyncio
 import json
 import os
 import random
+import re
+import signal as _signal
 import sys
 import time
 
@@ -45,6 +59,14 @@ DEFAULT_NDS_H = (1, 5, 6)
 DEFAULT_NDS = (7, 96, 93)
 
 MULTIPART_NDS = {14, 23, 24, 39}
+
+# every base table the default NDS serving templates (and their
+# literal variants) scan — the fleet/gen warehouse table list
+# (serve_check and fleet_serve_check generate exactly these)
+GEN_NDS_TABLES = ("store_sales", "store_returns", "date_dim", "store",
+                  "customer", "customer_address",
+                  "customer_demographics", "household_demographics",
+                  "item", "promotion", "reason", "time_dim")
 
 
 def render(suite: str, template: int, rng: random.Random) -> str:
@@ -126,6 +148,24 @@ def summarize(responses: list) -> dict:
            "latency_ms": _quantiles(lat)}
     if shed_reasons:
         out["shed_reasons"] = shed_reasons
+    reps: dict = {}
+    for r in responses:
+        rep = r.get("replica")
+        if rep is None:
+            continue
+        b = reps.setdefault(rep, {"count": 0, "status": {}, "lat": []})
+        b["count"] += 1
+        st = r.get("status", "?")
+        b["status"][st] = b["status"].get(st, 0) + 1
+        if st == "ok":
+            b["lat"].append(float(r.get("elapsed_ms", 0.0)))
+    if reps:
+        # per-replica breakdown: which ring member answered what, and
+        # how fast — the fleet failover report's core table
+        out["replicas"] = {
+            name: {"count": b["count"], "status": b["status"],
+                   "latency_ms": _quantiles(b["lat"])}
+            for name, b in sorted(reps.items())}
     return out
 
 
@@ -167,10 +207,164 @@ def run_tcp(host: str, port: int, docs: list,
     return asyncio.run(request_many(host, port, docs, concurrency))
 
 
+# -------------------------------------------------------------- fleet
+
+async def run_router(router, docs: list, concurrency: int = 8) -> list:
+    """Drive a FleetRouter with at most ``concurrency`` requests in
+    flight (call inside the router's event loop)."""
+    sem = asyncio.Semaphore(max(1, concurrency))
+
+    async def one(doc):
+        async with sem:
+            return await router.submit(doc)
+
+    return list(await asyncio.gather(*[one(d) for d in docs]))
+
+
+def parse_kill_schedule(specs) -> list:
+    """``replica=<idx-or-name>@<t>[,<signal>]`` specs -> sorted event
+    list (signal defaults to KILL; TERM drains). Offsets are seconds
+    into the load phase, so a schedule replays deterministically."""
+    out = []
+    for spec in specs or []:
+        m = re.match(r"replica=([\w-]+)@([0-9.]+)(?:,(\w+))?$",
+                     str(spec))
+        if not m:
+            raise ValueError(
+                f"bad --kill spec {spec!r} "
+                f"(want replica=N@t[,signal])")
+        target, t, signame = m.groups()
+        s = (signame or "KILL").upper()
+        if not s.startswith("SIG"):
+            s = f"SIG{s}"
+        try:
+            signum = getattr(_signal, s)
+        except AttributeError as exc:
+            raise ValueError(f"unknown signal {signame!r} in "
+                             f"{spec!r}") from exc
+        out.append({"replica": target, "t": float(t),
+                    "signal": int(signum), "signame": s})
+    return sorted(out, key=lambda e: e["t"])
+
+
+async def run_chaos(supervisor, schedule: list, names: list) -> list:
+    """Deliver a parsed kill schedule against a running fleet
+    (numeric targets index ``names``). Returns the fired events."""
+    t0 = time.monotonic()
+    fired = []
+    for ev in schedule:
+        delay = ev["t"] - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        name = (names[int(ev["replica"])]
+                if str(ev["replica"]).isdigit() else ev["replica"])
+        print(f"[chaos] t={ev['t']:g}s {ev['signame']} -> {name}",
+              flush=True)
+        supervisor.kill(name, ev["signal"])
+        fired.append({**ev, "replica": name})
+    return fired
+
+
+def fleet_replica_argv(workdir: str, gen_scale: float,
+                       max_queue: int = 16,
+                       boundary: "str | None" = None):
+    """argv factory for gen-warehouse replicas (fleet mode + gate +
+    tests share one launch recipe)."""
+    def replica_argv(name, announce, _inc):
+        argv = [sys.executable, "-m", "nds_tpu.serve.replica",
+                "--name", name, "--announce", announce,
+                "--gen_scale", str(gen_scale),
+                "--gen_nds_tables", ",".join(GEN_NDS_TABLES),
+                "--backend", "tpu",
+                "--cache_dir", os.path.join(workdir, "plancache"),
+                "--summary_dir", os.path.join(workdir, "serve_json"),
+                "--max_queue", str(max_queue),
+                "--property", "engine.retry.base_delay_s=0.01"]
+        if boundary is not None:
+            argv += ["--property",
+                     f"engine.prefetch.boundary={boundary}"]
+        return argv
+    return replica_argv
+
+
+def run_fleet(args, h_tpls, d_tpls) -> int:
+    """--fleet mode: supervised replicas + router in-process, seeded
+    load + seeded chaos, per-replica report + journal verdict."""
+    import tempfile
+
+    from nds_tpu.serve.fleet import launch_fleet
+    from nds_tpu.utils.config import EngineConfig
+
+    schedule = parse_kill_schedule(args.kill)
+    names = [f"r{i}" for i in range(args.fleet)]
+    with tempfile.TemporaryDirectory(prefix="ndsload_fleet_") as wd:
+        cfg = EngineConfig(overrides={
+            "serve.max_queue": str(args.max_queue),
+            "serve.fleet.ping_interval_s": "0.25",
+            "serve.fleet.ping_timeout_s": "3",
+        })
+        sup, router = launch_fleet(
+            os.path.join(wd, "fleet"), names,
+            fleet_replica_argv(wd, args.gen_scale, args.max_queue),
+            config=cfg, stall_s=args.stall_s)
+        sup.start()
+        report: dict = {"seed": args.seed, "fleet": names}
+
+        async def drive():
+            await router.start()
+            if not await router.wait_admitted(args.fleet, 300):
+                raise RuntimeError(
+                    f"fleet never formed: healthy="
+                    f"{router.healthy_replicas()}")
+            t0 = time.monotonic()
+            w = await run_router(
+                router, warmup_docs(args.seed, h_tpls, d_tpls), 1)
+            report["warmup"] = {
+                **summarize(w),
+                "wall_s": round(time.monotonic() - t0, 3)}
+            docs = build_requests(args.requests, args.seed,
+                                  args.tenants, h_tpls, d_tpls)
+            t0 = time.monotonic()
+            results = await asyncio.gather(
+                run_chaos(sup, schedule, names),
+                run_router(router, docs, args.concurrency))
+            report["chaos"] = results[0]
+            report["load"] = {
+                **summarize(results[1]),
+                "wall_s": round(time.monotonic() - t0, 3)}
+            report["journal"] = router.journal.verify()
+            await router.stop()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            report["supervisor"] = sup.stop()
+        print(json.dumps(report, indent=2))
+        ok = report.get("load", {}).get("status", {}).get("ok", 0)
+        j = report.get("journal", {})
+        clean = not j.get("lost") and not j.get("double")
+        return 0 if (ok == args.requests and clean) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--port", type=int, default=None,
+                    help="TCP server to drive (omit with --fleet)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="spin up N supervised gen-warehouse replicas "
+                         "+ router in-process and drive those instead "
+                         "of --port")
+    ap.add_argument("--kill", action="append", default=[],
+                    help="chaos event replica=<idx-or-name>@<t>"
+                         "[,signal], seconds into the load phase "
+                         "(repeatable; fleet mode only)")
+    ap.add_argument("--gen_scale", type=float, default=0.01,
+                    help="fleet-mode warehouse scale factor")
+    ap.add_argument("--max_queue", type=int, default=16,
+                    help="fleet-mode per-replica queue bound")
+    ap.add_argument("--stall_s", type=float, default=10.0,
+                    help="fleet-mode watchdog stall budget")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--tenants", type=int, default=2)
@@ -193,6 +387,13 @@ def main(argv=None) -> int:
                    if x.strip())
     if not h_tpls and not d_tpls:
         ap.error("template pool is empty")
+    if args.fleet:
+        return run_fleet(args, h_tpls, d_tpls)
+    if args.port is None:
+        ap.error("--port is required without --fleet")
+    if args.kill:
+        ap.error("--kill needs --fleet (a bare TCP server has no "
+                 "supervisor to deliver signals)")
 
     report: dict = {"seed": args.seed}
     if args.warmup:

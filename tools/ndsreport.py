@@ -158,6 +158,15 @@ def cmd_analyze(args) -> int:
         print(f"tenant {tenant}: requests={q['requests']} "
               f"p50={q.get('p50_ms')}ms p95={q.get('p95_ms')}ms "
               f"p99={q.get('p99_ms')}ms")
+    for rep, q in sorted((a.get("replicas") or {}).items()):
+        # fleet run dirs: per-replica latency line; OUTLIER means the
+        # replica's p99 diverges >2x from the fleet median — a sick
+        # member, not a workload property
+        flag = "  OUTLIER(p99>2x fleet median)" if q.get(
+            "outlier") else ""
+        print(f"replica {rep}: requests={q['requests']} "
+              f"p50={q.get('p50_ms')}ms p95={q.get('p95_ms')}ms "
+              f"p99={q.get('p99_ms')}ms{flag}")
     if a.get("stale_device_times"):
         print(f"WARNING: {len(a['stale_device_times'])} summar"
               f"{'y' if len(a['stale_device_times']) == 1 else 'ies'} "

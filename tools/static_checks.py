@@ -131,6 +131,22 @@ the one to run locally before pushing:
                         analyze, an overload burst sheds
                         (server_shed_total > 0) without a single
                         error, and the TCP JSON-lines front answers
+ 11b. serve-fleet       replicated fleet gate
+                        (tools/fleet_serve_check.py): 3 real replica
+                        PROCESSES (one started after warmup, warm
+                        from the shared AOT store) behind the
+                        FleetRouter take a mixed literal-variant load
+                        at >=40 concurrency while one replica is
+                        SIGKILLed and another SIGTERMed mid-load
+                        (drain -> exit 75 -> warm resume ->
+                        re-admission); every request completes,
+                        traffic redistributes, the request journal
+                        proves zero lost / zero double-answered,
+                        every response is digest-identical to a
+                        sequential single-engine oracle, every
+                        post-warmup incarnation reports ZERO compiles
+                        / cache misses, and ndsreport analyze derives
+                        the per-replica latency rollup
  12. locksan            runtime lock-order sanitizer verdict
                         (nds_tpu/analysis/locksan.py): a SEEDED
                         inversion + re-entrant acquire on a private
@@ -185,6 +201,7 @@ import check_trace_schema  # noqa: E402
 import compress_check  # noqa: E402
 import cost_check  # noqa: E402
 import fleet_check  # noqa: E402
+import fleet_serve_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
 import ndsjit  # noqa: E402
@@ -384,6 +401,7 @@ def main() -> int:
         ("pipeline", lambda: pipeline_check.main([])),
         ("cost", lambda: cost_check.main([])),
         ("serve", lambda: serve_check.main([])),
+        ("serve-fleet", lambda: fleet_serve_check.main([])),
         ("locksan", run_locksan_check),
         ("jitsan", run_jitsan_check),
     ]
