@@ -102,6 +102,20 @@ fails CI instead of waiting for a human audit:
                             Restores to ``SIG_DFL``/``SIG_IGN`` are
                             clean; anything else needs the chain or a
                             waiver saying why replacement is intended.
+- NDS119 unjournaled-mutation
+                            a direct store into a ``.tables[...]`` /
+                            ``.columns[...]`` catalog (subscript
+                            assign/del, or ``.pop/.setdefault/
+                            .update/.clear`` on it) outside the
+                            journaled machinery (engine/session.py,
+                            engine/dml.py, columnar/delta.py,
+                            io/host_table.py). Warehouse mutation
+                            must flow through Session.register_table
+                            or the DML path so the maintenance commit
+                            journal, delta segments and table-scoped
+                            plan invalidation all observe it — a raw
+                            catalog write is invisible to crash
+                            recovery and serves stale cached plans.
 
 Waivers are per-line: ``# ndslint: waive[NDS1xx] -- justification`` on
 the offending line or the line directly above. The justification is
@@ -1205,6 +1219,76 @@ class UndeadlinedAwaitRule(Rule):
         return out
 
 
+class UnjournaledMutationRule(Rule):
+    """NDS119: a raw store into a ``.tables[...]`` / ``.columns[...]``
+    catalog outside the journaled machinery. The writable warehouse
+    keeps three views consistent — the session catalog, the delta
+    segments/deleted-masks (columnar/delta.py) and the maintenance
+    commit journal (nds/maintenance.py) — and ALL of them hang off the
+    blessed mutation paths: ``Session.register_table``, the DML
+    ``sess.sql`` route and the delta append/delete helpers. A direct
+    subscript write (or ``.pop``/``.update``/``.setdefault``/
+    ``.clear`` on the catalog dict) bypasses table-scoped plan
+    invalidation and crash recovery: cached plans keep serving the old
+    table and a resumed run can double-apply or lose the mutation."""
+
+    id = "NDS119"
+    name = "unjournaled-mutation"
+    paths = ("nds_tpu/",)
+    _CATALOGS = ("tables", "columns")
+    #: the machinery the journal/invalidation contract is BUILT from —
+    #: mutation here is the blessed path itself
+    _ALLOWED = ("nds_tpu/engine/session.py", "nds_tpu/engine/dml.py",
+                "nds_tpu/columnar/delta.py", "nds_tpu/io/host_table.py")
+    _MUTATORS = {"pop", "setdefault", "update", "clear"}
+
+    def applies(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        if any(norm.endswith(a) for a in self._ALLOWED):
+            return False
+        return super().applies(path)
+
+    @classmethod
+    def _catalog_attr(cls, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and node.attr in cls._CATALOGS)
+
+    def check(self, tree, src, path):
+        out = []
+        for n in ast.walk(tree):
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            elif isinstance(n, ast.Delete):
+                targets = n.targets
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and self._catalog_attr(t.value)):
+                    out.append(LintViolation(
+                        self.id, path, n.lineno,
+                        f"direct .{t.value.attr}[...] catalog write "
+                        f"bypasses the DML journal and table-scoped "
+                        f"invalidation: route through "
+                        f"Session.register_table / the sess.sql DML "
+                        f"path / columnar.delta, or waive with why "
+                        f"this store is journal-invisible by design"))
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in self._MUTATORS
+                    and self._catalog_attr(n.func.value)):
+                out.append(LintViolation(
+                    self.id, path, n.lineno,
+                    f".{n.func.value.attr}.{n.func.attr}(...) mutates "
+                    f"a catalog dict outside the journaled machinery: "
+                    f"route through Session.register_table / the "
+                    f"sess.sql DML path / columnar.delta, or waive "
+                    f"with why this store is journal-invisible by "
+                    f"design"))
+        return out
+
+
 def default_rules() -> "list[Rule]":
     return [IdKeyedCacheRule(), RawTimingRule(), UnsyncedTimingRule(),
             PrefixHashRule(), DeadDataclassFieldRule(),
@@ -1214,7 +1298,7 @@ def default_rules() -> "list[Rule]":
             DirectProfilerRule(), UnchainedSignalHandlerRule(),
             BlockingInAsyncRule(), EarlyMaterializationRule(),
             BlockingTransferInStreamLoopRule(),
-            UndeadlinedAwaitRule()]
+            UndeadlinedAwaitRule(), UnjournaledMutationRule()]
 
 
 # -------------------------------------------------------------- driver

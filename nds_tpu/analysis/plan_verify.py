@@ -749,6 +749,7 @@ def estimate_plan(planned: P.PlannedQuery, tables: "dict | None" = None,
             rows0, bytes0 = est.tables.get(node.table, (0, 0))
             # one table scanned by several Scan nodes: rows count once,
             # bytes accumulate per scan (each scan uploads its columns)
+            # ndslint: waive[NDS119] -- est.tables is a local cost-estimate accumulator, not a session catalog
             est.tables[node.table] = (max(rows0, nrows),
                                       bytes0 + nbytes)
     for nrows, nbytes in est.tables.values():

@@ -127,6 +127,18 @@ def table_digest(table) -> str:
     memo = getattr(table, _DIGEST_ATTR, None)
     if memo is not None:
         return memo
+    delta = getattr(table, "_nds_delta", None)
+    if delta is not None:
+        # mutated table: segment-granular composition (base digest +
+        # ordered segment digests + deleted-bitmask digest). Only the
+        # touched table's stamp moves — every other table keeps its
+        # memo, so a delta invalidates nothing it doesn't scan.
+        digest = delta.content_digest()
+        try:
+            setattr(table, _DIGEST_ATTR, digest)
+        except Exception:  # noqa: BLE001 - slotted table
+            pass
+        return digest
     h = hashlib.sha256()
     for name in sorted(table.columns):
         col = table.columns[name]

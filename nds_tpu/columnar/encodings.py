@@ -152,31 +152,33 @@ def raw_nbytes(values: np.ndarray, mask=None) -> int:
 
 # ------------------------------------------------------------- planning
 
-def plan_values(values: np.ndarray, mask=None, *,
-                mode: str | None = None,
-                is_string: bool = False) -> EncSpec | None:
-    """Encoding choice for one column's (possibly padded) value array,
-    or None for the raw path. Deterministic in (content, mode): the
-    same bytes under the same mode always plan the same spec. Forced
-    modes apply exactly ONE family — ``dict`` touches only
-    dictionary-code (string) columns, so a differential run can
-    attribute a reproduction to one encoding."""
+def plan_from_stats(*, rows: int, dtype: str, raw: int,
+                    lo: "int | None", hi: "int | None",
+                    runs: "int | None", has_mask: bool,
+                    is_string: bool = False,
+                    mode: str | None = None) -> EncSpec | None:
+    """The pure decision procedure behind ``plan_values``, driven by
+    exact column statistics instead of the value array. Split out so
+    the delta-segment append path (columnar/delta.py) can MERGE base +
+    segment stats and plan the widened column without an O(rows)
+    re-scan — and, because both paths share this one procedure, a
+    merged-stats plan is provably the plan a fresh process would
+    derive from the concatenated content (the AOT fingerprint stamps
+    content, not specs, so the two must never diverge)."""
     from nds_tpu import columnar
     mode = columnar.mode() if mode is None else mode
-    if mode == "off" or len(values) < MIN_ROWS:
+    if mode == "off" or rows < MIN_ROWS:
         return None
-    if not np.issubdtype(values.dtype, np.number):
+    np_dtype = np.dtype(dtype)
+    if not np.issubdtype(np_dtype, np.number):
         return None
-    rows = len(values)
-    dtype = values.dtype.name
-    raw = raw_nbytes(values, mask)
     cands: list[EncSpec] = []
     forced = mode in ("dict", "bitpack", "rle")
-    if (np.issubdtype(values.dtype, np.integer)
+    if (np.issubdtype(np_dtype, np.integer)
             and mode in ("auto", "dict", "bitpack")
-            and (mode != "dict" or is_string)):
-        lo, hi = _int_bounds(values, mask)
-        bits = _pack_bits_for(hi - lo, values.dtype.itemsize)
+            and (mode != "dict" or is_string)
+            and lo is not None and hi is not None):
+        bits = _pack_bits_for(hi - lo, np_dtype.itemsize)
         if bits:
             cands.append(EncSpec("bitpack", rows, dtype, bits=bits,
                                  lo=lo))
@@ -185,11 +187,11 @@ def plan_values(values: np.ndarray, mask=None, *,
     # splice signed zeros into one run — the decode then flips
     # signbits vs the raw upload, breaking the byte-identical
     # contract (and sign-sensitive math like 1/x)
-    if (mask is None and mode in ("auto", "rle")
-            and not np.issubdtype(values.dtype, np.floating)):
-        runs = _runs_of(values)
+    if (not has_mask and mode in ("auto", "rle")
+            and not np.issubdtype(np_dtype, np.floating)
+            and runs is not None):
         cands.append(EncSpec("rle", rows, dtype, runs=runs))
-    if (mask is not None and rows >= MASK_PACK_MIN_ROWS
+    if (has_mask and rows >= MASK_PACK_MIN_ROWS
             and (mode in ("auto", "bitpack")
                  or (mode == "dict" and is_string))):
         # mask packing rides every candidate, and stands alone when no
@@ -207,6 +209,33 @@ def plan_values(values: np.ndarray, mask=None, *,
         # off the critical path
         return best if enc < raw else None
     return best if enc * GAIN_DEN <= raw * GAIN_NUM else None
+
+
+def plan_values(values: np.ndarray, mask=None, *,
+                mode: str | None = None,
+                is_string: bool = False) -> EncSpec | None:
+    """Encoding choice for one column's (possibly padded) value array,
+    or None for the raw path. Deterministic in (content, mode): the
+    same bytes under the same mode always plan the same spec. Forced
+    modes apply exactly ONE family — ``dict`` touches only
+    dictionary-code (string) columns, so a differential run can
+    attribute a reproduction to one encoding."""
+    from nds_tpu import columnar
+    mode = columnar.mode() if mode is None else mode
+    rows = len(values)
+    if mode == "off" or rows < MIN_ROWS:
+        return None
+    lo = hi = runs = None
+    if np.issubdtype(values.dtype, np.number):
+        if np.issubdtype(values.dtype, np.integer):
+            lo, hi = _int_bounds(values, mask)
+        if mask is None and not np.issubdtype(values.dtype,
+                                              np.floating):
+            runs = _runs_of(values)
+    return plan_from_stats(
+        rows=rows, dtype=values.dtype.name,
+        raw=raw_nbytes(values, mask), lo=lo, hi=hi, runs=runs,
+        has_mask=mask is not None, is_string=is_string, mode=mode)
 
 
 def plan_padded(values: np.ndarray, mask, nrows: int, *,

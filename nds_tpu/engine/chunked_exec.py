@@ -762,16 +762,37 @@ class ChunkedExecutor(dx.DeviceExecutor):
         self._survivor_cache[cache_key] = reduced
         return reduced
 
+    def invalidate_tables(self, names) -> None:
+        """Scoped DML invalidation for the out-of-core engine: beyond
+        the base executor's buffers/bounds/scan-views, drop the mutated
+        tables' survivor copies and every phase-B executor (they embed
+        reduced snapshots; which tables each one streamed isn't
+        recorded, so the conservative drop is the correct one — their
+        compiled programs persist in the AOT cache and re-attach
+        without recompiling)."""
+        super().invalidate_tables(names)
+        touched = set(names)
+        for ck in [ck for ck in self._survivor_cache
+                   if ck[0] in touched]:
+            del self._survivor_cache[ck]
+        self._reduced.clear()
+
     def _chunk_keep_mask(self, table: str, scans: list,
                          need_cols: list) -> np.ndarray:
         t = self.tables[table]
         n = t.nrows
         C = min(self.chunk_rows, max(n, 1))
+        # delta deleted-row bitmask: DF_*-deleted rows never survive
+        # phase A regardless of what the filters say
+        from nds_tpu.columnar import delta
+        live = delta.live_mask(t)
         # an EMPTY filter conjunction accepts every row: if any scan of
         # this table is filterless, no reduction is possible (the one
-        # reduced table serves all scans of it in phase B)
+        # reduced table serves all scans of it in phase B) — beyond
+        # excluding deleted rows
         if any(not s.filters for s in scans):
-            return np.ones(n, dtype=bool)
+            return np.ones(n, dtype=bool) if live is None \
+                else live.copy()
 
         # encoded chunk scans (nds_tpu/columnar/): bitpack-only, with
         # bounds from the WHOLE table, so every chunk of a column
@@ -902,7 +923,7 @@ class ChunkedExecutor(dx.DeviceExecutor):
                     f"chunked scan of {table}: {len(skipped)} filter(s) "
                     f"not chunk-evaluable, re-applied in phase B only "
                     f"({type(skipped[0][1]).__name__})")
-            return keep_np
+            return keep_np if live is None else keep_np & live
         except Exception as exc:  # noqa: BLE001 - conservative fallback
             if isinstance(exc, QueryDeadlineExceeded):
                 # deadlined queries abort; "keep all rows" would turn a
@@ -923,7 +944,8 @@ class ChunkedExecutor(dx.DeviceExecutor):
             TaskFailureCollector.notify(
                 f"chunked scan fell back to full rows for {table}: "
                 f"{type(exc).__name__}: {exc}")
-            return np.ones(n, dtype=bool)
+            return np.ones(n, dtype=bool) if live is None \
+                else live.copy()
         finally:
             # cancel-at-chunk-boundary + unconsumed-buffer release on
             # every exit path (success, fallback, deadline abort, drain)
@@ -985,4 +1007,11 @@ def make_chunked_factory(stream_bytes: int = DEFAULT_STREAM_BYTES,
         return ex
 
     factory.invalidate = holder.clear
+
+    def invalidate_tables(names):
+        ex = holder.get("ex")
+        if ex is not None:
+            ex.invalidate_tables(names)
+
+    factory.invalidate_tables = invalidate_tables
     return factory

@@ -203,6 +203,12 @@ class CpuExecutor:
             col = t.columns[name]
             arr = col.decode() if col.is_string else col.values
             ctx.put((node.binding, name), np.asarray(arr), col.null_mask)
+        from nds_tpu.columnar import delta
+        live = delta.live_mask(t)
+        if live is not None:
+            # delta deleted-row bitmask: DF_*-deleted rows drop out of
+            # every scan before any predicate sees them
+            ctx = ctx.mask(live)
         for pred in node.filters:
             m, mv = self.eval(pred, ctx)
             m = m.astype(bool)

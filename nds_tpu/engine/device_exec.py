@@ -437,6 +437,7 @@ class DeviceExecutor:
         if self._stage_fps.get(temp) == fp:
             return
         self._stage_fps[temp] = fp
+        # ndslint: waive[NDS119] -- executor-internal staged temp table scoped to one plan step and torn down by _unregister_staged; never visible to the session catalog or the DML journal
         self.tables[temp] = table
         self._drop_col_buffers(temp + ".")
         for k in [k for k in self._bounds if k[0] == temp]:
@@ -451,6 +452,24 @@ class DeviceExecutor:
         for d in (self._buffers, self._enc_specs, self._raw_nbytes):
             for k in [k for k in d if k.startswith(prefix)]:
                 del d[k]
+
+    def invalidate_tables(self, names) -> None:
+        """Scoped DML invalidation: drop ONLY the mutated tables'
+        device buffers, host-cached bounds/sorted verdicts, and reduced
+        scan views. Everything else — other tables' buffers, the whole
+        compiled-program cache — survives: programs key on content
+        fingerprints (segment-granular digests for delta tables), so a
+        stale entry can never be SERVED for mutated content, and
+        unaffected queries re-dispatch their warm programs at 0
+        compiles."""
+        for t in set(names):
+            self._drop_col_buffers(f"{t}.")
+            for k in [k for k in self._bounds if k[0] == t]:
+                del self._bounds[k]
+            for ck in [ck for ck in self._scan_views if ck[0] == t]:
+                old = self._scan_views.pop(ck)
+                if isinstance(old, _ReducedScan):
+                    self._drop_col_buffers(old.prefix + ".")
 
     def _staged_effective(self, planned: P.PlannedQuery, key):
         """Resolve plan splitting for `planned`: execute + register any
@@ -553,6 +572,7 @@ class DeviceExecutor:
         """Free everything _register_staged created for a temp table:
         the host table, its fingerprint, and its per-table caches
         (device buffers, bounds, scan views)."""
+        # ndslint: waive[NDS119] -- tear-down of the executor-internal staged temp registered above; the session catalog never saw it
         self.tables.pop(temp, None)
         self._stage_fps.pop(temp, None)
         self._drop_col_buffers(temp + ".")
@@ -747,6 +767,7 @@ class DeviceExecutor:
             timings["bytes_scanned"] = float(
                 sum(b.nbytes for b in bufs.values()))
             self._attach_compression(timings, bufs)
+            self._attach_delta(timings, planned)
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
@@ -798,6 +819,33 @@ class DeviceExecutor:
         if timings.get("bytes_scanned") and raw:
             timings["compression_ratio"] = round(
                 raw / timings["bytes_scanned"], 4)
+
+    def _attach_delta(self, timings: dict, planned) -> None:
+        """Per-query delta accounting (columnar/delta.py): how many
+        append-only segments and deleted-row mask entries rode under
+        the tables THIS query scanned. Emitted only when a scanned
+        table actually carries delta state, so pre-maintenance (and
+        delta-free) summaries stay byte-identical — the ndsreport
+        delta column keys off the field's presence."""
+        from nds_tpu.columnar import delta
+        scanned = {node.table
+                   for root in [planned.root, *planned.scalar_subplans]
+                   for node in P.walk_plan(root)
+                   if isinstance(node, P.Scan)}
+        segments = appended = masked = 0
+        hit = False
+        for t in sorted(scanned):
+            rep = delta.delta_report(self.tables.get(t))
+            if rep is None:
+                continue
+            hit = True
+            segments += rep["segments"]
+            appended += rep["appended_rows"]
+            masked += rep["masked_rows"]
+        if hit:
+            timings["delta_segments"] = float(segments)
+            timings["delta_appended_rows"] = float(appended)
+            timings["delta_masked_rows"] = float(masked)
 
     # ------------------------------------------------- plan cache (AOT)
 
@@ -1128,7 +1176,22 @@ class DeviceExecutor:
                             self._upload_reduced(bufs, rv, name)
                         else:
                             self._upload(bufs, node.table, name)
+                    if rv is None:
+                        # delta deleted-row bitmask rides along as a
+                        # bool buffer the scan's row gate consumes
+                        # (reduced views already gathered it out)
+                        self._upload_live(bufs, node.table)
         return bufs
+
+    def _upload_live(self, bufs: dict, table: str) -> None:
+        from nds_tpu.columnar import delta
+        live = delta.live_mask(self.tables[table])
+        if live is None:
+            return
+        key = f"{table}.__live"
+        if key not in self._buffers:
+            self._buffers[key] = jnp.asarray(live)
+        bufs[key] = self._buffers[key]
 
     # ------------------------------------------- filtered scan reduction
     #
@@ -1199,8 +1262,13 @@ class DeviceExecutor:
             ctx.put((node.binding, name), np.asarray(arr), col.null_mask)
         # ndslint: waive[NDS110] -- expression-evaluation helper inside the device scan path, not a placement: only eval()/like_mask run, never execute()
         helper = cx.CpuExecutor(self.tables)
-        keep = np.ones(t.nrows, dtype=bool)
-        handled = 0
+        from nds_tpu.columnar import delta
+        live = delta.live_mask(t)
+        # seed from the delta deleted-row bitmask: a reduced view then
+        # physically excludes deleted rows and needs no runtime gate
+        keep = np.ones(t.nrows, dtype=bool) if live is None \
+            else live.copy()
+        handled = 1 if live is not None else 0
         for pred in node.filters:
             # under reduced-precision compute (f32/bf16 floats mode) a
             # float predicate can legitimately flip near a boundary
@@ -1488,6 +1556,14 @@ class _Trace:
         else:
             n, nrows, prefix = max(t.nrows, 1), t.nrows, node.table
         row = jnp.arange(n, dtype=jnp.int32) < nrows
+        live = self.bufs.get(f"{node.table}.__live") if rv is None \
+            else None
+        if live is not None:
+            # delta deleted-row bitmask: DF_*-deleted rows leave every
+            # scan's row population before predicates run (base column
+            # buffers stay resident and encoded — deletion is one bool
+            # AND, not a re-upload)
+            row = row & live
         ctx = DCtx(n, row)
         for name, _dt in node.output:
             col = t.columns[name]
@@ -1517,8 +1593,9 @@ class _Trace:
         # arrays are in host storage order with a prefix row mask.
         # Contexts rebuilt elsewhere (hash exchanges, merges) never set
         # it, so a static plan check alone can't mistake an exchanged
-        # build side for a sorted one
-        ctx.pristine = not node.filters
+        # build side for a sorted one. A live mask breaks the
+        # prefix-row-mask property the fast path assumes.
+        ctx.pristine = not node.filters and live is None
         return ctx
 
     def _apply_filter(self, ctx: DCtx, pred: ir.IR) -> DCtx:
@@ -3149,8 +3226,16 @@ def make_device_factory(precision: str = "f64"):
             holder["ex"] = ex
         return ex
 
-    # DML invalidation hook (Session.invalidate): mutated tables need a
-    # fresh executor — buffers, bounds and compiled programs all key on
-    # table contents/shapes
+    # DML invalidation hooks (Session.invalidate): a wholesale
+    # invalidate drops the executor; the SCOPED variant keeps it —
+    # only the mutated tables' buffers/bounds/scan-views go, and every
+    # other table's warm buffers and the whole compile cache survive
     factory.invalidate = holder.clear
+
+    def invalidate_tables(names):
+        ex = holder.get("ex")
+        if ex is not None:
+            ex.invalidate_tables(names)
+
+    factory.invalidate_tables = invalidate_tables
     return factory

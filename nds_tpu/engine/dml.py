@@ -13,20 +13,28 @@ the device engines consume uploaded snapshots — so DML is:
   logic (a row is deleted only where the predicate is TRUE; NULL keeps
   the row), executing any subqueries through the engine first.
 
-After either mutation the session invalidates executor state: device
-buffers, compile caches and plans all key on table contents/shapes, so
-a mutated table must recompile — the analog of Spark re-planning after
-a table version change.
+Mutations land as DELTAS (`columnar/delta.py`), not rewrites: inserts
+append a segment (numeric concat + dictionary-size string merge, specs
+re-derived from exact merged stats), deletes flip bits in a deleted-row
+bitmask the scan keep-masks consult. Base column arrays — and the
+device buffers and AOT programs keyed on their content — are never
+touched for tables the DML doesn't name, and the session scopes
+invalidation to plans that scan the mutated table (segment-granular
+content digests make everything else hit). ``faults.py`` exposes a
+``dml.apply`` site here so chaos runs can land a crash between the
+journal START-mark and the snapshot commit.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from nds_tpu.columnar import delta
 from nds_tpu.engine.types import (
     DateType, DecimalType, FloatType, IntType, StringType,
 )
 from nds_tpu.io.host_table import HostTable, from_arrays
+from nds_tpu.resilience import faults
 from nds_tpu.sql import ast
 
 
@@ -80,37 +88,40 @@ def result_to_arrays(result, schema) -> dict:
     return arrays
 
 
-def append_rows(table: HostTable, result) -> HostTable:
-    """New HostTable with the result's rows appended."""
-    chunk = result_to_arrays(result, table.schema)
-    merged: dict[str, np.ndarray] = {}
-    n_old, n_new = table.nrows, result.nrows
-    for f in table.schema:
-        col = table.columns[f.name]
-        old_vals = col.decode() if col.is_string else col.values
-        new_vals = chunk[f.name]
-        if col.is_string:
-            old_vals = np.asarray(old_vals, dtype=object)
-            # decode() already applied the null mask as None; put
-            # placeholders back so re-encoding sees strings only
-            if col.null_mask is not None:
-                old_vals = old_vals.copy()
-                old_vals[~col.null_mask] = ""
-        merged[f.name] = np.concatenate([old_vals, new_vals])
-        old_mask = (col.null_mask if col.null_mask is not None
-                    else np.ones(n_old, dtype=bool))
-        new_mask = chunk.get(f.name + "#null")
-        if new_mask is None:
-            new_mask = np.ones(n_new, dtype=bool)
-        mask = np.concatenate([old_mask, new_mask])
-        if not mask.all():
-            merged[f.name + "#null"] = mask
-    return from_arrays(table.name, table.schema, merged)
+def segment_from_result(table: HostTable, result) -> HostTable:
+    """Build the O(result)-sized segment table an INSERT appends —
+    encoding only the NEW rows (the base table is never decoded)."""
+    return from_arrays(table.name, table.schema,
+                       result_to_arrays(result, table.schema))
+
+
+def append_rows(table: HostTable, result,
+                seg_id: str = "") -> HostTable:
+    """New effective HostTable with the result's rows appended as a
+    delta segment (`columnar/delta.py`): base arrays concatenate
+    in-place-free, string dictionaries merge at dictionary size, and
+    encoding specs re-derive from exact merged statistics — no
+    full-table re-encode."""
+    faults.fault_point("dml.apply", table=table.name, action="insert",
+                       rows=result.nrows)
+    return delta.append_segment(table, segment_from_result(table, result),
+                                seg_id=seg_id)
 
 
 # ------------------------------------------------------------------ delete
 
+def apply_delete(table: HostTable, keep: np.ndarray) -> HostTable:
+    """New effective HostTable with non-kept rows marked deleted in the
+    delta bitmask — column arrays (and their memoized encoding specs)
+    are shared untouched; scans consult the mask."""
+    faults.fault_point("dml.apply", table=table.name, action="delete",
+                       rows=int((~np.asarray(keep, bool)).sum()))
+    return delta.apply_delete(table, keep)
+
+
 def filter_rows(table: HostTable, keep: np.ndarray) -> HostTable:
+    """PHYSICAL row filter (gather): compaction's building block; DML
+    itself uses ``apply_delete``'s logical mask."""
     cols = {}
     for f in table.schema:
         col = table.columns[f.name]

@@ -116,15 +116,36 @@ class Session:
     def _views_signature(self) -> frozenset:
         return frozenset(self._view_sql.items())
 
-    def invalidate(self) -> None:
-        """Drop every content-derived cache after a table mutation: the
-        plan cache (plans bake in table stats/bounds) and any executor
-        the factory holds (device buffers + XLA programs key on table
-        shapes). The analog of Spark re-planning on a new table version."""
-        self._plan_cache.clear()
-        inv = getattr(self._executor_factory, "invalidate", None)
-        if inv is not None:
-            inv()
+    def invalidate(self, tables=None) -> None:
+        """Drop content-derived caches after a table mutation. With
+        ``tables=None`` everything goes (the pre-delta behavior, still
+        right for wholesale warehouse swaps like rollback). With a
+        table-name iterable, eviction is SCOPED: only plan-cache
+        entries whose plans scan a mutated table are dropped, and the
+        executor factory is asked for a scoped invalidate — segment-
+        granular content digests guarantee unaffected programs stay
+        correct, so unaffected queries re-run at 0 compiles."""
+        if tables is None:
+            self._plan_cache.clear()
+            inv = getattr(self._executor_factory, "invalidate", None)
+            if inv is not None:
+                inv()
+            return
+        touched = set(tables)
+        from nds_tpu.cache import fingerprint
+        for key in [k for k, planned in self._plan_cache.items()
+                    if not isinstance(planned, tuple)
+                    and touched.intersection(
+                        fingerprint.scan_tables(planned))]:
+            self._plan_cache.pop(key, None)
+        inv_scoped = getattr(self._executor_factory,
+                             "invalidate_tables", None)
+        if inv_scoped is not None:
+            inv_scoped(touched)
+        else:
+            inv = getattr(self._executor_factory, "invalidate", None)
+            if inv is not None:
+                inv()
 
     def _run_dml(self, action: str, name: str, payload) -> None:
         from nds_tpu.engine import dml
@@ -137,8 +158,8 @@ class Session:
             self.tables[name] = dml.append_rows(table, result)
         else:  # delete
             keep = dml.delete_mask(self, table, payload)
-            self.tables[name] = dml.filter_rows(table, keep)
-        self.invalidate()
+            self.tables[name] = dml.apply_delete(table, keep)
+        self.invalidate(tables=[name])
 
     def _planned_for(self, key: tuple, sql_text: str):
         """Plan-cache lookup that keeps the 'plan' chaos site firing

@@ -116,6 +116,27 @@ class SnapshotLog:
         self._write()
         return version
 
+    def commit_delta(self, table: str, new_rel_paths: list,
+                     note: str = "") -> int:
+        """Append a version whose file list for ``table`` is the
+        previous version's files PLUS ``new_rel_paths`` (delta lineage:
+        base files + every committed delta artifact, in commit order —
+        the reader replays them ascending). This append IS the atomic
+        commit point: until the stamped manifest lands, the delta files
+        are unreferenced and the reader serves the prior version."""
+        prev = (self.entries[-1]["tables"] if self.entries
+                else self.baseline([table]))
+        paths = list(prev.get(table, [])) + [
+            p for p in new_rel_paths if p not in prev.get(table, [])]
+        return self.commit({table: paths}, note=note)
+
+    def has_note(self, note: str) -> bool:
+        """True when a committed version carries ``note`` — maintenance
+        resume uses this to detect a crash that landed AFTER a refresh
+        function's snapshot commit but BEFORE its journal record (the
+        function's effects are durable; re-running would double-apply)."""
+        return any(e.get("note") == note for e in self.entries)
+
     def rollback_to_timestamp(self, ts: float) -> int | None:
         """Drop every version committed after ``ts``
         (`nds/nds_rollback.py:46-51` semantics). Returns the surviving

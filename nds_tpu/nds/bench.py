@@ -4,9 +4,10 @@ Behavioral port of `nds/nds_bench.py:367-498`: run the TPC-DS phases as
 subprocesses in spec order — data-gen (base + per-stream refresh sets)
 -> load (transcode) -> stream-gen (RNGSEED = load end timestamp,
 `nds/nds_bench.py:60-74`) -> power -> throughput 1 -> maintenance 1 ->
-throughput 2 -> maintenance 2 — with crash isolation via report-file
-state passing (SURVEY.md §3.4), then compute the 4-term composite
-metric (`nds/nds_bench.py:334-357`):
+throughput 2 -> maintenance 2 -> validate (optional: post-maintenance
+engine outputs diffed against a CPU-oracle round, nds/validate.py) —
+with crash isolation via report-file state passing (SURVEY.md §3.4),
+then compute the 4-term composite metric (`nds/nds_bench.py:334-357`):
 
     Q   = Sq * 99
     Tpt = Tpower * Sq / 3600 ;  Ttt = (Ttt1 + Ttt2) / 3600
@@ -321,11 +322,33 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
         return {"ttt": ttt}
 
     def _maintenance(round_no):
+        from nds_tpu.resilience.drain import EXIT_RESUMABLE
         dm_log = os.path.join(report_dir,
                               f"maintenance{round_no}_time.csv")
-        _run([sys.executable, "-m", "nds_tpu.nds.maintenance",
-              wh_dir, f"{refresh_base}{round_no}", dm_log,
-              "--backend", backend], backend=backend)
+        base_cmd = [sys.executable, "-m", "nds_tpu.nds.maintenance",
+                    wh_dir, f"{refresh_base}{round_no}", dm_log,
+                    "--backend", backend,
+                    "--json_summary_folder",
+                    os.path.join(report_dir,
+                                 f"maintenance{round_no}_json")]
+        # bench-level --resume also resumes mid-phase: the maintenance
+        # commit journal in the warehouse replays the refresh functions
+        # whose snapshot commits already landed (never double-applies)
+        cmd = base_cmd + (["--resume"] if resume else [])
+        resumes = 0
+        while True:
+            rc = _run_rc(cmd, backend=backend,
+                         extra_env=_snap_env(f"maintenance{round_no}"))
+            if rc == 0:
+                break
+            if rc == EXIT_RESUMABLE and resumes < MAX_PHASE_RESUMES:
+                resumes += 1
+                print(f"== maintenance {round_no} drained (exit "
+                      f"{EXIT_RESUMABLE}) — resuming "
+                      f"({resumes}/{MAX_PHASE_RESUMES}) ==")
+                cmd = base_cmd + ["--resume"]
+                continue
+            raise subprocess.CalledProcessError(rc, cmd)
         return {"tdm": get_maintenance_time(dm_log)}
 
     ttts, tdms = [], []
@@ -338,6 +361,46 @@ def run_full_bench(cfg: dict, resume: bool = False) -> dict:
                               lambda r=round_no: _maintenance(r))["tdm"])
     metrics["throughput_times_s"] = ttts
     metrics["maintenance_times_s"] = tdms
+
+    def _validate():
+        """Post-maintenance validation: run the power stream twice on
+        the CURRENT (maintained) warehouse — once on the bench backend,
+        once on the CPU oracle — and diff the saved outputs
+        (nds/validate.py), patching ``queryValidationStatus`` into the
+        engine round's JSON summaries."""
+        vcfg = cfg.get("validate") or {}
+        stream0 = os.path.join(stream_dir, "query_0.sql")
+        vdir = os.path.join(report_dir, "validate")
+        jdir = os.path.join(vdir, "json")
+        subset = [str(q) for q in (vcfg.get("query_subset") or [])]
+        out_engine = os.path.join(vdir, "output_engine")
+        out_oracle = os.path.join(vdir, "output_oracle")
+        for be, outp, tag in ((backend, out_engine, "engine"),
+                              ("cpu", out_oracle, "oracle")):
+            cmd = [sys.executable, "-m", "nds_tpu.nds.power",
+                   wh_dir, stream0,
+                   os.path.join(vdir, f"{tag}_time.csv"),
+                   "--backend", be, "--output_prefix", outp]
+            if tag == "engine":
+                cmd += ["--json_summary_folder", jdir]
+            if subset:
+                cmd += ["--query_subset", *subset]
+            _run(cmd, backend=be)
+        vcmd = [sys.executable, "-m", "nds_tpu.nds.validate",
+                out_engine, out_oracle, stream0, "--ignore_ordering",
+                "--json_summary_folder", jdir]
+        if vcfg.get("epsilon") is not None:
+            vcmd += ["--epsilon", str(vcfg["epsilon"])]
+        rc = _run_rc(vcmd, backend="cpu")
+        if rc and not vcfg.get("allow_failure"):
+            raise SystemExit(
+                f"validate: engine outputs diverge from the CPU "
+                f"oracle (exit {rc}; mismatches listed above)")
+        return {"validation_ok": 0 if rc else 1}
+
+    if cfg.get("validate") and not skip.get("validate", False):
+        metrics["validation_ok"] = bool(
+            phase("validate", _validate)["validation_ok"])
 
     # all four terms or no composite (a fabricated term would silently
     # skew the geometric mean)

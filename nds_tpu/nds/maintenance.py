@@ -9,10 +9,27 @@ times in JSON summaries + the CSV time log, and exit non-zero on
 failures.
 
 TPU-native: DML mutates the host warehouse through the engine
-(`nds_tpu/engine/dml.py`); after all functions run, the mutated fact
-tables are committed as a new snapshot version
-(`nds_tpu/io/snapshots.py`) — the Iceberg-snapshot analog that
-`nds_tpu.nds.rollback` undoes.
+(`nds_tpu/engine/dml.py`) as DELTAS (`columnar/delta.py`) — segments
+and deleted-row bitmasks over the immutable encoded base, never a
+rewrite — and every refresh function commits its deltas as one
+snapshot version (`nds_tpu/io/snapshots.py`), the Iceberg-snapshot
+analog that `nds_tpu.nds.rollback` undoes by manifest truncation.
+
+Crash safety is the power loop's contract applied to writes: a
+write-ahead commit journal START-marks each LF_*/DF_* function before
+its DML dispatches and records completion only AFTER its snapshot
+commit lands, so ``--resume`` replays completed functions from the
+journal, recognizes the crash-after-commit window by the committed
+version's note, and NEVER double-applies a mutation; SIGTERM drains
+the in-flight function and exits 75 so `bench.py` retries with
+``--resume``. Chaos coverage injects at ``dml.apply`` (between
+START-mark and commit) and ``store.commit`` (the torn-commit window).
+
+Compaction — folding deltas + bitmasks back into full base files — is
+a first-class governed operator: `compact_warehouse` asks the
+`MemoryGovernor` for admission (materializing live rows is the one
+O(table) step in the write path) and commits a full-file version that
+rollback undoes like any other.
 """
 
 from __future__ import annotations
@@ -85,21 +102,105 @@ def run_dm_query(session: Session, sql: str) -> None:
         session.sql(stmt)
 
 
+JOURNAL_NAME = "_maintenance_journal.json"
+
+
+def journal_path(data_dir: str, refresh_dir: str) -> str:
+    """Journal keyed by refresh set: a full bench runs maintenance
+    twice (refresh1, refresh2) against ONE warehouse — round 2 resumed
+    must not replay round 1's records."""
+    tag = os.path.basename(os.path.normpath(refresh_dir)) or "refresh"
+    return os.path.join(data_dir, JOURNAL_NAME.replace(
+        ".json", f".{tag}.json"))
+
+
+def _commit_function_deltas(data_dir: str, log, session: Session,
+                            note: str) -> "int | None":
+    """Persist every pending delta artifact (segments, deleted-row
+    bitmask) the just-finished refresh function produced and append ONE
+    snapshot version referencing them (the atomic commit point). A
+    crash before the manifest append leaves unreferenced files the
+    reader never visits — the next incarnation re-runs the function and
+    overwrites them. Returns the committed version, or None when the
+    function mutated nothing."""
+    from nds_tpu.columnar import delta
+    version = (log.entries[-1]["version"] + 1) if log.entries else 1
+    new_rel: dict[str, list] = {}
+    for t in MUTABLE_TABLES:
+        table = session.tables.get(t)
+        if table is None or delta.state_of(table) is None:
+            continue
+        files = delta.persist_pending(table, log.version_dir(t, version),
+                                      note=note)
+        if files:
+            new_rel[t] = [os.path.relpath(p, data_dir) for p in files]
+    if not new_rel:
+        return None
+    prev = (dict(log.entries[-1]["tables"]) if log.entries else {})
+    merged = {}
+    for t, rel in new_rel.items():
+        base = prev.get(t)
+        if base is None:
+            base = log.baseline([t]).get(t, [])
+        merged[t] = list(base) + rel
+    return log.commit(merged, note=note)
+
+
 def run_maintenance(data_dir: str, refresh_dir: str, time_log_path: str,
                     config=None,
                     json_summary_folder: str | None = None,
                     refresh_format: str = "raw",
-                    commit: bool = True) -> int:
-    """Run all 11 maintenance functions; returns the failure count."""
+                    commit: bool = True,
+                    resume: bool = False) -> int:
+    """Run all 11 maintenance functions under the write-ahead commit
+    journal; returns the failure count. ``resume=True`` replays
+    journaled-complete functions (and functions whose snapshot commit
+    landed but whose journal record didn't — the crash-after-commit
+    window, recognized by the committed version's note) and re-runs
+    only genuinely unfinished ones — zero double-applied DML by
+    construction."""
+    from nds_tpu.io.snapshots import SnapshotLog
     from nds_tpu.nds.schema import get_maintenance_schemas
+    from nds_tpu.resilience import drain
+    from nds_tpu.resilience.journal import QueryJournal, config_digest
     config = config or power_core.config_from_args(
         argparse.Namespace(), default_backend="cpu")
     suite = _maintenance_suite(config)
     session = power_core.make_session(suite, config)
-    app_id = f"nds-tpu-maintenance-{int(time.time())}"
+    # nonce keeps run ids (and therefore snapshot commit notes) unique
+    # even when two rounds start within the same second
+    import uuid
+    app_id = (f"nds-tpu-maintenance-{int(time.time())}-"
+              f"{uuid.uuid4().hex[:8]}")
     tlog = TimeLog(app_id)
+    run_dir = (json_summary_folder
+               or os.path.dirname(time_log_path) or ".")
 
-    # base warehouse + refresh staging tables
+    journal = QueryJournal(
+        journal_path(data_dir, refresh_dir), phase=app_id,
+        digest=config_digest({"data_dir": data_dir,
+                              "refresh_dir": refresh_dir,
+                              "commit": commit}))
+    if resume and journal.load():
+        inc = journal.begin_incarnation()
+        # the run id binds this journal's records to their snapshot
+        # notes; a resumed incarnation inherits the original's
+        run_id = journal.state.get("phase") or app_id
+        print(f"== resuming maintenance (incarnation {inc}): "
+              f"{len(journal.completed())} function(s) journaled ==")
+    else:
+        journal.reset()
+        run_id = app_id
+
+    # graceful preemption: SIGTERM/SIGINT drains the in-flight refresh
+    # function and exits 75 (resumable) — installed only when no outer
+    # driver (bench.py) already owns the signal chain
+    own_drain = drain.manager() is None
+    if own_drain:
+        drain.install(drain.drain_seconds(config), run_dir)
+
+    # base warehouse (versioned: committed deltas from a crashed run
+    # replay through columnar.delta) + refresh staging tables
     setup = power_core.load_warehouse(
         suite, session, data_dir,
         schemas=power_core.suite_schemas(suite, config))
@@ -111,37 +212,76 @@ def run_maintenance(data_dir: str, refresh_dir: str, time_log_path: str,
     for tname, secs in setup.items():
         tlog.add(f"CreateTempView {tname}", int(secs * 1000))
 
+    log = SnapshotLog(data_dir) if commit else None
     date1, date2, inv_date1, inv_date2 = get_delete_date(session)
     queries = get_maintenance_queries(
         INSERT_FUNCS + DELETE_FUNCS + INVENTORY_DELETE_FUNCS)
     if json_summary_folder:
         os.makedirs(json_summary_folder, exist_ok=True)
     failures = 0
-    dm_start = time.perf_counter()
-    for fname, sql in queries.items():
-        if fname in INVENTORY_DELETE_FUNCS:
-            sql = replace_date(sql, inv_date1, inv_date2)
-        elif fname in DELETE_FUNCS:
-            sql = replace_date(sql, date1, date2)
-        report = BenchReport(fname, config.as_dict())
-        summary = report.report_on(run_dm_query, session, sql)
-        elapsed_ms = summary["queryTimes"][-1]
-        tlog.add(fname, elapsed_ms)
-        print(f"====== Run {fname} ======")
-        print(f"Time taken: {elapsed_ms} millis for {fname}")
-        if not report.is_success():
-            failures += 1
-        if json_summary_folder:
-            report.write_summary(prefix=f"maintenance-{app_id}",
-                                 out_dir=json_summary_folder)
-    dm_ms = int((time.perf_counter() - dm_start) * 1000)
+    dm_ms = 0
+    try:
+        for fname, sql in queries.items():
+            # function-boundary drain point: a requested drain exits 75
+            # here, with every finished function journaled + committed
+            drain.check_boundary()
+            note = f"maint:{run_id}:{fname}"
+            if journal.done(fname):
+                entry = journal.entry(fname)
+                elapsed_ms = int(entry.get("wall_ms", 0))
+                tlog.add(fname, elapsed_ms)
+                dm_ms += elapsed_ms
+                if not str(entry.get("status", "")).startswith(
+                        "Completed"):
+                    failures += 1
+                print(f"====== {fname} replayed from journal ======")
+                continue
+            if log is not None and log.has_note(note):
+                # crash landed between this function's snapshot commit
+                # and its journal record: the mutation is durable (and
+                # already loaded from the committed version) — record
+                # retroactively, NEVER re-apply
+                journal.record(fname, 0.0,
+                               "Completed(replayed-from-snapshot)")
+                print(f"====== {fname} already committed "
+                      f"(v-note {note}) ======")
+                continue
+            if fname in INVENTORY_DELETE_FUNCS:
+                fsql = replace_date(sql, inv_date1, inv_date2)
+            elif fname in DELETE_FUNCS:
+                fsql = replace_date(sql, date1, date2)
+            else:
+                fsql = sql
+            # START-mark before dispatch: a kill one instruction later
+            # still leaves the attempt on disk (at-most-one in flight)
+            journal.start(fname)
+            report = BenchReport(fname, config.as_dict())
+            summary = report.report_on(run_dm_query, session, fsql)
+            elapsed_ms = summary["queryTimes"][-1]
+            tlog.add(fname, elapsed_ms)
+            dm_ms += elapsed_ms
+            print(f"====== Run {fname} ======")
+            print(f"Time taken: {elapsed_ms} millis for {fname}")
+            ok = report.is_success()
+            if not ok:
+                failures += 1
+            if ok and log is not None:
+                v = _commit_function_deltas(data_dir, log, session, note)
+                if v is not None:
+                    print(f"committed {fname} deltas as snapshot v{v}")
+            # journal record AFTER the commit: completion implies the
+            # mutation is durable, so resume can safely skip it
+            journal.record(fname, elapsed_ms,
+                           "Completed" if ok else "Failed")
+            if json_summary_folder:
+                report.write_summary(prefix=f"maintenance-{app_id}",
+                                     out_dir=json_summary_folder)
+    finally:
+        if own_drain:
+            drain.uninstall()
     tlog.add("Data Maintenance Time", dm_ms)
     tlog.write(time_log_path)
     print(f"Data Maintenance Time: {dm_ms} millis")
-
-    if commit and not failures:
-        version = commit_snapshot(data_dir, session)
-        print(f"committed warehouse snapshot v{version}")
     return failures
 
 
@@ -158,19 +298,60 @@ def _maintenance_suite(config) -> power_core.Suite:
     )
 
 
-def commit_snapshot(data_dir: str, session: Session) -> int:
-    """Persist the mutated fact tables as a new warehouse version."""
+def compact_warehouse(data_dir: str, session: Session,
+                      governor=None, note: str = "compact",
+                      tables: "list[str] | None" = None) -> "int | None":
+    """Fold each mutated table's delta segments + deleted-row bitmask
+    back into full base files and commit them as one snapshot version
+    (which rollback undoes like any other — manifest truncation).
+
+    Materializing live rows is the one O(table) host-memory step in the
+    write path, so compaction is a governed operator: when a
+    `MemoryGovernor` refuses admission the fold is deferred (counted as
+    ``compaction_deferred_total``) and the delta representation — still
+    correct, just less compact — keeps serving queries.
+
+    Returns the committed version, or None when nothing was compacted.
+    """
+    from nds_tpu.columnar import delta
     from nds_tpu.io import csv_io
     from nds_tpu.io.snapshots import SnapshotLog
+    from nds_tpu.obs import metrics as obs_metrics
+
+    targets = []
+    for t in (tables or MUTABLE_TABLES):
+        table = session.tables.get(t)
+        if table is not None and delta.state_of(table) is not None:
+            targets.append((t, table))
+    if not targets:
+        return None
+
+    if governor is not None:
+        class _Est:
+            bytes = sum(tb.nbytes for _, tb in targets)
+            rows = sum(tb.nrows for _, tb in targets)
+        reason = governor.decide(_Est())
+        if reason is not None:
+            obs_metrics.counter("compaction_deferred_total").inc()
+            print(f"compaction deferred ({reason}) — delta "
+                  f"representation stays in service")
+            return None
+
     log = SnapshotLog(data_dir)
     version = (log.entries[-1]["version"] + 1) if log.entries else 1
     new_files = {}
-    for t in MUTABLE_TABLES:
+    for t, table in targets:
+        pt = delta.physical(table)
         vdir = log.version_dir(t, version)
         path = os.path.join(vdir, "part-0.parquet")
-        csv_io.write_parquet(session.tables[t], path)
+        csv_io.write_parquet(pt, path)
         new_files[t] = [os.path.relpath(path, data_dir)]
-    return log.commit(new_files, note="data maintenance")
+        # the in-session table becomes the compacted physical form;
+        # register_table drops the delta attr and re-derives stats
+        session.register_table(pt)
+    v = log.commit(new_files, note=note)
+    session.invalidate(tables=[t for t, _ in targets])
+    return v
 
 
 def main(argv=None) -> None:
@@ -189,13 +370,33 @@ def main(argv=None) -> None:
                    help="leave the on-disk warehouse untouched")
     p.add_argument("--allow_failure", action="store_true",
                    help="exit 0 even when functions failed")
+    p.add_argument("--resume", action="store_true",
+                   help="replay the commit journal: skip functions "
+                        "whose mutations are already durable")
+    p.add_argument("--compact", action="store_true",
+                   help="after the refresh functions, fold deltas back "
+                        "into full base files (governed)")
     power_core.add_config_args(p)
     args = p.parse_args(argv)
     config = power_core.config_from_args(args, default_backend="cpu")
     failures = run_maintenance(
         args.data_dir, args.refresh_dir, args.time_log, config=config,
         json_summary_folder=args.json_summary_folder,
-        refresh_format=args.refresh_format, commit=not args.no_commit)
+        refresh_format=args.refresh_format, commit=not args.no_commit,
+        resume=args.resume)
+    if args.compact and not failures and not args.no_commit:
+        from nds_tpu.engine.scheduler import MemoryGovernor
+        suite = _maintenance_suite(config)
+        session = power_core.make_session(suite, config)
+        power_core.load_warehouse(
+            suite, session, args.data_dir,
+            schemas=power_core.suite_schemas(suite, config))
+        budget = config.get("engine.placement.device_budget_bytes")
+        gov = (MemoryGovernor(budget=int(budget))
+               if budget is not None else MemoryGovernor())
+        v = compact_warehouse(args.data_dir, session, governor=gov)
+        if v is not None:
+            print(f"compacted warehouse as snapshot v{v}")
     sys.exit(0 if (args.allow_failure or not failures) else 1)
 
 

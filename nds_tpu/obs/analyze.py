@@ -165,6 +165,14 @@ def attribute_query(summary: dict) -> dict:
     for k in ("bytes_scanned", "compression_ratio"):
         if isinstance(et.get(k), (int, float)):
             row[k] = float(et[k])
+    # writable-warehouse deltas (nds_tpu/columnar/delta.py): how many
+    # append-only segments and masked (deleted) rows rode under the
+    # tables this query scanned. Absent on delta-free runs, so
+    # pre-maintenance run dirs keep analyzing byte-identically
+    for k in ("delta_segments", "delta_appended_rows",
+              "delta_masked_rows"):
+        if isinstance(et.get(k), (int, float)):
+            row[k] = int(et[k])
     # pipelined execution (engine/pipeline_io.py): host staging time
     # the prefetch overlapped under compute, and the derived device
     # occupancy (1 - prefetch_wait/wall — what fraction of the query's
@@ -564,6 +572,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
     has_roofline = any("ops_per_byte" in r or "roofline_frac" in r
                        for r in rows)
     has_bytes = any("bytes_scanned" in r for r in rows)
+    has_delta = any("delta_segments" in r for r in rows)
     has_profile = any("profile" in r for r in rows)
     has_occup = any("occupancy" in r for r in rows)
     has_cost = any("cost" in r for r in rows)
@@ -574,6 +583,7 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         + ("  cache" if has_cache else "")
         + ("   roofline" if has_roofline else "")
         + ("         bytes" if has_bytes else "")
+        + ("        delta" if has_delta else "")
         + ("  occup" if has_occup else "")
         + ("  predicted  achieved" if has_cost else "")
         + ("  profile" if has_profile else "") + "  status")
@@ -626,6 +636,19 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
             if cr is not None:
                 cell += f" x{cr:.1f}"
             bytes_col = f"  {cell:>12}"
+        delta_col = ""
+        if has_delta:
+            # delta state under the query's scanned tables:
+            # "<segments>s +<appended> -<masked>" — a nonzero cell
+            # means the query ran over a mutated warehouse without a
+            # re-encode (README "Writable warehouse")
+            if "delta_segments" in r:
+                cell = (f"{r['delta_segments']}s "
+                        f"+{r.get('delta_appended_rows', 0)} "
+                        f"-{r.get('delta_masked_rows', 0)}")
+            else:
+                cell = "-"
+            delta_col = f"  {cell:>12}"
         occup_col = ""
         if has_occup:
             # device occupancy under pipelined execution: 100% means
@@ -653,8 +676,8 @@ def format_attribution(analysis: dict, top: int | None = None) -> str:
         lines.append(
             f"{r['query']:<{w}} "
             + " ".join(f"{v:>9.1f}" for v in vals)
-            + place + cache_col + roof_col + bytes_col + occup_col
-            + cost_col + prof_col + f"  {r['status']}")
+            + place + cache_col + roof_col + bytes_col + delta_col
+            + occup_col + cost_col + prof_col + f"  {r['status']}")
     t = analysis["totals"]
     tvals = [t["categories"][c] for c in CATEGORIES]
     tvals += [t["residual_ms"], t["wall_ms"]]
@@ -944,6 +967,50 @@ def cache_hit_rate(analysis: dict) -> "dict | None":
             "rate": round(hits / total, 4) if total else None}
 
 
+# the 11 maintenance refresh functions (nds/maintenance.py INSERT/
+# DELETE/INVENTORY_DELETE_FUNCS — listed literally: this module stays
+# importable without the engine stack). Their per-function BenchReport
+# summaries land in run dirs like query reports do, but they are DML:
+# the steady-state decomposition doesn't apply, so they diff on FULL
+# refresh wall-clock under their own MAINT-REGRESSED gate — the TPC
+# metric charges Tdm for exactly this time
+MAINT_FUNCS = frozenset((
+    "LF_CR", "LF_CS", "LF_I", "LF_SR", "LF_SS", "LF_WR", "LF_WS",
+    "DF_CS", "DF_SS", "DF_WS", "DF_I"))
+
+
+def _is_maint_fn(name: str) -> bool:
+    return name.partition("#")[0] in MAINT_FUNCS
+
+
+def maint_changes(base_rows: dict, cur_rows: dict, pct: float = 10.0,
+                  abs_ms: float = 50.0) -> list:
+    """Per-function refresh-time changes between two runs holding
+    maintenance summaries: the same noise model as steady-state time
+    but over FULL wall-clock (DML has no compile/steady split worth
+    separating), with ``regressed: True`` failing the gate. A function
+    present in base but MISSING from cur also fails — a refresh
+    function that vanished is strictly worse than one that got slower.
+    Runs with no maintenance summaries on either side emit nothing, so
+    query-only run dirs keep diffing byte-identically."""
+    b = {q: r["wall_ms"] for q, r in base_rows.items()
+         if _is_maint_fn(q)}
+    c = {q: r["wall_ms"] for q, r in cur_rows.items()
+         if _is_maint_fn(q)}
+    if not b and not c:
+        return []
+    d = diff_times(b, c, pct=pct, abs_ms=abs_ms)
+    out = []
+    for e in d["regressions"]:
+        out.append({**e, "regressed": True})
+    out += d["improvements"]
+    for q in d["removed"]:
+        out.append({"query": q, "removed": True, "regressed": True})
+    for q in d["added"]:
+        out.append({"query": q, "added": True})
+    return out
+
+
 def diff_runs(base: dict, cur: dict, pct: float = 10.0,
               abs_ms: float = 50.0, cost_pct: float = 25.0) -> dict:
     """Query-by-query diff of two ``analyze_run`` results, gated on
@@ -955,6 +1022,14 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
     that got slower)."""
     b_rows = {r["query"]: r for r in base["queries"]}
     c_rows = {r["query"]: r for r in cur["queries"]}
+    # maintenance refresh functions gate on their own wall-clock
+    # (MAINT-REGRESSED) and leave the query-side comparisons — a
+    # refresh summary has no kernels/bytes/cost surface to diff
+    mchanges = maint_changes(b_rows, c_rows, pct=pct, abs_ms=abs_ms)
+    maint_regressed = [e["query"] for e in mchanges
+                       if e.get("regressed")]
+    b_rows = {q: r for q, r in b_rows.items() if not _is_maint_fn(q)}
+    c_rows = {q: r for q, r in c_rows.items() if not _is_maint_fn(q)}
     d = diff_times({q: steady_ms(r) for q, r in b_rows.items()},
                    {q: steady_ms(r) for q, r in c_rows.items()},
                    pct=pct, abs_ms=abs_ms)
@@ -1005,11 +1080,12 @@ def diff_runs(base: dict, cur: dict, pct: float = 10.0,
         "bytes_changes": bchanges,
         "pipeline_changes": pchanges,
         "cost_changes": cchanges,
+        "maint_changes": mchanges,
         "newly_failed": newly_failed,
         "passed": not d["regressions"] and not d["removed"]
                   and not newly_failed and not demoted
                   and not bytes_regressed and not stalled
-                  and not cost_drifted,
+                  and not cost_drifted and not maint_regressed,
     })
     # plan-cache hit-rate per run, the compile-count-change flag's
     # natural companion: a run whose compile counts dropped to 0
@@ -1101,6 +1177,23 @@ def format_diff(d: dict) -> str:
                              f"{_v(e.get(f'cur_{key}'))}")
         lines.append(f"  {label:<11} {e['query']:<14} "
                      + "; ".join(parts))
+    for e in d.get("maint_changes", []):
+        # per-function refresh wall-clock (the Tdm the TPC metric
+        # charges): a regression here is a write-path slowdown even
+        # when every query held steady
+        label = "MAINT-REGRESSED" if e.get("regressed") else "maint"
+        if e.get("removed"):
+            lines.append(f"  {label:<15} {e['query']:<14} "
+                         f"refresh function missing from cur run")
+        elif e.get("added"):
+            lines.append(f"  {label:<15} {e['query']:<14} "
+                         f"refresh function new in cur run")
+        else:
+            rel = ("n/a" if e["pct"] is None else f"{e['pct']:+g}%")
+            lines.append(
+                f"  {label:<15} {e['query']:<14} "
+                f"{e['base_ms']:>10.1f} -> {e['cur_ms']:>10.1f} ms "
+                f"({rel})")
     chr_ = d.get("cache_hit_rate") or {}
     if any(chr_.get(k) for k in ("base", "cur")):
         def _rate(r):

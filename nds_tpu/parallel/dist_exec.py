@@ -183,6 +183,28 @@ class DistributedExecutor(dx.DeviceExecutor):
         if key + "#v" in self._buffers:
             bufs[key + "#v"] = self._buffers[key + "#v"]
 
+    def _upload_live(self, bufs: dict, table: str) -> None:
+        # delta deleted-row bitmask shards with the table's own pad
+        # layout (False-padded, so padded slots stay dead) — the
+        # sharded scan ANDs its local slice into the row gate exactly
+        # like the single-chip path
+        from nds_tpu.columnar import delta
+        live = delta.live_mask(self.tables[table])
+        if live is None:
+            return
+        key = f"{table}.__live"
+        if key not in self._buffers:
+            sharded = self._is_sharded(table)
+            if sharded:
+                cap = pad_to_multiple(max(len(live), self.n_dev),
+                                      self.n_dev)
+                pad = cap - len(live)
+                if pad:
+                    live = np.concatenate(
+                        [live, np.zeros(pad, dtype=bool)])
+            self._buffers[key] = self._dev(live, sharded)
+        bufs[key] = self._buffers[key]
+
     def _compile(self, planned: P.PlannedQuery):
         side = {}
 
@@ -487,6 +509,7 @@ class DistributedExecutor(dx.DeviceExecutor):
             repl_bufs = {k: bufs[k] for k in state["rk"]}
             timings["bytes_scanned"] = float(
                 sum(b.nbytes for b in bufs.values()))
+            self._attach_delta(timings, planned)
             obs_metrics.counter("device_executions_total").inc()
             obs_metrics.counter("bytes_scanned_total").inc(
                 timings["bytes_scanned"])
@@ -661,7 +684,13 @@ class _DistTrace(dx._Trace):
             dev_i = (lax.axis_index(HOST_AXIS) * self.ex.n_lanes
                      + dev_i)
         gidx = dev_i.astype(jnp.int64) * local + jnp.arange(local)
-        ctx = DCtx(local, gidx < t.nrows)
+        row = gidx < t.nrows
+        live = self.bufs.get(f"{node.table}.__live")
+        if live is not None:
+            # delta deleted-row bitmask (local shard slice, padded
+            # False): deleted rows leave the shard's row population
+            row = row & live
+        ctx = DCtx(local, row)
         ctx.sharded = True
         for name, _dt in node.output:
             col = t.columns[name]
@@ -959,7 +988,15 @@ def make_distributed_factory(mesh=None, n_devices=None,
             holder["ex"] = ex
         return ex
 
-    # DML invalidation hook (Session.invalidate), as in
-    # device_exec.make_device_factory
+    # DML invalidation hooks (Session.invalidate), as in
+    # device_exec.make_device_factory — the scoped form keeps warm
+    # buffers and compiled programs for every unmutated table
     factory.invalidate = holder.clear
+
+    def invalidate_tables(names):
+        ex = holder.get("ex")
+        if ex is not None:
+            ex.invalidate_tables(names)
+
+    factory.invalidate_tables = invalidate_tables
     return factory

@@ -77,7 +77,8 @@ from dataclasses import dataclass, field
 FAULTS_ENV = "NDS_TPU_FAULTS"
 SEED_ENV = "NDS_TPU_FAULT_SEED"
 
-SITES = ("plan", "device.execute", "exchange", "io.read", "stream.query")
+SITES = ("plan", "device.execute", "exchange", "io.read", "stream.query",
+         "dml.apply", "store.commit")
 
 
 class InjectedFault(RuntimeError):

@@ -273,9 +273,20 @@ def load_warehouse(suite: Suite, session: Session, data_dir: str,
             if log is not None and os.path.isdir(tdir):
                 # versioned warehouse: the snapshot manifest names the
                 # live files (maintenance commits new versions, always
-                # as parquet — formats may mix, so read per-extension)
+                # as parquet — formats may mix, so read per-extension).
+                # Delta lineages (files under <table>/_v<N>/) replay
+                # through columnar.delta: base files load normally,
+                # then each committed version's segments/bitmask apply
+                # in order — rebuilding the same content digests and
+                # merged-stats encoding specs the writer had
                 paths = log.current([name]).get(name, [])
-                table = csv_io.read_paths_auto(paths, name, schema, fmt)
+                from nds_tpu.columnar import delta
+                if delta.has_delta_paths(paths):
+                    table = delta.load_versioned(name, schema, paths,
+                                                 fmt)
+                else:
+                    table = csv_io.read_paths_auto(paths, name, schema,
+                                                   fmt)
                 session.register_table(table)
                 timings[name] = time.perf_counter() - t0
                 continue

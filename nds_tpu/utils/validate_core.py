@@ -43,7 +43,12 @@ def col_equal(a: pd.Series, b: pd.Series, epsilon: float,
         fa = pd.to_numeric(a, errors="coerce").to_numpy(dtype=float)
         fb = pd.to_numeric(b, errors="coerce").to_numpy(dtype=float)
         tol = rel_tol if rel_tol is not None else epsilon
-        return all(math.isclose(x, y, rel_tol=tol)
+        # abs_tol matters when the true value is exactly 0: backends
+        # that reduce in a different order leave ulp-scale residues
+        # (e.g. 2^-43 from a cumsum-difference group sum) where the
+        # oracle computes a literal 0.0, and rel_tol alone rejects ANY
+        # nonzero-vs-zero pair no matter the epsilon
+        return all(math.isclose(x, y, rel_tol=tol, abs_tol=tol)
                    for x, y in zip(fa, fb))
     return list(a.astype(str)) == list(b.astype(str))
 

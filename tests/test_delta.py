@@ -1,0 +1,280 @@
+"""Delta-layer crash-window tests (nds_tpu/columnar/delta.py +
+io/snapshots.py): append/delete semantics over synthetic tables,
+segment-granular content digests, the torn-commit window (delta files
+on disk, snapshot manifest never appended -> a fresh reader serves the
+prior version, and a recovery re-commit makes the mutation visible
+without rewriting files), digest verification on load (every corruption
+raises CorruptArtifact deterministically), rollback-to-baseline, and
+the validate summary patch contract.
+
+The full maintenance pipeline over a generated warehouse lives in
+test_maintenance.py and tools/maint_check.py (SIGKILL chaos + CPU
+oracle); this file pins the storage-layer invariants those builds on,
+at synthetic-table speed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nds_tpu.cache import fingerprint
+from nds_tpu.columnar import delta
+from nds_tpu.engine.types import INT32, INT64, Schema, varchar
+from nds_tpu.io import csv_io, integrity
+from nds_tpu.io.host_table import from_arrays
+from nds_tpu.io.snapshots import SnapshotLog
+from nds_tpu.nds import validate
+
+SCHEMA = Schema.of(
+    ("d_id", INT64, False),
+    ("d_qty", INT32, True),        # carries a null mask
+    ("d_tag", varchar(8), True))   # dict-encoded, later segs grow it
+
+BASE_ROWS = 20
+
+
+def _tbl(name="dtab", start=0, n=BASE_ROWS, tag_mod=5):
+    rng = np.random.default_rng(1000 + start + n)
+    ids = np.arange(start, start + n, dtype=np.int64)
+    qty = rng.integers(0, 100, n).astype(np.int32)
+    tags = np.array([f"tag{i % tag_mod}" for i in range(start, start + n)],
+                    dtype=object)
+    return from_arrays(name, SCHEMA, {
+        "d_id": ids,
+        "d_qty": qty, "d_qty#null": rng.random(n) > 0.2,
+        "d_tag": tags, "d_tag#null": rng.random(n) > 0.1,
+    })
+
+
+def _mutate(table):
+    """The canonical mutation both writer and reader must agree on:
+    append 7 rows (3 of them with dictionary-new tags) then delete 5
+    of the merged physical rows."""
+    t2 = delta.append_segment(table, _tbl(start=100, n=7, tag_mod=9),
+                              seg_id="seg-a")
+    keep = np.ones(t2.nrows, dtype=bool)
+    keep[[1, 3, 5, 21, 25]] = False
+    return delta.apply_delete(t2, keep)
+
+
+class TestDeltaUnits:
+    def test_append_and_delete_semantics(self):
+        t = _tbl()
+        assert delta.state_of(t) is None
+        assert delta.delta_report(t) is None
+        assert delta.visible_rows(t) == BASE_ROWS
+        t3 = _mutate(t)
+        assert t3.nrows == BASE_ROWS + 7          # physical
+        assert delta.visible_rows(t3) == BASE_ROWS + 7 - 5
+        assert delta.segment_count(t3) == 1
+        assert delta.delta_report(t3) == {
+            "segments": 1, "appended_rows": 7, "masked_rows": 5}
+        mask = delta.live_mask(t3)
+        assert mask is not None and int(mask.sum()) == BASE_ROWS + 2
+        # appended values land at the tail of the physical arrays
+        tail = t3.columns["d_id"].values[BASE_ROWS:]
+        np.testing.assert_array_equal(
+            tail, np.arange(100, 107, dtype=np.int64))
+        # physical() gathers the deleted rows out, once
+        phys = delta.physical(t3)
+        assert phys.nrows == BASE_ROWS + 2
+        assert delta.physical(t3) is phys  # memoized
+        assert 1 not in phys.columns["d_id"].values
+
+    def test_delete_shares_column_objects(self):
+        """apply_delete must not copy arrays: the device buffers and
+        encoding memos hang off the column objects, and the whole point
+        of the bitmask design is that a DELETE invalidates nothing."""
+        t2 = delta.append_segment(_tbl(), _tbl(start=100, n=7),
+                                  seg_id="s")
+        keep = np.ones(t2.nrows, dtype=bool)
+        keep[0] = False
+        t3 = delta.apply_delete(t2, keep)
+        for f in SCHEMA:
+            assert t3.columns[f.name] is t2.columns[f.name]
+
+    def test_stats_merge_exact_bounds(self):
+        t3 = _mutate(_tbl())
+        st = delta.state_of(t3)
+        assert st.col_stats["d_id"]["lo"] == 0
+        assert st.col_stats["d_id"]["hi"] == 106
+
+    def test_content_digest_moves_and_is_pure(self):
+        t = _tbl()
+        d_base = fingerprint.table_digest(t)
+        t2 = delta.append_segment(t, _tbl(start=100, n=7), seg_id="s")
+        d_append = delta.state_of(t2).content_digest()
+        keep = np.ones(t2.nrows, dtype=bool)
+        keep[2] = False
+        d_del = delta.state_of(
+            delta.apply_delete(t2, keep)).content_digest()
+        assert len({d_base, d_append, d_del}) == 3
+        # pure function of the ops: replaying identical ops on an
+        # identically-built base reproduces the digest exactly
+        u2 = delta.append_segment(_tbl(), _tbl(start=100, n=7),
+                                  seg_id="s")
+        u3 = delta.apply_delete(u2, keep)
+        assert delta.state_of(u3).content_digest() == d_del
+
+
+# --------------------------------------------------------- persistence
+
+def _seed_warehouse(tmp_path):
+    """Baseline-only warehouse: one parquet file under <wh>/dtab/."""
+    wh = str(tmp_path / "wh")
+    tdir = os.path.join(wh, "dtab")
+    os.makedirs(tdir)
+    csv_io.write_table(_tbl(), os.path.join(tdir, "part-0.parquet"),
+                       "parquet")
+    return wh
+
+
+def _load_current(wh):
+    paths = SnapshotLog(wh).current(["dtab"])["dtab"]
+    return paths, delta.load_versioned("dtab", SCHEMA, paths, "parquet")
+
+
+def _persist_mutation(wh, commit):
+    """Replay the canonical mutation against the warehouse's current
+    version and persist it into _v1; append the snapshot manifest entry
+    only when ``commit`` — False models the crash inside the torn
+    window (files durable, manifest not)."""
+    log = SnapshotLog(wh)
+    _paths, base = _load_current(wh)
+    t3 = _mutate(base)
+    vdir = log.version_dir("dtab", 1)
+    files = delta.persist_pending(t3, vdir, note="LF_TEST")
+    assert files and os.path.basename(files[0]) == delta.OPS_NAME
+    if commit:
+        log.commit_delta(
+            "dtab", [os.path.relpath(p, wh) for p in files],
+            note="LF_TEST")
+    return t3, files
+
+
+class TestTornCommit:
+    def test_torn_commit_serves_previous_version(self, tmp_path):
+        wh = _seed_warehouse(tmp_path)
+        _paths0, base0 = _load_current(wh)
+        d0 = fingerprint.table_digest(base0)
+        t3, files = _persist_mutation(wh, commit=False)
+        # the delta artifacts are durable on disk...
+        assert all(os.path.exists(p) for p in files)
+        # ...but a fresh reader's manifest never references them: the
+        # baseline walk skips _v* dirs and serves version 0 unchanged
+        paths, reloaded = _load_current(wh)
+        assert not delta.has_delta_paths(paths)
+        assert reloaded.nrows == BASE_ROWS
+        assert delta.visible_rows(reloaded) == BASE_ROWS
+        assert fingerprint.table_digest(reloaded) == d0
+
+    def test_recovery_commit_publishes_without_rewriting(self, tmp_path):
+        wh = _seed_warehouse(tmp_path)
+        t3, files = _persist_mutation(wh, commit=False)
+        mtimes = {p: os.path.getmtime(p) for p in files}
+        # recovery: resume finds the version dir complete and only
+        # appends the manifest entry — the atomic commit point
+        log = SnapshotLog(wh)
+        assert not log.has_note("LF_TEST")
+        log.commit_delta("dtab",
+                         [os.path.relpath(p, wh) for p in files],
+                         note="LF_TEST")
+        assert SnapshotLog(wh).has_note("LF_TEST")
+        paths, eff = _load_current(wh)
+        assert delta.has_delta_paths(paths)
+        assert delta.visible_rows(eff) == BASE_ROWS + 7 - 5
+        assert (delta.state_of(eff).content_digest()
+                == delta.state_of(t3).content_digest())
+        np.testing.assert_array_equal(
+            delta.physical(eff).columns["d_id"].values,
+            delta.physical(t3).columns["d_id"].values)
+        assert mtimes == {p: os.path.getmtime(p) for p in files}
+
+    def test_committed_mutation_survives_reload(self, tmp_path):
+        wh = _seed_warehouse(tmp_path)
+        t3, _files = _persist_mutation(wh, commit=True)
+        _paths, eff = _load_current(wh)
+        assert delta.visible_rows(eff) == delta.visible_rows(t3)
+        assert (delta.state_of(eff).content_digest()
+                == delta.state_of(t3).content_digest())
+
+
+class TestDigestVerification:
+    """verify_digests is forced on under tests (conftest): every delta
+    artifact re-hashes against the version dir's manifest on load, and
+    the op list carries a CRC — each corruption class must raise
+    CorruptArtifact, and deterministically (same answer on retry)."""
+
+    def _committed(self, tmp_path):
+        wh = _seed_warehouse(tmp_path)
+        _persist_mutation(wh, commit=True)
+        return wh, os.path.join(wh, "dtab", "_v1")
+
+    def _assert_raises_twice(self, wh):
+        for _ in range(2):
+            paths = SnapshotLog(wh).current(["dtab"])["dtab"]
+            with pytest.raises(integrity.CorruptArtifact):
+                delta.load_versioned("dtab", SCHEMA, paths, "parquet")
+
+    def test_flipped_byte_in_segment_file(self, tmp_path):
+        wh, vdir = self._committed(tmp_path)
+        seg = os.path.join(vdir, "delta-0.parquet")
+        blob = bytearray(open(seg, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(seg, "wb").write(bytes(blob))
+        self._assert_raises_twice(wh)
+
+    def test_tampered_op_list_fails_crc(self, tmp_path):
+        wh, vdir = self._committed(tmp_path)
+        ops_path = os.path.join(vdir, delta.OPS_NAME)
+        with open(ops_path) as f:
+            doc = json.load(f)
+        doc["note"] = "tampered"  # stale crc stamp
+        with open(ops_path, "w") as f:
+            json.dump(doc, f)
+        self._assert_raises_twice(wh)
+
+    def test_truncated_mask_detected(self, tmp_path):
+        wh, vdir = self._committed(tmp_path)
+        [mask] = [f for f in os.listdir(vdir) if f.endswith(".npz")]
+        path = os.path.join(vdir, mask)
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[:len(blob) // 2])
+        self._assert_raises_twice(wh)
+
+
+class TestRollback:
+    def test_rollback_to_baseline_restores_bytes(self, tmp_path):
+        wh = _seed_warehouse(tmp_path)
+        _paths0, base0 = _load_current(wh)
+        d0 = fingerprint.table_digest(base0)
+        _persist_mutation(wh, commit=True)
+        assert delta.has_delta_paths(
+            SnapshotLog(wh).current(["dtab"])["dtab"])
+        log = SnapshotLog(wh)
+        assert log.rollback_to_timestamp(0.0) is None
+        paths, reloaded = _load_current(wh)
+        assert not delta.has_delta_paths(paths)
+        assert fingerprint.table_digest(reloaded) == d0
+        # and the persisted manifest agrees for the NEXT process too
+        assert SnapshotLog(wh).entries == []
+
+
+class TestValidateSummary:
+    def test_update_summary_patches_status(self, tmp_path):
+        folder = str(tmp_path / "json")
+        os.makedirs(folder)
+        for q in ("query7", "query96"):
+            with open(os.path.join(folder, f"{q}.json"), "w") as f:
+                json.dump({"query": q, "queryStatus": ["Completed"]}, f)
+        with open(os.path.join(folder, "notes.json"), "w") as f:
+            json.dump({"info": "no query key"}, f)
+        validate.update_summary(folder, ["query7"])
+        get = lambda q: json.load(  # noqa: E731
+            open(os.path.join(folder, f"{q}.json")))
+        assert get("query7")["queryValidationStatus"] == ["NotMatch"]
+        assert get("query96")["queryValidationStatus"] == ["Match"]
+        with open(os.path.join(folder, "notes.json")) as f:
+            assert "queryValidationStatus" not in json.load(f)

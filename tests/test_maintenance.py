@@ -8,6 +8,7 @@ import os
 import numpy as np
 import pytest
 
+from nds_tpu.columnar import delta
 from nds_tpu.datagen import tpcds
 from nds_tpu.engine.session import Session
 from nds_tpu.io.host_table import from_arrays
@@ -16,6 +17,13 @@ from nds_tpu.nds import gen_data, maintenance, transcode
 from nds_tpu.nds.schema import get_schemas
 
 SF = 0.01
+
+
+def _nrows(sess, table):
+    """Logical row count: DELETEs land as delta bitmasks, so
+    ``table.nrows`` stays physical and the visible count subtracts the
+    masked rows."""
+    return delta.visible_rows(sess.tables[table])
 
 
 def _session(tables=("store_sales", "store_returns", "date_dim",
@@ -63,7 +71,7 @@ class TestDml:
             "'1998-01-01' and '1998-01-31') and ss_sold_date_sk <= "
             "(select max(d_date_sk) from date_dim where d_date between "
             "'1998-01-01' and '1998-01-31')")
-        assert sess.tables["store_sales"].nrows == n0 - in_window
+        assert _nrows(sess, "store_sales") == n0 - in_window
 
     def test_delete_null_dates_survive(self):
         """SQL DELETE keeps rows where the predicate is NULL — the
@@ -74,10 +82,15 @@ class TestDml:
         n_null = int((~col.null_mask).sum())
         assert n_null > 0
         sess.sql("delete from store_sales where ss_sold_date_sk >= 0")
-        col2 = sess.tables["store_sales"].column("ss_sold_date_sk")
-        assert sess.tables["store_sales"].nrows == n_null
-        assert not col2.null_mask.any() if col2.null_mask is not None \
-            else True
+        tbl = sess.tables["store_sales"]
+        assert _nrows(sess, "store_sales") == n_null
+        # every surviving (live) row has a NULL date
+        live = delta.live_mask(tbl)
+        col2 = tbl.column("ss_sold_date_sk")
+        assert col2.null_mask is not None
+        surviving_valid = col2.null_mask if live is None \
+            else col2.null_mask[live]
+        assert not surviving_valid.any()
 
     def test_delete_in_subquery(self):
         sess = _session()
@@ -93,7 +106,7 @@ class TestDml:
             "(select distinct ss_ticket_number from store_sales, "
             "date_dim where ss_sold_date_sk=d_date_sk and d_date "
             "between '1998-02-01' and '1998-03-01')")
-        assert sess.tables["store_returns"].nrows == n0 - expected
+        assert _nrows(sess, "store_returns") == n0 - expected
 
     def test_dml_invalidates_plan_cache(self):
         sess = _session()
@@ -117,7 +130,7 @@ class TestDml:
         over_50_dollars = int(r.cols[0][0])
         n0 = sess.tables["store_sales"].nrows
         sess.sql("delete from store_sales where ss_sales_price > 50.00")
-        assert sess.tables["store_sales"].nrows == n0 - over_50_dollars
+        assert _nrows(sess, "store_sales") == n0 - over_50_dollars
 
     def test_delete_date_string_literal_coercion(self):
         sess = _session(("date_dim",))
@@ -126,7 +139,7 @@ class TestDml:
                      "where d_date >= '2000-01-01'")
         after = int(r.cols[0][0])
         sess.sql("delete from date_dim where d_date >= '2000-01-01'")
-        assert sess.tables["date_dim"].nrows == n0 - after
+        assert _nrows(sess, "date_dim") == n0 - after
 
     def test_insert_rejects_trailing_statement(self):
         sess = _session()
@@ -160,7 +173,7 @@ class TestMaintenanceRun:
             power_core.load_warehouse(
                 SUITE, sess, warehouse["wh"],
                 tables=maintenance.MUTABLE_TABLES)
-            return {t: sess.tables[t].nrows
+            return {t: delta.visible_rows(sess.tables[t])
                     for t in maintenance.MUTABLE_TABLES}
 
         before = fact_counts()
@@ -258,7 +271,7 @@ def test_maintenance_functions_on_device_engine():
     assert n1 > n0, "device-engine LF_SS must insert rows"
     maintenance.run_dm_query(
         sess, maintenance.replace_date(qs["DF_SS"], d1, d2))
-    assert sess.tables["store_sales"].nrows < n1
+    assert delta.visible_rows(sess.tables["store_sales"]) < n1
 
 
 @pytest.mark.slow

@@ -1852,6 +1852,7 @@ class TestBenchResumableExit:
         from nds_tpu.nds import bench as bench_mod
         from nds_tpu.utils.timelog import TimeLog
         calls = []
+        maint_calls = []
         rcs = [75, 75, 0]
 
         def fake_run(cmd, backend=None, extra_env=None):
@@ -1859,12 +1860,16 @@ class TestBenchResumableExit:
                 with open(cmd[5], "w") as f:
                     f.write("Total conversion time for 24 tables was "
                             "5.0s\nRNGSEED used: 123\n")
-            elif cmd[2] == "nds_tpu.nds.maintenance":
+
+        def fake_run_rc(cmd, backend=None, extra_env=None):
+            # maintenance rides _run_rc too (its commit journal makes
+            # exit 75 resumable); here it just succeeds
+            if cmd[2] == "nds_tpu.nds.maintenance":
+                maint_calls.append(list(cmd))
                 t = TimeLog("fake")
                 t.add("Data Maintenance Time", 1500)
                 t.write(cmd[5])
-
-        def fake_run_rc(cmd, backend=None, extra_env=None):
+                return 0
             calls.append(list(cmd))
             rc = rcs.pop(0)
             if rc == 0:
@@ -1897,6 +1902,8 @@ class TestBenchResumableExit:
         assert "--resume" not in calls[0]       # fresh first launch
         assert "--resume" in calls[1]           # both retries resume
         assert "--resume" in calls[2]
+        assert len(maint_calls) == 2            # one per round
+        assert all("--resume" not in c for c in maint_calls)
 
     def test_power_non_resumable_failure_still_raises(self, tmp_path,
                                                       monkeypatch):

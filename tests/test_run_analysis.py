@@ -235,6 +235,73 @@ class TestDiffGate:
         assert "REGRESSION" in out and "DIFF FAILED" in out
 
 
+# ----------------------------------------------- maintenance refreshes
+
+def _analysis(times: dict) -> dict:
+    """Minimal analyze_run-shaped dict from {name: wall_ms}."""
+    rows = [{"query": q, "wall_ms": w, "start_time": i,
+             "categories": {"compile": 0.0, "retry_backoff": 0.0,
+                            "prefetch_wait": 0.0},
+             "residual_ms": 0.0, "compiles": 0,
+             "status": "Completed"}
+            for i, (q, w) in enumerate(sorted(times.items()))]
+    return {"queries": rows, "failed": [], "run_dir": "x"}
+
+
+class TestMaintGate:
+    def test_refresh_regression_fails_gate(self):
+        base = _analysis({"query1": 100.0, "LF_WS": 200.0})
+        cur = _analysis({"query1": 100.0, "LF_WS": 500.0})
+        d = analyze.diff_runs(base, cur, pct=10.0, abs_ms=50.0)
+        assert not d["passed"]
+        regressed = [e for e in d["maint_changes"]
+                     if e.get("regressed")]
+        assert [e["query"] for e in regressed] == ["LF_WS"]
+        # the refresh function never leaks into the query-side diff
+        assert not d["regressions"]
+        assert "MAINT-REGRESSED" in analyze.format_diff(d)
+
+    def test_refresh_noise_and_improvement_pass(self):
+        base = _analysis({"LF_WS": 200.0, "DF_SS": 400.0})
+        cur = _analysis({"LF_WS": 210.0, "DF_SS": 300.0})
+        d = analyze.diff_runs(base, cur, pct=10.0, abs_ms=50.0)
+        assert d["passed"]
+        assert not any(e.get("regressed") for e in d["maint_changes"])
+
+    def test_missing_refresh_function_fails_gate(self):
+        base = _analysis({"query1": 100.0, "DF_I": 50.0})
+        cur = _analysis({"query1": 100.0})
+        d = analyze.diff_runs(base, cur, pct=10.0, abs_ms=50.0)
+        assert not d["passed"]
+        assert any(e.get("removed") and e["query"] == "DF_I"
+                   for e in d["maint_changes"])
+        # removed maintenance functions report under MAINT, not the
+        # query-side removed list
+        assert d["removed"] == []
+
+    def test_query_only_runs_emit_no_maint_block(self):
+        a = analyze.analyze_run(RUN_A)
+        assert analyze.diff_runs(a, a)["maint_changes"] == []
+
+    def test_delta_column_in_attribution(self):
+        row = analyze.attribute_query({
+            "query": "q", "queryStatus": ["Completed"],
+            "queryTimes": [10], "startTime": 1,
+            "engineTimings": {"delta_segments": 2.0,
+                              "delta_appended_rows": 40.0,
+                              "delta_masked_rows": 12.0},
+        })
+        assert row["delta_segments"] == 2
+        assert row["delta_masked_rows"] == 12
+        text = analyze.format_attribution(
+            {"queries": [row],
+             "totals": {"wall_ms": 10.0,
+                        "categories": row["categories"],
+                        "residual_ms": row["residual_ms"]},
+             "slowest": ["q"]})
+        assert "delta" in text and "2s +40 -12" in text
+
+
 # -------------------------------------------------------------- report
 
 class _TagBalance(html.parser.HTMLParser):

@@ -557,3 +557,64 @@ def test_rule_unchained_signal_handler():
 def test_nds114_in_default_rules():
     assert any(r.id == "NDS114"
                for r in lint_rules.default_rules())
+
+
+def test_rule_unjournaled_mutation():
+    # a raw subscript store into a .tables catalog flags
+    src = ("def swap(sess, name, t):\n"
+           "    sess.tables[name] = t\n")
+    assert _rules(_lint(src, path="nds_tpu/obs/fixture.py",
+                        enabled={"NDS119"}).violations) == {"NDS119"}
+    # so do del and the dict mutator methods on .tables/.columns
+    extra = ("def drop(sess, store, name):\n"
+             "    del sess.tables[name]\n"
+             "    store.columns.pop(name, None)\n"
+             "    sess.tables.update({name: None})\n"
+             "    sess.tables.clear()\n")
+    res = _lint(extra, path="nds_tpu/obs/fixture.py",
+                enabled={"NDS119"})
+    assert len(res.violations) == 4
+    # reads and mutation of unrelated attributes are clean
+    clean = ("def peek(sess, name):\n"
+             "    t = sess.tables[name]\n"
+             "    sess.caches[name] = t\n"
+             "    return sess.tables.get(name)\n")
+    assert _lint(clean, path="nds_tpu/obs/fixture.py",
+                 enabled={"NDS119"}).violations == []
+    # the journaled machinery itself is the blessed mutation path
+    for allowed in ("nds_tpu/engine/session.py",
+                    "nds_tpu/engine/dml.py",
+                    "nds_tpu/columnar/delta.py",
+                    "nds_tpu/io/host_table.py"):
+        assert _lint(src, path=allowed,
+                     enabled={"NDS119"}).violations == []
+    # outside nds_tpu/ the rule does not apply
+    assert _lint(src, path="tools/fixture.py",
+                 enabled={"NDS119"}).violations == []
+    # waivable with justification
+    waived = ("def swap(sess, name, t):\n"
+              "    # ndslint: waive[NDS119] -- fixture-local dict\n"
+              "    sess.tables[name] = t\n")
+    res = _lint(waived, path="nds_tpu/obs/fixture.py",
+                enabled={"NDS119"})
+    assert res.violations == [] and len(res.waived) == 1
+    # the production tree holds the invariant: every catalog write
+    # under nds_tpu/ is journaled machinery or an audited waiver
+    # (device_exec staged temps, plan_verify cost accumulator)
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    offenders = []
+    for p in (repo / "nds_tpu").rglob("*.py"):
+        txt = p.read_text()
+        if ".tables[" in txt or ".columns[" in txt \
+                or ".tables." in txt or ".columns." in txt:
+            res = lint_rules.lint_sources(
+                {str(p.relative_to(repo)): txt},
+                enabled={"NDS119"})
+            offenders += res.violations
+    assert offenders == [], offenders
+
+
+def test_nds119_in_default_rules():
+    assert any(r.id == "NDS119"
+               for r in lint_rules.default_rules())

@@ -1,0 +1,420 @@
+"""Crash-safe writable-warehouse gate: chaos-proven full-bench metric.
+
+tier-1 (via tools/static_checks.py) proves the delta-segment writable
+warehouse (nds_tpu/columnar/delta.py, journaled maintenance in
+nds_tpu/nds/maintenance.py; README "Benchmark phases") end-to-end:
+
+1. **full sweep + mid-maintenance SIGKILL** — a real
+   ``python -m nds_tpu.nds.bench`` run (SF0.01, 3-query streams)
+   executes load -> power -> throughput -> maintenance -> validate ->
+   metric. A ``dml.apply`` fault injection wedges LF_WS mid-round-1 and
+   the whole process group is SIGKILLed — the unjournaled crash, not a
+   graceful drain.
+2. **resume, zero double-applies** — ``bench --resume`` replays the
+   journaled phases and the maintenance commit journal: every function
+   committed before the kill keeps ``starts == [0]`` (incarnation 0,
+   never re-applied), the victim re-runs exactly once, and both rounds
+   end with all 11 LF_*/DF_* functions done. The composite metric folds
+   both Tdm terms.
+3. **validate phase** — the resumed bench's validate phase re-runs the
+   power stream on the maintained warehouse against a CPU oracle and
+   must match (``validation_ok``), proving the journal accounting above
+   with results, not bookkeeping.
+4. **encoded store survives maintenance** — the snapshot lineage of
+   every mutated table still references its BASELINE part files plus
+   ``_v*/`` delta segments (base encoded columns never rewritten), a
+   device-placement run over the maintained warehouse digest-matches a
+   fresh CPU oracle, and every device summary reports
+   ``compression_ratio > 1``.
+5. **rollback restores pre-maintenance bytes** — manifest truncation
+   (nds/rollback.py) then a power re-run reproduces the original power
+   phase's result digests byte-identically.
+6. **invalidation scope** — a DML insert into one table evicts only
+   plans scanning it: an unrelated query keeps its plan-cache entry and
+   re-runs with ZERO compiles; the mutated table's query reflects the
+   new rows.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SCALE = 0.01
+TEMPLATES = [96, 7, 93]   # store_sales-heavy: maintenance moves them
+VICTIM = "LF_WS"
+# wedge LF_WS's INSERT inside dml.apply (scope matches the ctx table
+# value "web_sales"; times defaults to 1 so only the first match hangs)
+FAULT = "dml.apply:hang=120@web_sales"
+WAIT_S = 240
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _tail(path: str, n: int = 30) -> str:
+    try:
+        with open(path, errors="replace") as f:
+            return "".join(f.readlines()[-n:])
+    except OSError:
+        return "<no log>"
+
+
+def _read_journal(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return doc.get("queries", {}) if isinstance(doc, dict) else {}
+
+
+def _digests(json_dir: str) -> dict:
+    q = _read_journal(os.path.join(json_dir, "power-nds_queries.json"))
+    return {name: e.get("result_digest") for name, e in q.items()}
+
+
+def _write_cfg(wd: str) -> str:
+    import yaml
+    cfg = {
+        "scale_factor": SCALE,
+        "parallel": 1,
+        "num_streams": 1,        # -> 3 streams: power + 1 per half
+        "backend": "cpu",
+        "paths": {
+            "raw_data": os.path.join(wd, "raw"),
+            "refresh_data": os.path.join(wd, "refresh"),
+            "warehouse": os.path.join(wd, "wh"),
+            "streams": os.path.join(wd, "streams"),
+            "reports": os.path.join(wd, "reports"),
+        },
+        "validate": {"epsilon": 0.00001},
+        # streams are pre-generated with the 3 maintenance-sensitive
+        # templates — the full 99-template sweep belongs to the slow
+        # orchestrator test, not a tier-1 gate
+        "skip": {"stream_gen": True},
+    }
+    path = os.path.join(wd, "bench.yml")
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    return path
+
+
+def _env(faults: str | None = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    env.pop("NDS_TPU_FAULTS", None)
+    if faults:
+        env["NDS_TPU_FAULTS"] = faults
+    return env
+
+
+def _funcs():
+    from nds_tpu.nds import maintenance
+    return (maintenance.INSERT_FUNCS + maintenance.DELETE_FUNCS
+            + maintenance.INVENTORY_DELETE_FUNCS)
+
+
+def _bench_kill_resume(wd: str) -> int:
+    """Sections 1-3: the chaos bench run, resume accounting, validate
+    phase, and the composite metric with both Tdm terms folded in."""
+    from nds_tpu.nds import maintenance
+    from nds_tpu.nds.streams import generate_query_streams
+
+    sdir = os.path.join(wd, "streams")
+    generate_query_streams(sdir, 3, templates=TEMPLATES,
+                           qualification=False)
+    cfg_path = _write_cfg(wd)
+    wh = os.path.join(wd, "wh")
+    jpath = maintenance.journal_path(wh, os.path.join(wd, "refresh1"))
+
+    log1 = os.path.join(wd, "bench1.log")
+    cmd = [sys.executable, "-m", "nds_tpu.nds.bench", cfg_path]
+    with open(log1, "w") as lf:
+        proc = subprocess.Popen(cmd, cwd=ROOT, env=_env(faults=FAULT),
+                                stdout=lf, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        deadline = time.time() + WAIT_S
+        wedged = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return _fail(
+                    f"bench exited (rc={proc.returncode}) before the "
+                    f"{VICTIM} fault wedged it:\n{_tail(log1)}")
+            q = _read_journal(jpath)
+            v = q.get(VICTIM, {})
+            if v.get("done"):
+                return _fail(f"{VICTIM} completed — the dml.apply "
+                             f"fault never fired")
+            if v.get("starts"):
+                wedged = True
+                break
+            time.sleep(0.3)
+        if not wedged:
+            proc.kill()
+            return _fail(f"bench never reached {VICTIM} within "
+                         f"{WAIT_S}s:\n{_tail(log1)}")
+        time.sleep(0.5)  # let the statement reach the hang site
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+
+    funcs = _funcs()
+    before = _read_journal(jpath)
+    committed_before = [f for f in funcs if before.get(f, {}).get("done")]
+    if not committed_before:
+        return _fail("kill landed before any maintenance function "
+                     "committed — the chaos window missed")
+    if before.get(VICTIM, {}).get("done"):
+        return _fail(f"{VICTIM} journaled done before the kill")
+    print(f"OK: SIGKILL mid-maintenance with "
+          f"{len(committed_before)}/{len(funcs)} functions committed, "
+          f"{VICTIM} in flight")
+
+    log2 = os.path.join(wd, "bench2.log")
+    with open(log2, "w") as lf:
+        rc = subprocess.run(cmd + ["--resume"], cwd=ROOT, env=_env(),
+                            stdout=lf, stderr=subprocess.STDOUT,
+                            timeout=WAIT_S * 2).returncode
+    if rc != 0:
+        return _fail(f"bench --resume exited {rc}:\n{_tail(log2)}")
+
+    # journal accounting: zero double-applied mutations
+    after = _read_journal(jpath)
+    for fname in funcs:
+        e = after.get(fname, {})
+        if not e.get("done"):
+            return _fail(f"round 1: {fname} not done after resume")
+    for fname in committed_before:
+        e = after[fname]
+        if e.get("starts") != [0] or e.get("incarnation") != 0:
+            return _fail(
+                f"round 1: {fname} was re-applied after resume "
+                f"(starts={e.get('starts')}, "
+                f"incarnation={e.get('incarnation')}) — journal must "
+                f"replay committed functions, never re-run them")
+    ve = after[VICTIM]
+    if len(ve.get("starts", [])) != 2:
+        return _fail(f"round 1: {VICTIM} starts={ve.get('starts')} — "
+                     f"expected exactly one pre-kill + one resume start")
+    j2 = _read_journal(maintenance.journal_path(
+        wh, os.path.join(wd, "refresh2")))
+    redone = [f for f in funcs if not j2.get(f, {}).get("done")]
+    if redone:
+        return _fail(f"round 2 incomplete after resume: {redone}")
+    print(f"OK: resume — {len(funcs)} functions done both rounds, "
+          f"{len(committed_before)} replayed from journal untouched, "
+          f"{VICTIM} re-ran exactly once")
+
+    # the resumed run's validate phase compared the maintained
+    # warehouse against a CPU oracle and the metric folded both Tdm
+    with open(os.path.join(wd, "reports", "bench_state.json")) as f:
+        phases = json.load(f).get("phases", {})
+    for ph in ("power_test", "throughput_1", "maintenance_1",
+               "throughput_2", "maintenance_2", "validate"):
+        if ph not in phases:
+            return _fail(f"bench_state.json missing phase {ph}")
+    if phases["validate"]["timings"].get("validation_ok") != 1:
+        return _fail("validate phase did not pass against the CPU "
+                     "oracle on the maintained warehouse")
+    with open(os.path.join(wd, "reports", "metrics.csv")) as f:
+        row = list(csv.DictReader(f))[0]
+    if not row["metric"] or int(row["metric"]) <= 0:
+        return _fail(f"composite metric missing: {row!r}")
+    for col in ("maintenance1_s", "maintenance2_s"):
+        if float(row[col]) <= 0:
+            return _fail(f"{col} not folded into the metric: {row!r}")
+    print(f"OK: validate phase matched the CPU oracle; metric="
+          f"{row['metric']} with Tdm {row['maintenance1_s']}s + "
+          f"{row['maintenance2_s']}s folded in")
+    return 0
+
+
+def _post_state(wd: str) -> int:
+    """Sections 4-5: encoded store intact through maintenance (device
+    differential + compression ratio + baseline lineage), rollback
+    restores pre-maintenance digests byte-identically."""
+    from nds_tpu.columnar import delta
+    from nds_tpu.io.snapshots import SnapshotLog
+    from nds_tpu.nds import rollback
+    from nds_tpu.nds.maintenance import MUTABLE_TABLES
+    from nds_tpu.nds.power import SUITE
+    from nds_tpu.utils.config import EngineConfig
+    from nds_tpu.utils.power_core import run_query_stream
+
+    wh = os.path.join(wd, "wh")
+    stream0 = os.path.join(wd, "streams", "query_0.sql")
+
+    # base files never rewritten: every mutated table's live lineage is
+    # its baseline parts plus versioned delta segments
+    import re
+    vdir = re.compile(r"(?:^|[\\/])_v\d+[\\/]")
+    log = SnapshotLog(wh)
+    current = log.current(MUTABLE_TABLES)
+    for t in MUTABLE_TABLES:
+        rel = [os.path.relpath(p, wh) for p in current.get(t, [])]
+        if not any(vdir.search(p) for p in rel):
+            return _fail(f"{t}: no versioned delta files in lineage "
+                         f"({rel})")
+        if not delta.has_delta_paths(rel):
+            return _fail(f"{t}: lineage lost its delta segments — "
+                         f"maintenance must not rewrite the base")
+        if not [p for p in rel if not vdir.search(p)]:
+            return _fail(f"{t}: baseline part files dropped from "
+                         f"lineage — base was rewritten")
+
+    pre = _digests(os.path.join(wd, "reports", "json"))
+    if not pre or any(d is None for d in pre.values()):
+        return _fail(f"power phase journal has no result digests: {pre}")
+
+    # device placement over the maintained warehouse (encoded store +
+    # delta live-masks upload) vs a fresh CPU oracle
+    runs = {}
+    for tag, backend in (("dev", "tpu"), ("orc", "cpu")):
+        jdir = os.path.join(wd, f"post_{tag}_json")
+        cfg = EngineConfig(overrides={"engine.backend": backend,
+                                      "columnar.encode": "auto"})
+        failures = run_query_stream(
+            SUITE, wh, stream0,
+            os.path.join(wd, f"post_{tag}_time.csv"),
+            config=cfg, json_summary_folder=jdir,
+            output_prefix=os.path.join(wd, f"post_{tag}_out"))
+        if failures:
+            return _fail(f"post-maintenance {tag} run: {failures} "
+                         f"queries failed")
+        runs[tag] = _digests(jdir)
+    # cross-backend diff is order-insensitive (under-specified ORDER BY
+    # ties land differently per placement), exactly like the bench's
+    # validate phase
+    from nds_tpu.nds.validate import iterate_queries
+    unmatched = iterate_queries(
+        os.path.join(wd, "post_dev_out"),
+        os.path.join(wd, "post_orc_out"), stream0,
+        ignore_ordering=True, epsilon=0.00001)
+    if unmatched:
+        return _fail(f"post-maintenance device results diverge from "
+                     f"the CPU oracle: {unmatched}")
+    if runs["orc"] == pre:
+        return _fail("maintenance was a no-op: post-maintenance "
+                     "digests identical to pre-maintenance")
+    ratios = {}
+    jdir = os.path.join(wd, "post_dev_json")
+    for name in os.listdir(jdir):
+        if name.endswith("_queries.json"):
+            continue
+        with open(os.path.join(jdir, name)) as f:
+            s = json.load(f)
+        r = (s.get("engineTimings") or {}).get("compression_ratio")
+        if r is not None:
+            ratios[s.get("query", name)] = r
+    if not ratios or min(ratios.values()) <= 1.0:
+        return _fail(f"compression_ratio must stay > 1 through "
+                     f"maintenance: {ratios}")
+    print(f"OK: maintained warehouse — device digests == CPU oracle "
+          f"on {len(runs['dev'])} queries, compression ratios "
+          f"{min(ratios.values()):.2f}..{max(ratios.values()):.2f}")
+
+    # rollback = manifest truncation; a power re-run must reproduce the
+    # ORIGINAL power phase byte-for-byte
+    rollback.rollback(wh, 0.0)
+    rb_jdir = os.path.join(wd, "rb_json")
+    failures = run_query_stream(
+        SUITE, wh, stream0, os.path.join(wd, "rb_time.csv"),
+        config=EngineConfig(overrides={"engine.backend": "cpu"}),
+        json_summary_folder=rb_jdir)
+    if failures:
+        return _fail(f"post-rollback run: {failures} queries failed")
+    rb = _digests(rb_jdir)
+    if rb != pre:
+        diff = {q for q in pre if rb.get(q) != pre[q]}
+        return _fail(f"rollback did not restore pre-maintenance "
+                     f"digests: {sorted(diff)}")
+    print(f"OK: rollback restored all {len(pre)} pre-maintenance "
+          f"query digests byte-identically")
+    return 0
+
+
+def _invalidation_scope() -> int:
+    """Section 6: DML invalidation is table-scoped — an unrelated
+    query's plan survives a mutation and re-runs with zero compiles."""
+    from nds_tpu.datagen import tpcds
+    from nds_tpu.engine.device_exec import make_device_factory
+    from nds_tpu.engine.session import Session
+    from nds_tpu.io.host_table import from_arrays
+    from nds_tpu.nds.schema import get_schemas
+    from nds_tpu.obs import metrics as obs_metrics
+
+    schemas = get_schemas()
+    sess = Session.for_nds(make_device_factory())
+    for t in ("web_sales", "date_dim"):
+        sess.register_table(
+            from_arrays(t, schemas[t], tpcds.gen_table(t, SCALE)))
+
+    q_dim = "select count(*) as c from date_dim where d_year = 2000"
+    q_fact = "select count(*) as c from web_sales"
+    dim0 = int(sess.sql(q_dim).cols[0][0])
+    keys_dim = set(sess._plan_cache)
+    fact0 = int(sess.sql(q_fact).cols[0][0])
+    exp = int(sess.sql("select count(*) as c from web_sales "
+                       "where ws_quantity > 95").cols[0][0])
+    keys_fact = set(sess._plan_cache) - keys_dim
+    sess.sql(q_dim), sess.sql(q_fact)  # warm
+
+    sess.sql("insert into web_sales "
+             "(select * from web_sales where ws_quantity > 95)")
+    keys_after = set(sess._plan_cache)
+    if not keys_dim <= keys_after:
+        return _fail("DML to web_sales evicted the date_dim plan — "
+                     "invalidation must scope to the mutated table")
+    if keys_fact & keys_after:
+        return _fail("DML to web_sales left stale web_sales plans "
+                     "cached")
+
+    snap = obs_metrics.snapshot()
+    dim1 = int(sess.sql(q_dim).cols[0][0])
+    compiles = obs_metrics.delta(snap, obs_metrics.snapshot())[
+        "counters"].get("compiles_total", 0)
+    if compiles:
+        return _fail(f"unaffected query recompiled after unrelated "
+                     f"DML: {compiles} compiles (want 0)")
+    if dim1 != dim0:
+        return _fail(f"unaffected query changed answer: {dim0} -> "
+                     f"{dim1}")
+    fact1 = int(sess.sql(q_fact).cols[0][0])
+    if fact1 != fact0 + exp:
+        return _fail(f"mutated-table query missed the insert: "
+                     f"{fact0} + {exp} != {fact1}")
+    print(f"OK: invalidation scoped — date_dim plan survived "
+          f"(0 compiles on re-run), web_sales count {fact0} -> {fact1}")
+    return 0
+
+
+def main(argv=None) -> int:
+    wd = tempfile.mkdtemp(prefix="maint_check_")
+    try:
+        rc = (_bench_kill_resume(wd) or _post_state(wd)
+              or _invalidation_scope())
+    finally:
+        if os.environ.get("NDS_TPU_MAINT_KEEP"):
+            print(f"keeping workdir {wd}")
+        else:
+            shutil.rmtree(wd, ignore_errors=True)
+    if rc == 0:
+        print("MAINT CHECK OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

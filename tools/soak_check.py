@@ -35,8 +35,13 @@ subprocesses on a tiny in-memory warehouse (SF0.01):
   (the journal is byte-flipped between incarnations: the resume must
   degrade to a warned fresh start, count ``journal_resets_total``,
   surface it in the summaries' ``degradations`` block, and STILL
-  converge to the clean digests) and an NDS-H drain round — both
-  suites survive, not just NDS.
+  converge to the clean digests), a kill-during-maintenance round (a
+  randomized LF_* refresh function is hard-killed inside ``dml.apply``
+  after its commit-journal START-mark; ``--resume`` must apply every
+  refresh function exactly once and a second resume must be a no-op —
+  the WRITE path honors the same at-most-once contract as the read
+  path, tools/maint_check.py proves the result-level half) and an
+  NDS-H drain round — both suites survive, not just NDS.
 """
 
 from __future__ import annotations
@@ -441,6 +446,122 @@ def run_ndsh_drain(workdir: str) -> int:
     return 0
 
 
+# each LF_* refresh function inserts into exactly one fact table (the
+# shipped data_maintenance SQL), and the insert functions run before
+# every delete — so a dml.apply hang scoped to the table wedges
+# deterministically inside its LF_* function, nowhere else
+_LF_TABLE = {"LF_CR": "catalog_returns", "LF_CS": "catalog_sales",
+             "LF_I": "inventory", "LF_SR": "store_returns",
+             "LF_SS": "store_sales", "LF_WR": "web_returns",
+             "LF_WS": "web_sales"}
+
+
+def run_maintenance_kill(workdir: str, seed: int) -> int:
+    """--full round: kill -9 mid-maintenance with a randomized victim
+    refresh function wedged inside ``dml.apply`` (after its journal
+    START-mark, before its snapshot commit), then ``--resume``. The
+    write path's journal accounting must mirror the power loop's: every
+    function done exactly once, only the victim restarted, functions
+    committed before the kill replayed (never re-applied), and a second
+    resume a pure no-op."""
+    import random
+    from nds_tpu.nds.maintenance import (
+        DELETE_FUNCS, INSERT_FUNCS, INVENTORY_DELETE_FUNCS,
+        journal_path)
+    rng = random.Random(seed)
+    victim = rng.choice(sorted(_LF_TABLE))
+    table = _LF_TABLE[victim]
+    raw = os.path.join(workdir, "raw")
+    wh = os.path.join(workdir, "maint_wh")
+    refresh = os.path.join(workdir, "maint_refresh")
+    mdir = os.path.join(workdir, "maint")
+    os.makedirs(mdir, exist_ok=True)
+    from nds_tpu.nds import gen_data
+    gen_data.generate_refresh_data(SCALE, 1, refresh)
+    rc = subprocess.run(
+        [sys.executable, "-m", "nds_tpu.nds.transcode", raw, wh,
+         os.path.join(mdir, "load_report.txt")], env=_env()).returncode
+    if rc != 0:
+        return _fail(f"maint round: transcode exited {rc}")
+
+    cmd = [sys.executable, "-m", "nds_tpu.nds.maintenance", wh,
+           refresh, os.path.join(mdir, "dm.csv"), "--backend", "cpu",
+           "--json_summary_folder", mdir]
+    jpath = journal_path(wh, refresh)
+    proc = subprocess.Popen(
+        cmd, env=_env(f"dml.apply:hang={HANG_S}@{table}"))
+    try:
+        deadline = time.monotonic() + 120.0
+        wedged = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                with open(jpath) as f:
+                    q = json.load(f).get("queries", {}).get(victim, {})
+            except (OSError, ValueError):
+                q = {}
+            if q.get("starts") and not q.get("done"):
+                wedged = True
+                break
+            # ndslint: waive[NDS108] -- deadline-bounded journal poll waiting on an external child process, not a retry; constant interval is the sampling rate
+            time.sleep(0.1)
+        if not wedged:
+            proc.kill()
+            proc.wait()
+            return _fail(f"maint round: {victim} never journaled a "
+                         f"start before the kill window")
+        time.sleep(0.5)
+        proc.kill()
+        rc = proc.wait(timeout=WAIT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return _fail("maint round: killed run never exited")
+    if rc != -signal.SIGKILL:
+        return _fail(f"maint round: expected SIGKILL death, got {rc}")
+    with open(jpath) as f:
+        before = json.load(f).get("queries", {})
+    committed = [q for q, e in before.items() if e.get("done")]
+    if before.get(victim, {}).get("done"):
+        return _fail(f"maint round: {victim} cannot be done after a "
+                     f"mid-dml kill")
+
+    for attempt in ("resume", "idempotent-resume"):
+        rc = subprocess.run(cmd + ["--resume"], env=_env(),
+                            timeout=WAIT_S).returncode
+        if rc != 0:
+            return _fail(f"maint round: {attempt} exited {rc}")
+        with open(jpath) as f:
+            after = json.load(f).get("queries", {})
+        funcs = INSERT_FUNCS + DELETE_FUNCS + INVENTORY_DELETE_FUNCS
+        for fname in funcs:
+            e = after.get(fname, {})
+            if not e.get("done"):
+                return _fail(f"maint round: {fname} not done after "
+                             f"{attempt}: {e}")
+            starts = e.get("starts", [])
+            want = 2 if fname == victim else 1
+            if len(starts) != want:
+                return _fail(
+                    f"maint round ({attempt}): {fname} dispatched "
+                    f"{len(starts)}x (starts={starts}), expected "
+                    f"{want} — "
+                    + ("the killed function must re-run exactly once"
+                       if fname == victim else
+                       "a journaled function must NEVER re-apply"))
+        for fname in committed:
+            if after.get(fname, {}).get("starts") != \
+                    before[fname].get("starts"):
+                return _fail(f"maint round ({attempt}): {fname} was "
+                             f"committed before the kill but "
+                             f"re-dispatched after it")
+    print(f"OK: soak maintenance round (kill -9 inside {victim}, "
+          f"resume applied each refresh function exactly once, second "
+          f"resume a no-op)")
+    return 0
+
+
 def run_full(workdir: str, rounds: int, seed: int) -> int:
     import random
     from nds_tpu.nds import streams
@@ -471,6 +592,7 @@ def run_full(workdir: str, rounds: int, seed: int) -> int:
             print(f"OK: soak round {i} ({kind}@{victim}) converged")
     rc |= run_oom_round(workdir)
     rc |= run_torn_journal(workdir)
+    rc |= run_maintenance_kill(workdir, seed)
     rc |= run_ndsh_drain(workdir)
     return rc
 

@@ -131,6 +131,24 @@ the one to run locally before pushing:
                         analyze, an overload burst sheds
                         (server_shed_total > 0) without a single
                         error, and the TCP JSON-lines front answers
+ 10d. maint             crash-safe writable-warehouse gate
+                        (tools/maint_check.py): a real full-bench run
+                        (load -> power -> throughput -> maintenance ->
+                        validate -> metric, SF0.01, 3-query streams)
+                        is SIGKILLed mid-maintenance while a fault
+                        injection wedges LF_WS inside dml.apply, then
+                        resumed — the maintenance commit journal must
+                        show ZERO double-applied functions (committed
+                        ones keep starts==[0], the victim re-runs
+                        exactly once), the validate phase must match a
+                        CPU oracle on the maintained warehouse, the
+                        metric folds both Tdm terms, every mutated
+                        table keeps its BASELINE parts + _v*/ delta
+                        segments (base never rewritten) with device
+                        compression_ratio > 1, rollback restores the
+                        pre-maintenance power digests byte-identically,
+                        and DML invalidation is table-scoped (an
+                        unrelated query re-runs with zero compiles)
  11b. serve-fleet       replicated fleet gate
                         (tools/fleet_serve_check.py): 3 real replica
                         PROCESSES (one started after warmup, warm
@@ -202,6 +220,7 @@ import compress_check  # noqa: E402
 import cost_check  # noqa: E402
 import fleet_check  # noqa: E402
 import fleet_serve_check  # noqa: E402
+import maint_check  # noqa: E402
 import ndslint  # noqa: E402
 import ndsperf  # noqa: E402
 import ndsjit  # noqa: E402
@@ -400,6 +419,7 @@ def main() -> int:
         ("compress", lambda: compress_check.main([])),
         ("pipeline", lambda: pipeline_check.main([])),
         ("cost", lambda: cost_check.main([])),
+        ("maint", lambda: maint_check.main([])),
         ("serve", lambda: serve_check.main([])),
         ("serve-fleet", lambda: fleet_serve_check.main([])),
         ("locksan", run_locksan_check),
